@@ -1,0 +1,57 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle
+Fluid 1.x capabilities.
+
+Public API mirrors `paddle.fluid` (reference: python/paddle/fluid/
+__init__.py): Program/Block/Variable graph building, layers, optimizers,
+Executor, ParallelExecutor/CompiledProgram, io, readers — but the runtime
+is JAX/XLA: programs compile to single fused TPU computations, parallelism
+is pjit/GSPMD over a device Mesh, and kernels are jnp/lax/Pallas.
+"""
+
+from . import clip  # noqa: F401
+from . import initializer  # noqa: F401
+from . import layers  # noqa: F401
+from . import ops as _ops  # registers all op impls  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .core import unique_name  # noqa: F401
+from .core.backward import append_backward, gradients  # noqa: F401
+from .core.executor import (Executor, Scope, global_scope,  # noqa: F401
+                            scope_guard)
+from .core.program import (Block, Operator, Parameter, Program,  # noqa: F401
+                           Variable, default_main_program,
+                           default_startup_program, name_scope,
+                           program_guard)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+
+class CPUPlace:
+    """Placement token (reference: paddle/fluid/platform/place.h:26-57).
+    Device choice on TPU is driven by the JAX platform / shardings, so
+    Places are identity tokens for API parity."""
+
+    def __repr__(self):
+        return "CPUPlace()"
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# Alias so fluid scripts using CUDAPlace run unchanged on TPU.
+CUDAPlace = TPUPlace
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+__version__ = "0.1.0"

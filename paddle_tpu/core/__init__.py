@@ -1,0 +1,1 @@
+"""Core IR + executor (analog of paddle/fluid/framework/)."""

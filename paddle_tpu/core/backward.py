@@ -1,0 +1,85 @@
+"""Autodiff as a program transformation.
+
+TPU-native analog of fluid's append_backward
+(reference: python/paddle/fluid/backward.py:394 — which walks the op list,
+asks C++ grad-op makers for grad OpDescs, sums duplicated grads and prunes
+no-grad branches).  Here there are no per-op grad kernels: append_backward
+records a *backward boundary* in the program — everything before it is the
+forward function, and the Executor computes parameter gradients with
+`jax.value_and_grad` over that traced forward (core/executor.py
+interpret_program).  Gradient variables `<p>@GRAD` become real program vars
+so the optimizer update ops that fluid appends after the backward section
+work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .program import (Parameter, Program, Variable, default_main_program,
+                      grad_var_name)
+
+
+def append_backward(loss: Variable,
+                    parameter_list: Optional[Sequence[str]] = None,
+                    no_grad_set: Optional[Set[str]] = None,
+                    callbacks=None) -> List[Tuple[Variable, Variable]]:
+    """Mark the backward boundary and create gradient variables.
+
+    Returns [(parameter, gradient_variable)] like the reference
+    (backward.py:394).  Must be called once per program, after the forward
+    graph is complete.
+    """
+    program = loss.block.program
+    block = program.global_block()
+    if program._backward_info is not None:
+        raise RuntimeError("append_backward called twice on the same program")
+
+    no_grad = set(no_grad_set or ())
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = block.all_parameters()
+    params = [p for p in params
+              if getattr(p, "trainable", True) and p.name not in no_grad]
+    if not params:
+        raise RuntimeError("no trainable parameters found for backward")
+
+    index = len(block.ops)
+
+    # Create grad vars (loss grad + one per param).
+    loss_grad = block.create_var(
+        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype,
+        stop_gradient=True)
+    params_grads: List[Tuple[Variable, Variable]] = []
+    grad_names = []
+    for p in params:
+        g = block.create_var(
+            name=grad_var_name(p.name), shape=p.shape, dtype=p.dtype,
+            stop_gradient=True)
+        params_grads.append((p, g))
+        grad_names.append(g.name)
+
+    block.append_op(
+        type="backward_marker",
+        inputs={"Loss": [loss]},
+        outputs={"LossGrad": [loss_grad], "ParamGrads": grad_names},
+        attrs={"params": [p.name for p in params]},
+    )
+    program._backward_info = {
+        "index": index,
+        "loss": loss.name,
+        "params": [p.name for p in params],
+    }
+    return params_grads
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """Grad of targets w.r.t. arbitrary input vars (fluid calc_gradient,
+    backward.py:613).  Executed eagerly by the Executor at fetch time via a
+    dedicated sub-program is future work; currently supports the common
+    parameter case through append_backward."""
+    raise NotImplementedError(
+        "calc_gradient-style arbitrary-input grads land with the "
+        "control-flow milestone; use append_backward for parameters")

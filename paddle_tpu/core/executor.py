@@ -1,0 +1,315 @@
+"""Executor: compile a Program to one XLA computation and run it.
+
+TPU-native analog of the reference C++ Executor
+(reference: paddle/fluid/framework/executor.cc — Run:299, Prepare:372, the
+op-by-op hot loop at :448-455, program cache in python executor.py:222).
+The key design change: instead of interpreting OpDescs one at a time on a
+device stream, the whole program — forward ops, the autodiff boundary
+(core/backward.py), and optimizer update ops — is traced ONCE into a single
+`jax.jit` function of shape
+
+    step(state: {persistable: Array}, feeds: {name: Array})
+        -> (new_state, fetches)
+
+with the state argument donated.  XLA then fuses/schedules everything; eager
+per-op garbage collection (executor.cc:45-134) is unnecessary because XLA's
+buffer liveness analysis subsumes it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .desc import normalize_dtype
+from .program import (GRAD_SUFFIX, Parameter, Program, Variable,
+                      grad_var_name)
+from .registry import OpContext, get_op_impl
+
+RNG_STATE_VAR = "__rng_key__"
+
+
+class Scope:
+    """Name → value store for persistable state (reference: scope.h:48).
+
+    Parent-chain lookup is kept for API parity; values are jax Arrays (on
+    device) or numpy arrays.
+    """
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.vars: Dict[str, Any] = {}
+        self.kids: List["Scope"] = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def var(self, name: str):
+        """Find-or-create (reference scope.h:56 Var)."""
+        if name not in self.vars:
+            self.vars[name] = None
+        return self.vars[name]
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def set_var(self, name: str, value):
+        self.vars[name] = value
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def local_var_names(self) -> List[str]:
+        return list(self.vars)
+
+    def drop_kids(self):
+        self.kids = []
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+# ---------------------------------------------------------------------------
+# Program interpretation (used inside jit traces)
+# ---------------------------------------------------------------------------
+
+def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0):
+    """Interpret a straight-line op list over `env` (name → traced array).
+
+    This runs under jax tracing: each op impl emits jaxpr; nothing executes
+    eagerly.  Equivalent of the executor hot loop (executor.cc:448) but as a
+    trace, compiled once.
+    """
+    for i, op in enumerate(ops):
+        desc = op.desc
+        impl = get_op_impl(desc.type)
+        ins = {
+            slot: [env[n] for n in names]
+            for slot, names in desc.inputs.items()
+        }
+        ctx = OpContext(rng_key, op_index=start_index + i)
+        outs = impl(ctx, ins, desc.attrs)
+        for slot, names in desc.outputs.items():
+            values = outs.get(slot, [])
+            if len(values) != len(names):
+                raise RuntimeError(
+                    f"op {desc.type}: output slot {slot!r} produced "
+                    f"{len(values)} values for {len(names)} names"
+                )
+            for name, val in zip(names, values):
+                env[name] = val
+    return env
+
+
+def prune_ops(program: Program, fetch_names):
+    """Dead-op elimination: keep ops contributing to fetches or writing
+    persistable state (reference analog: Program pruning in
+    framework/prune.cc + io.py save_inference_model's prune to targets).
+    Training programs (with a backward boundary) are never pruned."""
+    ops = program.global_block().ops
+    if program._backward_info is not None:
+        return ops
+    block = program.global_block()
+
+    def is_persistable(name: str) -> bool:
+        return block.has_var(name) and block.var(name).persistable
+
+    needed = set(fetch_names)
+    keep = [False] * len(ops)
+    for i in range(len(ops) - 1, -1, -1):
+        desc = ops[i].desc
+        outs = desc.output_names()
+        if any(n in needed for n in outs) or any(
+                is_persistable(n) for n in outs):
+            keep[i] = True
+            needed.update(desc.input_names())
+    return [op for i, op in enumerate(ops) if keep[i]]
+
+
+def _split_params(program: Program, env: Dict[str, Any]):
+    info = program._backward_info
+    trainable = {}
+    for pname in info["params"]:
+        if pname in env:
+            trainable[pname] = env[pname]
+    return trainable
+
+
+def interpret_program(program: Program, env: Dict[str, Any], rng_key,
+                      fetch_names=()):
+    """Run the full program (forward [+ backward + update ops]) over env."""
+    import jax
+
+    info = program._backward_info
+    if info is None:
+        return run_ops(prune_ops(program, fetch_names), env, rng_key)
+    ops = program.global_block().ops
+
+    k = info["index"]
+    loss_name = info["loss"]
+    fwd_ops, rest_ops = ops[:k], ops[k:]
+    trainable = _split_params(program, env)
+
+    def fwd(params, base_env):
+        e = dict(base_env)
+        e.update(params)
+        run_ops(fwd_ops, e, rng_key)
+        loss = e[loss_name]
+        if loss.ndim > 0:
+            import jax.numpy as jnp
+
+            loss = jnp.squeeze(loss)
+        return loss, e
+
+    (loss_val, env_after), grads = jax.value_and_grad(fwd, has_aux=True)(
+        trainable, env
+    )
+    env = env_after
+    env[grad_var_name(loss_name)] = loss_val * 0 + 1.0
+    for pname, g in grads.items():
+        env[grad_var_name(pname)] = g
+    # rest_ops[0] is the `backward_marker` op itself; skip it.
+    run_ops(rest_ops[1:], env, rng_key, start_index=k + 1)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class Executor:
+    """Compile-and-run engine (reference: python/paddle/fluid/executor.py:445
+    Executor.run and paddle/fluid/framework/executor.cc).
+
+    place is accepted for API parity; JAX device placement is controlled by
+    the platform (real TPU) or by CompiledProgram shardings (parallel/).
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    # -- public API ------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[Any]] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True,
+            use_program_cache: bool = True):
+        from .program import default_main_program
+
+        import jax
+        import jax.numpy as jnp
+
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = dict(feed or {})
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f)
+            for f in (fetch_list or [])
+        ]
+
+        compiled = getattr(program, "_compiled_wrapper", None)
+        if compiled is not None:
+            return compiled.run(self, feed, fetch_names, scope,
+                                return_numpy=return_numpy)
+
+        block = program.global_block()
+
+        # Ensure RNG state exists whenever any op may need randomness.
+        if RNG_STATE_VAR not in scope.vars:
+            scope.set_var(RNG_STATE_VAR,
+                          jax.random.PRNGKey(program.random_seed))
+
+        state_names = tuple(sorted(
+            v.name for v in block.vars.values()
+            if v.persistable and scope.has_var(v.name)
+        ))
+        key = (id(program), program._version, tuple(sorted(feed)),
+               tuple(fetch_names), state_names)
+        fn = self._cache.get(key) if use_program_cache else None
+        if fn is None:
+            fn = self._build_step_fn(program, tuple(sorted(feed)),
+                                     tuple(fetch_names), state_names)
+            if use_program_cache:
+                self._cache[key] = fn
+
+        state = {n: scope.find_var(n) for n in state_names}
+        state[RNG_STATE_VAR] = scope.find_var(RNG_STATE_VAR)
+        feed_arrays = {
+            name: _to_array(value, block)
+            for name, value in feed.items()
+        }
+        new_state, fetches = fn(state, feed_arrays)
+        for name, val in new_state.items():
+            scope.set_var(name, val)
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
+        return fetches
+
+    def close(self):
+        self._cache.clear()
+
+    # -- compilation -----------------------------------------------------
+    def _build_step_fn(self, program: Program, feed_names, fetch_names,
+                       state_names):
+        import jax
+
+        persistable_names = tuple(sorted(
+            v.name for v in program.global_block().vars.values()
+            if v.persistable
+        ))
+
+        def step(state, feeds):
+            rng_key = state[RNG_STATE_VAR]
+            env: Dict[str, Any] = {}
+            env.update({k: v for k, v in state.items()
+                        if k != RNG_STATE_VAR})
+            env.update(feeds)
+            env = interpret_program(program, env, rng_key,
+                                    fetch_names=fetch_names)
+            new_state = {
+                n: env[n] for n in persistable_names if n in env
+            }
+            new_state[RNG_STATE_VAR] = jax.random.split(rng_key, 1)[0]
+            fetches = [env[n] for n in fetch_names]
+            return new_state, fetches
+
+        return jax.jit(step, donate_argnums=(0,))
+
+
+def _to_array(value, block):
+    import jax.numpy as jnp
+
+    if isinstance(value, np.ndarray):
+        return jnp.asarray(value)
+    if isinstance(value, (int, float, list, tuple)):
+        return jnp.asarray(value)
+    return value  # already a jax Array

@@ -1,0 +1,472 @@
+"""Program / Block / Variable: the user-facing graph-building API.
+
+TPU-native analog of the reference's Python framework layer
+(reference: python/paddle/fluid/framework.py — Program:1510, Block:992,
+Operator:551, Variable:231, Parameter:2104, program_guard, name_scope:106).
+
+Layer functions append OpDescs to the default main Program and parameter
+initialization ops to the default startup Program, exactly like Fluid's two
+implicit global programs.  Unlike Fluid there is no C++ op-by-op interpreter:
+the Executor (core/executor.py) lowers the finished program to a single
+jit-compiled XLA computation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import unique_name
+from .desc import OpDesc, PROGRAM_FORMAT_VERSION, VarDesc, normalize_dtype
+
+GRAD_SUFFIX = "@GRAD"  # reference: paddle/fluid/framework/operator.h:64
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class Variable:
+    """Symbolic handle to a program variable.
+
+    Mirrors fluid.framework.Variable (framework.py:231): carries name,
+    shape (-1 = dynamic batch dim), dtype; arithmetic operators are
+    overloaded to append elementwise ops (reference:
+    python/paddle/fluid/layers/math_op_patch.py).
+    """
+
+    def __init__(self, block: "Block", desc: VarDesc):
+        self.block = block
+        self.desc = desc
+
+    # --- desc accessors -------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.desc.name
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.desc.shape)
+
+    @property
+    def dtype(self) -> str:
+        return self.desc.dtype
+
+    @property
+    def persistable(self) -> bool:
+        return self.desc.persistable
+
+    @persistable.setter
+    def persistable(self, v: bool):
+        self.desc.persistable = v
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self.desc.stop_gradient = v
+
+    @property
+    def lod_level(self) -> int:
+        return self.desc.lod_level
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, "
+            f"dtype={self.dtype}, persistable={self.persistable})"
+        )
+
+    # --- math op patching ----------------------------------------------
+    def _elementwise(self, other, op_type: str, reverse: bool = False):
+        from .. import layers  # lazy: layers depends on program
+
+        if isinstance(other, (int, float, np.floating, np.integer)):
+            if op_type == "elementwise_add":
+                return layers.scale(self, scale=1.0, bias=float(other))
+            if op_type == "elementwise_sub":
+                if reverse:
+                    return layers.scale(self, scale=-1.0, bias=float(other))
+                return layers.scale(self, scale=1.0, bias=-float(other))
+            if op_type == "elementwise_mul":
+                return layers.scale(self, scale=float(other), bias=0.0)
+            other = layers.fill_constant(
+                shape=[1], dtype=self.dtype, value=float(other)
+            )
+        x, y = (other, self) if reverse else (self, other)
+        return layers.elementwise_op(op_type, x, y)
+
+    def __add__(self, other):
+        return self._elementwise(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._elementwise(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        return self._elementwise(other, "elementwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._elementwise(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._elementwise(other, "elementwise_div")
+
+    def __rtruediv__(self, other):
+        return self._elementwise(other, "elementwise_div", reverse=True)
+
+    def __pow__(self, other):
+        return self._elementwise(other, "elementwise_pow")
+
+    def __neg__(self):
+        from .. import layers
+
+        return layers.scale(self, scale=-1.0)
+
+    def _compare(self, other, op_type):
+        from .. import layers
+
+        if isinstance(other, (int, float, np.floating, np.integer)):
+            other = layers.fill_constant(
+                shape=[1], dtype=self.dtype, value=float(other)
+            )
+        return layers.elementwise_op(op_type, self, other, out_dtype="bool")
+
+    def __lt__(self, other):
+        return self._compare(other, "less_than")
+
+    def __le__(self, other):
+        return self._compare(other, "less_equal")
+
+    def __gt__(self, other):
+        return self._compare(other, "greater_than")
+
+    def __ge__(self, other):
+        return self._compare(other, "greater_equal")
+
+    def astype(self, dtype):
+        from .. import layers
+
+        return layers.cast(self, dtype)
+
+
+class Parameter(Variable):
+    """Trainable persistable variable (fluid framework.py:2104).
+
+    Carries optimizer-adjacent metadata: regularizer, gradient clip attr,
+    learning-rate multiplier, trainable flag.
+    """
+
+    def __init__(self, block, desc, regularizer=None, gradient_clip_attr=None,
+                 learning_rate: float = 1.0, trainable: bool = True):
+        super().__init__(block, desc)
+        desc.persistable = True
+        desc.is_parameter = True
+        desc.trainable = trainable
+        self.regularizer = regularizer
+        self.gradient_clip_attr = gradient_clip_attr
+        self.learning_rate = learning_rate
+
+    @property
+    def trainable(self) -> bool:
+        return self.desc.trainable
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.desc.trainable = v
+
+
+class Operator:
+    """Thin python view over an OpDesc (fluid framework.py:551)."""
+
+    def __init__(self, block: "Block", desc: OpDesc):
+        self.block = block
+        self.desc = desc
+
+    @property
+    def type(self) -> str:
+        return self.desc.type
+
+    def input(self, slot: str) -> List[str]:
+        return self.desc.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.desc.outputs.get(slot, [])
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return self.desc.attrs
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.desc.inputs.items()}
+        outs = {k: v for k, v in self.desc.outputs.items()}
+        return f"{self.type}(inputs={ins}, outputs={outs}, attrs={self.desc.attrs})"
+
+
+class Block:
+    """A straight-line list of ops plus a var table.
+
+    The reference uses nested blocks for control flow (while/cond sub-blocks,
+    framework.py:992); here structured control flow is expressed inside op
+    implementations via lax.scan/cond/while_loop, so a program is typically a
+    single global block.  The Block abstraction is kept for API parity.
+    """
+
+    def __init__(self, program: "Program", idx: int = 0, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    # --- vars -----------------------------------------------------------
+    def create_var(self, name: Optional[str] = None, shape=(), dtype="float32",
+                   persistable: bool = False, stop_gradient: bool = False,
+                   is_data: bool = False, lod_level: int = 0) -> Variable:
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        desc = VarDesc(
+            name=name,
+            shape=tuple(int(s) for s in shape),
+            dtype=normalize_dtype(dtype),
+            persistable=persistable,
+            stop_gradient=stop_gradient,
+            is_data=is_data,
+            lod_level=lod_level,
+        )
+        var = Variable(self, desc)
+        self.vars[name] = var
+        self.program._bump()
+        return var
+
+    def create_parameter(self, name, shape, dtype, **kwargs) -> Parameter:
+        desc = VarDesc(
+            name=name,
+            shape=tuple(int(s) for s in shape),
+            dtype=normalize_dtype(dtype),
+            persistable=True,
+        )
+        param = Parameter(self, desc, **kwargs)
+        self.vars[name] = param
+        self.program._bump()
+        return param
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # --- ops ------------------------------------------------------------
+    def append_op(self, type: str, inputs: Dict[str, Any] | None = None,
+                  outputs: Dict[str, Any] | None = None,
+                  attrs: Dict[str, Any] | None = None) -> Operator:
+        desc = OpDesc(
+            type=type,
+            inputs=_slot_names(inputs),
+            outputs=_slot_names(outputs),
+            attrs=dict(attrs or {}),
+        )
+        op = Operator(self, desc)
+        self.ops.append(op)
+        self.program._bump()
+        from .shape_inference import infer_op_shapes
+
+        infer_op_shapes(desc, self)
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        desc = OpDesc(
+            type=type,
+            inputs=_slot_names(inputs),
+            outputs=_slot_names(outputs),
+            attrs=dict(attrs or {}),
+        )
+        op = Operator(self, desc)
+        self.ops.insert(0, op)
+        self.program._bump()
+        return op
+
+
+def _slot_names(slots: Dict[str, Any] | None) -> Dict[str, List[str]]:
+    """Normalize {slot: Variable | name | list-of-those} to {slot: [names]}."""
+    out: Dict[str, List[str]] = {}
+    for slot, v in (slots or {}).items():
+        if v is None:
+            continue
+        if not isinstance(v, (list, tuple)):
+            v = [v]
+        names = []
+        for item in v:
+            if isinstance(item, Variable):
+                names.append(item.name)
+            elif isinstance(item, str):
+                names.append(item)
+            else:
+                raise TypeError(f"bad value for slot {slot!r}: {item!r}")
+        out[slot] = names
+    return out
+
+
+class Program:
+    """A complete computation description (fluid framework.py:1510).
+
+    Two implicit globals exist, matching Fluid: the default *main* program
+    (the training/inference graph) and the default *startup* program
+    (parameter/state initialization, run once by Executor.run(startup)).
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.random_seed: int = 0
+        # Monotonic edit counter; the Executor uses (id, version) as its
+        # compile-cache key, so any mutation invalidates cached executables.
+        self._version = 0
+        # Set by append_backward: index boundary and grad bookkeeping.
+        self._backward_info: Optional[Dict[str, Any]] = None
+
+    def _bump(self):
+        self._version += 1
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    def list_vars(self) -> Iterable[Variable]:
+        return list(self.global_block().vars.values())
+
+    # --- clone / prune -------------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-copy the program.  With for_test=True, switch ops to
+        inference behavior (dropout off, batch_norm uses global stats) and
+        drop everything after the backward marker — mirroring
+        fluid.Program.clone(for_test=True)."""
+        p = Program()
+        p.random_seed = self.random_seed
+        blk = p.global_block()
+        for name, var in self.global_block().vars.items():
+            desc = copy.deepcopy(var.desc)
+            if isinstance(var, Parameter):
+                nv = Parameter(blk, desc, regularizer=var.regularizer,
+                               gradient_clip_attr=var.gradient_clip_attr,
+                               learning_rate=var.learning_rate)
+            else:
+                nv = Variable(blk, desc)
+            blk.vars[name] = nv
+        ops = self.global_block().ops
+        if for_test and self._backward_info is not None:
+            ops = ops[: self._backward_info["index"]]
+        for op in ops:
+            desc = copy.deepcopy(op.desc)
+            if for_test and "is_test" in _TEST_MODE_OPS.get(desc.type, ()):
+                desc.attrs["is_test"] = True
+            blk.ops.append(Operator(blk, desc))
+        if not for_test:
+            p._backward_info = copy.deepcopy(self._backward_info)
+        return p
+
+    # --- serialization --------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": PROGRAM_FORMAT_VERSION,
+            "random_seed": self.random_seed,
+            "vars": [v.desc.to_dict() for v in self.global_block().vars.values()],
+            "params": [v.name for v in self.all_parameters()],
+            "ops": [op.desc.to_dict() for op in self.global_block().ops],
+            "backward_info": self._backward_info,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Program":
+        p = Program()
+        p.random_seed = d.get("random_seed", 0)
+        blk = p.global_block()
+        params = set(d.get("params", []))
+        for vd in d["vars"]:
+            desc = VarDesc.from_dict(vd)
+            if desc.name in params or desc.is_parameter:
+                blk.vars[desc.name] = Parameter(blk, desc)
+            else:
+                blk.vars[desc.name] = Variable(blk, desc)
+        for od in d["ops"]:
+            blk.ops.append(Operator(blk, OpDesc.from_dict(od)))
+        p._backward_info = d.get("backward_info")
+        return p
+
+    def __str__(self):
+        lines = [f"Program(version={self._version})"]
+        for v in self.global_block().vars.values():
+            tag = "param" if isinstance(v, Parameter) else (
+                "data" if v.desc.is_data else "var")
+            lines.append(
+                f"  {tag} {v.name}: shape={v.shape} dtype={v.dtype}"
+                f"{' persistable' if v.persistable else ''}")
+        for i, op in enumerate(self.global_block().ops):
+            lines.append(f"  op[{i}] {op!r}")
+        return "\n".join(lines)
+
+
+# Ops that honor an is_test attribute when cloned for inference.
+_TEST_MODE_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+
+# ---------------------------------------------------------------------------
+# Default-program machinery (fluid framework.py default_main_program etc.)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    global _main_program, _startup_program
+    old_main, old_startup = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program = old_main
+        _startup_program = old_startup
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    """Name scoping (fluid framework.py:106): generated var/param names are
+    prefixed with the scope path while the context is active."""
+    unique_name._scope_stack.append(prefix)
+    try:
+        yield
+    finally:
+        unique_name._scope_stack.pop()

@@ -1,0 +1,74 @@
+"""Operator registry: op type name → JAX implementation.
+
+TPU-native analog of the reference kernel registry
+(reference: paddle/fluid/framework/op_registry.h:197,237,240 —
+REGISTER_OPERATOR / REGISTER_OP_*_KERNEL).  There is no per-device kernel
+dispatch: every op has one traceable JAX implementation and XLA lowers it to
+the target backend.  Grad kernels don't exist either — autodiff is jax.grad
+over the traced program (see core/backward.py) instead of grad-op makers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+# impl signature: impl(ctx, ins: Dict[slot, List[Array]], attrs: Dict) ->
+#                 Dict[slot, List[Array]]
+OpImpl = Callable[..., Dict[str, List[Any]]]
+
+_REGISTRY: Dict[str, OpImpl] = {}
+
+
+def register_op(op_type: str):
+    """Decorator registering an implementation for `op_type`."""
+
+    def deco(fn: OpImpl) -> OpImpl:
+        if op_type in _REGISTRY:
+            raise ValueError(f"op {op_type!r} registered twice")
+        _REGISTRY[op_type] = fn
+        return fn
+
+    return deco
+
+
+def get_op_impl(op_type: str) -> OpImpl:
+    impl = _REGISTRY.get(op_type)
+    if impl is None:
+        raise NotImplementedError(
+            f"no implementation registered for op {op_type!r}; "
+            f"known ops: {sorted(_REGISTRY)[:20]}..."
+        )
+    return impl
+
+
+def has_op(op_type: str) -> bool:
+    return op_type in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class OpContext:
+    """Per-execution context handed to op impls.
+
+    Provides deterministic per-op PRNG keys derived from the step key
+    (replaces the reference's per-op curand/seed attrs) and scope-level
+    flags such as nan-check (reference FLAGS_check_nan_inf,
+    paddle/fluid/framework/operator.cc:943).
+    """
+
+    def __init__(self, rng_key, op_index: int = 0, is_test: bool = False):
+        self._rng_key = rng_key
+        self.op_index = op_index
+        self.is_test = is_test
+
+    def rng(self):
+        """A PRNG key unique to this op within the step."""
+        import jax
+
+        if self._rng_key is None:
+            raise RuntimeError(
+                "op requested randomness but executor has no RNG state"
+            )
+        return jax.random.fold_in(self._rng_key, self.op_index)

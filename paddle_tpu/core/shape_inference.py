@@ -1,0 +1,90 @@
+"""Shape/dtype inference by abstract evaluation.
+
+The reference implements a hand-written InferShape per operator
+(reference: paddle/fluid/framework/shape_inference.h + each op's
+InferShape).  Here we get all of them for free: when an op is appended at
+graph-build time, its JAX implementation is abstractly evaluated with
+`jax.eval_shape` over ShapeDtypeStructs, and the resulting output
+shapes/dtypes are written back into the output VarDescs.
+
+Dynamic batch dims (-1) are represented during abstract evaluation by a
+large prime sentinel; output dims divisible by the sentinel are restored
+to -1 (a batch dim flowing through reshape/flatten keeps its dynamic
+marking).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+# Large prime sentinel standing in for a dynamic (-1) dimension.
+DYNAMIC_DIM_SENTINEL = 1000003
+
+
+def _encode_shape(shape):
+    return tuple(DYNAMIC_DIM_SENTINEL if d == -1 else int(d) for d in shape)
+
+
+def _decode_dim(d: int) -> int:
+    if d >= DYNAMIC_DIM_SENTINEL and d % DYNAMIC_DIM_SENTINEL == 0:
+        return -1
+    return int(d)
+
+
+def _decode_shape(shape):
+    return tuple(_decode_dim(d) for d in shape)
+
+
+# Op types that the executor handles specially or whose impls can't be
+# abstractly evaluated; their outputs keep declared shapes.
+_SKIP_INFERENCE = {"backward_marker", "py_func", "print"}
+
+
+def infer_op_shapes(op_desc, block) -> bool:
+    """Best-effort shape inference for one appended op.  Returns True when
+    output VarDescs were updated."""
+    if op_desc.type in _SKIP_INFERENCE:
+        return False
+    import jax
+    import jax.numpy as jnp
+
+    from .registry import OpContext, get_op_impl, has_op
+
+    if not has_op(op_desc.type):
+        return False
+
+    ins: Dict[str, List[jax.ShapeDtypeStruct]] = {}
+    for slot, names in op_desc.inputs.items():
+        specs = []
+        for n in names:
+            if not block.has_var(n):
+                return False
+            v = block.var(n)
+            specs.append(
+                jax.ShapeDtypeStruct(_encode_shape(v.shape), jnp.dtype(v.dtype))
+            )
+        ins[slot] = specs
+
+    impl = get_op_impl(op_desc.type)
+
+    def absfn(abstract_ins):
+        ctx = OpContext(jax.random.PRNGKey(0), op_index=0,
+                        is_test=bool(op_desc.attrs.get("is_test", False)))
+        return impl(ctx, abstract_ins, op_desc.attrs)
+
+    try:
+        outs = jax.eval_shape(absfn, ins)
+    except Exception:
+        return False  # leave declared shapes; executor will still run it
+
+    for slot, names in op_desc.outputs.items():
+        specs = outs.get(slot, [])
+        if len(specs) != len(names):
+            continue
+        for n, spec in zip(names, specs):
+            if not block.has_var(n):
+                continue
+            v = block.var(n)
+            v.desc.shape = _decode_shape(spec.shape)
+            v.desc.dtype = str(spec.dtype)
+    return True

@@ -1,0 +1,16 @@
+"""fluid.layers-equivalent namespace.
+
+reference: python/paddle/fluid/layers/__init__.py — flat namespace over
+nn / tensor / io / ops / control_flow / metric_op / learning-rate
+schedulers.
+"""
+
+from .io import data  # noqa: F401
+from .metric_op import accuracy, auc  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .nn import elementwise_op  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .tensor import (argmax, argmin, argsort, assign, cast, concat,  # noqa: F401
+                     create_global_var, create_tensor, fill_constant,
+                     fill_constant_batch_size_like, increment, isfinite,
+                     ones, range, reverse, sums, where, zeros, zeros_like)

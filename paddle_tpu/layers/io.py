@@ -1,0 +1,32 @@
+"""Input layers.
+
+reference: python/paddle/fluid/layers/io.py — data (:?), py_reader (:633),
+double_buffer (:1002).  On TPU the reader pipeline is host-side
+(paddle_tpu/data/) and feeds jitted steps; `data` declares a feed var.
+"""
+
+from __future__ import annotations
+
+from ..core.program import default_main_program
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare a feed variable (reference layers/io.py data).
+
+    append_batch_size=True prepends a dynamic batch dim (-1), matching
+    fluid.  lod_level>0 declares a ragged sequence input: the DataFeeder
+    pads it and produces a companion `<name>.seq_len` int32 var with true
+    lengths (segment-based replacement for LoD, SURVEY.md §5.7).
+    """
+    block = default_main_program().global_block()
+    full_shape = list(shape)
+    if append_batch_size:
+        full_shape = [-1] + full_shape
+    var = block.create_var(name=name, shape=full_shape, dtype=dtype,
+                           is_data=True, stop_gradient=stop_gradient,
+                           lod_level=lod_level)
+    if lod_level > 0:
+        block.create_var(name=f"{name}.seq_len", shape=[-1], dtype="int32",
+                         is_data=True, stop_gradient=True)
+    return var

@@ -1,0 +1,20 @@
+"""Op implementations — importing this package registers all ops.
+
+The registry (core/registry.py) is the analog of the reference's static
+kernel registrars (op_registry.h); importing modules here plays the role
+of the static-initialization pass that populates the kernel maps.
+"""
+
+from ..core.registry import register_op, registered_ops  # noqa: F401
+from . import basic  # noqa: F401
+from . import nn  # noqa: F401
+from . import optim  # noqa: F401
+
+
+@register_op("backward_marker")
+def _backward_marker(ctx, ins, attrs):
+    raise RuntimeError(
+        "backward_marker must be handled by the Executor's autodiff split "
+        "(core/executor.py interpret_program); running it as a plain op "
+        "means the program's _backward_info was lost"
+    )

@@ -1,0 +1,524 @@
+"""Basic math / tensor ops.
+
+Covers the reference's dense-math group (SURVEY.md §2.2 "Dense math" +
+"Tensor manipulation" + "Reduce"): mul, matmul, scale, cast, sum, mean,
+elementwise family with broadcast axis, comparisons, fill/assign/random
+init ops, reshape/transpose/concat/split/etc.
+(reference files: paddle/fluid/operators/mul_op.cc, matmul_op.cc,
+elementwise/*, reduce_ops/*, fill_constant_op.cc, ...)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import broadcast_y, first, opt_in, out, to_jnp_dtype
+
+
+# --------------------------------------------------------------------------
+# Fill / init / random
+# --------------------------------------------------------------------------
+
+@register_op("fill_constant")
+def fill_constant(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    return out(Out=jnp.full(shape, attrs.get("value", 0.0), dtype=dtype))
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like(ctx, ins, attrs):
+    return out(Out=jnp.zeros_like(first(ins, "X")))
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    x = first(ins, "Input")
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    return out(Out=jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dtype))
+
+
+@register_op("assign")
+def assign(ctx, ins, attrs):
+    return out(Out=first(ins, "X"))
+
+
+@register_op("assign_value")
+def assign_value(ctx, ins, attrs):
+    values = np.asarray(attrs["values"], dtype=attrs.get("dtype", "float32"))
+    return out(Out=jnp.asarray(values.reshape(attrs["shape"])))
+
+
+@register_op("gaussian_random")
+def gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    x = jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)
+    x = x * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return out(Out=x.astype(dtype))
+
+
+@register_op("uniform_random")
+def uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    x = jax.random.uniform(
+        ctx.rng(), shape, dtype=jnp.float32,
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))
+    return out(Out=x.astype(dtype))
+
+
+@register_op("truncated_gaussian_random")
+def truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    x = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, jnp.float32)
+    x = x * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return out(Out=x.astype(dtype))
+
+
+@register_op("uniform_random_batch_size_like")
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    x = first(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    o = jax.random.uniform(ctx.rng(), tuple(shape), dtype=jnp.float32,
+                           minval=attrs.get("min", -1.0),
+                           maxval=attrs.get("max", 1.0))
+    return out(Out=o.astype(dtype))
+
+
+@register_op("randint")
+def randint(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    x = jax.random.randint(ctx.rng(), shape, attrs.get("low", 0),
+                           attrs.get("high", 2**31 - 1),
+                           dtype=to_jnp_dtype(attrs.get("dtype", "int64")))
+    return out(Out=x)
+
+
+# --------------------------------------------------------------------------
+# Matmul family
+# --------------------------------------------------------------------------
+
+@register_op("mul")
+def mul(ctx, ins, attrs):
+    """Flattening matmul (reference: operators/mul_op.cc) — x flattened to 2D
+    at x_num_col_dims, y at y_num_col_dims."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xnc])), int(np.prod(xs[xnc:]))))
+    y2 = y.reshape((int(np.prod(ys[:ync])), int(np.prod(ys[ync:]))))
+    o = x2 @ y2
+    return out(Out=o.reshape(xs[:xnc] + ys[ync:]))
+
+
+@register_op("matmul")
+def matmul(ctx, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    o = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        o = o * alpha
+    return out(Out=o)
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = first(ins, "X"), first(ins, "Y"), first(ins, "Weight")
+    bias = opt_in(ins, "Bias")
+    o = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if bias is not None:
+        o = o + bias
+    return out(Out=o)
+
+
+# --------------------------------------------------------------------------
+# Elementwise family (with fluid broadcast-axis semantics)
+# --------------------------------------------------------------------------
+
+def _register_elementwise(name, fn, out_dtype=None):
+    @register_op(name)
+    def impl(ctx, ins, attrs, _fn=fn, _dt=out_dtype):
+        x, y = first(ins, "X"), first(ins, "Y")
+        y = broadcast_y(x, y, attrs.get("axis", -1))
+        o = _fn(x, y)
+        if _dt is not None:
+            o = o.astype(_dt)
+        return out(Out=o)
+
+
+_register_elementwise("elementwise_add", jnp.add)
+_register_elementwise("elementwise_sub", jnp.subtract)
+_register_elementwise("elementwise_mul", jnp.multiply)
+_register_elementwise("elementwise_div", jnp.divide)
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_pow", jnp.power)
+_register_elementwise("elementwise_mod", jnp.mod)
+_register_elementwise("elementwise_floordiv", jnp.floor_divide)
+_register_elementwise("less_than", jnp.less, "bool")
+_register_elementwise("less_equal", jnp.less_equal, "bool")
+_register_elementwise("greater_than", jnp.greater, "bool")
+_register_elementwise("greater_equal", jnp.greater_equal, "bool")
+_register_elementwise("equal", jnp.equal, "bool")
+_register_elementwise("not_equal", jnp.not_equal, "bool")
+
+
+@register_op("logical_and")
+def logical_and(ctx, ins, attrs):
+    return out(Out=jnp.logical_and(first(ins, "X"), first(ins, "Y")))
+
+
+@register_op("logical_or")
+def logical_or(ctx, ins, attrs):
+    return out(Out=jnp.logical_or(first(ins, "X"), first(ins, "Y")))
+
+
+@register_op("logical_xor")
+def logical_xor(ctx, ins, attrs):
+    return out(Out=jnp.logical_xor(first(ins, "X"), first(ins, "Y")))
+
+
+@register_op("logical_not")
+def logical_not(ctx, ins, attrs):
+    return out(Out=jnp.logical_not(first(ins, "X")))
+
+
+# --------------------------------------------------------------------------
+# Scale / cast / clip / sign-style unary
+# --------------------------------------------------------------------------
+
+@register_op("scale")
+def scale(ctx, ins, attrs):
+    x = first(ins, "X")
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        o = x * s + b
+    else:
+        o = (x + b) * s
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("cast")
+def cast(ctx, ins, attrs):
+    return out(Out=first(ins, "X").astype(to_jnp_dtype(attrs["out_dtype"])))
+
+
+@register_op("clip")
+def clip(ctx, ins, attrs):
+    return out(Out=jnp.clip(first(ins, "X"), attrs["min"], attrs["max"]))
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx, ins, attrs):
+    x = first(ins, "X")
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale_f = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                        1.0)
+    return out(Out=x * scale_f)
+
+
+@register_op("isfinite")
+def isfinite(ctx, ins, attrs):
+    # reference isfinite_op reduces to a single bool over all inputs
+    xs = ins["X"]
+    flags = [jnp.all(jnp.isfinite(x.astype(jnp.float32))) for x in xs]
+    res = flags[0]
+    for f in flags[1:]:
+        res = jnp.logical_and(res, f)
+    return out(Out=res.reshape((1,)))
+
+
+# --------------------------------------------------------------------------
+# Reductions / sum / mean
+# --------------------------------------------------------------------------
+
+@register_op("sum")
+def sum_op(ctx, ins, attrs):
+    """Sum a list of tensors (reference: operators/sum_op.cc) — used by
+    backward grad accumulation and lr scheduling."""
+    xs = ins["X"]
+    o = xs[0]
+    for x in xs[1:]:
+        o = o + x
+    return out(Out=o)
+
+
+@register_op("mean")
+def mean(ctx, ins, attrs):
+    return out(Out=jnp.mean(first(ins, "X")).reshape((1,)))
+
+
+def _register_reduce(name, fn):
+    @register_op(name)
+    def impl(ctx, ins, attrs, _fn=fn):
+        x = first(ins, "X")
+        if attrs.get("reduce_all", False):
+            axes = None
+        else:
+            axes = tuple(a if a >= 0 else a + x.ndim
+                         for a in attrs.get("dim", [0]))
+        o = _fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))
+        if o.ndim == 0:
+            o = o.reshape((1,))
+        return out(Out=o)
+
+
+_register_reduce("reduce_sum", jnp.sum)
+_register_reduce("reduce_mean", jnp.mean)
+_register_reduce("reduce_max", jnp.max)
+_register_reduce("reduce_min", jnp.min)
+_register_reduce("reduce_prod", jnp.prod)
+_register_reduce("reduce_all", jnp.all)
+_register_reduce("reduce_any", jnp.any)
+
+
+# --------------------------------------------------------------------------
+# Shape manipulation
+# --------------------------------------------------------------------------
+
+@register_op("reshape")
+def reshape(ctx, ins, attrs):
+    x = first(ins, "X")
+    shape = list(attrs["shape"])
+    # fluid reshape: 0 means copy dim from input, -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    o = x.reshape(tuple(shape))
+    return {"Out": [o], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("squeeze")
+def squeeze(ctx, ins, attrs):
+    x = first(ins, "X")
+    axes = attrs.get("axes", [])
+    if axes:
+        o = jnp.squeeze(x, axis=tuple(a if a >= 0 else a + x.ndim
+                                      for a in axes))
+    else:
+        o = jnp.squeeze(x)
+    return {"Out": [o], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("unsqueeze")
+def unsqueeze(ctx, ins, attrs):
+    x = first(ins, "X")
+    o = x
+    for a in sorted(attrs["axes"]):
+        o = jnp.expand_dims(o, a)
+    return {"Out": [o], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("flatten")
+def flatten(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    o = x.reshape((lead, -1))
+    return {"Out": [o], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("transpose")
+def transpose(ctx, ins, attrs):
+    x = first(ins, "X")
+    o = jnp.transpose(x, attrs["axis"])
+    return {"Out": [o], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("concat")
+def concat(ctx, ins, attrs):
+    return out(Out=jnp.concatenate(ins["X"], axis=attrs.get("axis", 0)))
+
+
+@register_op("split")
+def split(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        pieces = jnp.split(x, idx, axis=axis)
+    else:
+        pieces = jnp.split(x, num, axis=axis)
+    return {"Out": list(pieces)}
+
+
+@register_op("stack")
+def stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def unstack(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    pieces = [jnp.squeeze(p, axis=axis)
+              for p in jnp.split(x, n, axis=axis)]
+    return {"Y": pieces}
+
+
+@register_op("expand")
+def expand(ctx, ins, attrs):
+    x = first(ins, "X")
+    times = attrs["expand_times"]
+    return out(Out=jnp.tile(x, tuple(times)))
+
+
+@register_op("slice")
+def slice_op(ctx, ins, attrs):
+    x = first(ins, "Input")
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = s + dim if s < 0 else min(s, dim)
+        e = e + dim if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return out(Out=x[tuple(idx)])
+
+
+@register_op("gather")
+def gather(ctx, ins, attrs):
+    x, index = first(ins, "X"), first(ins, "Index")
+    return out(Out=jnp.take(x, index.reshape(-1), axis=0))
+
+
+@register_op("scatter")
+def scatter(ctx, ins, attrs):
+    x, ids, updates = first(ins, "X"), first(ins, "Ids"), first(ins, "Updates")
+    ids = ids.reshape(-1)
+    if attrs.get("overwrite", True):
+        o = x.at[ids].set(updates)
+    else:
+        o = x.at[ids].add(updates)
+    return out(Out=o)
+
+
+@register_op("pad")
+def pad(ctx, ins, attrs):
+    x = first(ins, "X")
+    paddings = attrs["paddings"]
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return out(Out=jnp.pad(x, cfg, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("reverse")
+def reverse(ctx, ins, attrs):
+    x = first(ins, "X")
+    o = x
+    for a in attrs["axis"]:
+        o = jnp.flip(o, axis=a)
+    return out(Out=o)
+
+
+@register_op("shape")
+def shape_op(ctx, ins, attrs):
+    x = first(ins, "Input")
+    return out(Out=jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+@register_op("one_hot")
+def one_hot(ctx, ins, attrs):
+    x = first(ins, "X")
+    depth = attrs["depth"]
+    o = jax.nn.one_hot(x.reshape(x.shape[:-1]) if x.shape[-1] == 1 else x,
+                       depth, dtype=jnp.float32)
+    return out(Out=o)
+
+
+@register_op("top_k")
+def top_k(ctx, ins, attrs):
+    x = first(ins, "X")
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("argsort")
+def argsort(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max")
+def arg_max(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min")
+def arg_min(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("cumsum")
+def cumsum(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    o = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        o = o - x
+    if attrs.get("reverse", False):
+        o = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get("exclusive", False):
+            o = o - x
+    return out(Out=o)
+
+
+@register_op("increment")
+def increment(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=x + jnp.asarray(attrs.get("step", 1.0), dtype=x.dtype))
+
+
+@register_op("range")
+def range_op(ctx, ins, attrs):
+    start = first(ins, "Start").reshape(())
+    end = first(ins, "End").reshape(())
+    step = first(ins, "Step").reshape(())
+    num = attrs.get("num")  # static length required under jit
+    if num is None:
+        raise ValueError("range op requires static 'num' attr under XLA")
+    o = start + step * jnp.arange(num, dtype=start.dtype)
+    return out(Out=o)
+
+
+@register_op("multiplex")
+def multiplex(ctx, ins, attrs):
+    ids = first(ins, "Ids").reshape(-1)
+    xs = jnp.stack(ins["X"], axis=0)
+    rows = jnp.arange(ids.shape[0])
+    return out(Out=xs[ids, rows])
+
+
+@register_op("where_op")
+def where_op(ctx, ins, attrs):
+    cond = first(ins, "Condition")
+    x, y = first(ins, "X"), first(ins, "Y")
+    return out(Out=jnp.where(cond, x, y))

@@ -1,0 +1,48 @@
+"""Shared helpers for op implementations."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def first(ins, slot):
+    return ins[slot][0]
+
+
+def opt_in(ins, slot):
+    vals = ins.get(slot)
+    return vals[0] if vals else None
+
+
+def out(**slots):
+    return {slot: [v] for slot, v in slots.items()}
+
+
+def broadcast_y(x, y, axis: int = -1):
+    """Fluid elementwise broadcast: align y's dims to x starting at `axis`
+    (reference: paddle/fluid/operators/elementwise/elementwise_op_function.h
+    — the trailing-alignment rule with explicit axis).  When y outranks x
+    (e.g. scalar-constant X from `1.0 / var`), fall back to numpy
+    broadcasting, which handles the shape-(1,) constant case."""
+    if x.ndim >= y.ndim:
+        if x.ndim == y.ndim:
+            return y
+        if axis == -1:
+            axis = x.ndim - y.ndim
+        new_shape = ([1] * axis + list(y.shape)
+                     + [1] * (x.ndim - axis - y.ndim))
+        return y.reshape(new_shape)
+    return y
+
+
+def pair(value, n=2):
+    """Normalize an int-or-list spatial attr to a tuple of length n."""
+    if isinstance(value, (list, tuple)):
+        if len(value) == 1:
+            return tuple(value) * n
+        return tuple(value)
+    return (value,) * n
+
+
+def to_jnp_dtype(name: str):
+    return jnp.dtype(name)

@@ -1,0 +1,52 @@
+"""Embedding / sparse ops.
+
+reference: paddle/fluid/operators/lookup_table_op.cc (+ SelectedRows grad
+path).  On TPU sparse grads become dense take-grads (XLA scatter-add);
+sharded tables live in parallel/embedding.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+
+@register_op("lookup_table")
+def lookup_table(ctx, ins, attrs):
+    ids, w = first(ins, "Ids"), first(ins, "W")
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
+    flat_ids = ids.reshape(ids.shape[:-1]) if squeeze_last else ids
+    o = jnp.take(w, flat_ids.astype(jnp.int32), axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (flat_ids != padding_idx)[..., None]
+        o = jnp.where(mask, o, 0.0)
+    return out(Out=o)
+
+
+@register_op("shard_index")
+def shard_index(ctx, ins, attrs):
+    x = first(ins, "X")
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = index_num // nshards
+    in_shard = (x // shard_size) == shard_id
+    return out(Out=jnp.where(in_shard, x % shard_size, ignore_value))
+
+
+@register_op("hash")
+def hash_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    mod_by = attrs.get("mod_by", 100000)
+    # Deterministic integer hash (xorshift-multiply), matching the intent
+    # of the reference hash_op (bloom-filter style id hashing).
+    v = x.astype(jnp.uint32)
+    v = v ^ (v >> 16)
+    v = v * jnp.uint32(0x45D9F3B)
+    v = v ^ (v >> 16)
+    return out(Out=(v % jnp.uint32(mod_by)).astype(jnp.int64))

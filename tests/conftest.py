@@ -1,0 +1,17 @@
+"""Test harness config: virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): op tests run against
+the CPU interpreter; multi-device tests use a virtual 8-device host mesh
+(xla_force_host_platform_device_count) standing in for an ICI slice.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,153 @@
+"""Executor end-to-end: startup init, train steps, state updates.
+
+Mirrors the reference's executor tests + book tests
+(python/paddle/fluid/tests/book/test_fit_a_line.py,
+test_recognize_digits.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _scope():
+    return fluid.Scope()
+
+
+def test_startup_initializes_params():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        w = [p for p in main.all_parameters() if p.shape == (4, 3)][0]
+        val = scope.find_var(w.name)
+        assert val is not None and val.shape == (4, 3)
+        # Xavier init: non-zero, bounded
+        arr = np.asarray(val)
+        assert np.abs(arr).max() <= np.sqrt(6.0 / 7) + 1e-6
+        assert np.abs(arr).max() > 0
+
+
+def test_forward_fetch():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[2], dtype="float32")
+        y = layers.scale(x, scale=3.0, bias=1.0)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, xv * 3 + 1, rtol=1e-6)
+
+
+def test_fit_a_line_converges():
+    """Linear regression must fit y = 2x + 3 (book test_fit_a_line)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[1], dtype="float32")
+        label = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(200):
+            xv = rng.rand(16, 1).astype(np.float32)
+            yv = 2 * xv + 3
+            (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < 0.05, f"final loss {losses[-1]}"
+        assert losses[-1] < losses[0] * 0.1
+
+
+def test_mnist_mlp_learns():
+    """Softmax classifier on separable synthetic data (book
+    test_recognize_digits MLP, shrunk)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    rng = np.random.RandomState(1)
+    n_cls = 4
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        img = layers.data(name="img", shape=[16], dtype="float32")
+        lbl = layers.data(name="label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=32, act="relu")
+        logits = layers.fc(h, size=n_cls)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, lbl))
+        acc = layers.accuracy(layers.softmax(logits), lbl, k=1)
+        fluid.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        centers = rng.randn(n_cls, 16).astype(np.float32) * 3
+        accs = []
+        for _ in range(120):
+            y = rng.randint(0, n_cls, size=(64, 1))
+            xv = centers[y[:, 0]] + rng.randn(64, 16).astype(np.float32)
+            lv, av = exe.run(main,
+                             feed={"img": xv, "label": y.astype(np.int64)},
+                             fetch_list=[loss, acc])
+            accs.append(float(av))
+        assert np.mean(accs[-10:]) > 0.9
+
+
+def test_adam_accumulators_update():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[2], dtype="float32")
+        y = layers.fc(x, size=1, bias_attr=False)
+        loss = layers.mean(y)
+        fluid.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.ones((4, 2), dtype=np.float32)
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w = main.all_parameters()[0]
+        b1p = scope.find_var(f"{w.name}.beta1_pow_acc")
+        assert b1p is not None
+        np.testing.assert_allclose(np.asarray(b1p), [0.9 ** 2], rtol=1e-5)
+
+
+def test_batch_norm_moving_stats_update():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        y = layers.batch_norm(x, momentum=0.5,
+                              moving_mean_name="bn_mean",
+                              moving_variance_name="bn_var")
+        loss = layers.mean(y)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(8, 3, 8, 8).astype(np.float32)
+        xv = xv * 2.0 + 5.0
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        mean_after = np.asarray(scope.find_var("bn_mean"))
+        # moving mean moved halfway (momentum=0.5) toward ~5
+        assert np.all(mean_after > 1.5), mean_after
+
+
+def test_rng_varies_across_steps():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = _scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        d = layers.dropout(x, dropout_prob=0.5)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.ones((2, 64), dtype=np.float32)
+        (a,) = exe.run(main, feed={"x": xv}, fetch_list=[d])
+        (b,) = exe.run(main, feed={"x": xv}, fetch_list=[d])
+        assert not np.allclose(a, b), "dropout mask must differ per step"

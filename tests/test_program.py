@@ -1,0 +1,85 @@
+"""Program/Block/Variable construction + serialization round-trip.
+
+Mirrors the reference's framework unit tests
+(python/paddle/fluid/tests/unittests/test_program.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def fresh_programs():
+    return fluid.Program(), fluid.Program()
+
+
+def test_build_simple_program():
+    main, startup = fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3, act="relu")
+    assert y.shape == (-1, 3)
+    op_types = [op.type for op in main.global_block().ops]
+    assert op_types == ["mul", "elementwise_add", "relu"]
+    params = main.all_parameters()
+    assert len(params) == 2
+    w = [p for p in params if p.shape == (4, 3)]
+    assert len(w) == 1
+    # startup has matching init ops
+    sop_types = [op.type for op in startup.global_block().ops]
+    assert len(sop_types) == 2
+
+
+def test_shape_inference_tracks_batch_dim():
+    main, startup = fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        c = layers.conv2d(x, num_filters=8, filter_size=3, padding=1)
+        p = layers.pool2d(c, pool_size=2, pool_stride=2)
+    assert c.shape == (-1, 8, 28, 28)
+    assert p.shape == (-1, 8, 14, 14)
+
+
+def test_program_serialization_roundtrip():
+    main, startup = fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3)
+        loss = layers.mean(y)
+    d = main.to_dict()
+    text = __import__("json").dumps(d)
+    restored = fluid.Program.from_dict(__import__("json").loads(text))
+    assert [o.type for o in restored.global_block().ops] == \
+        [o.type for o in main.global_block().ops]
+    assert {v.name for v in restored.list_vars()} == \
+        {v.name for v in main.list_vars()}
+    assert len(restored.all_parameters()) == len(main.all_parameters())
+
+
+def test_clone_for_test_strips_backward_and_dropout():
+    main, startup = fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        h = layers.dropout(h, dropout_prob=0.5)
+        loss = layers.mean(h)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    types = [o.type for o in test_prog.global_block().ops]
+    assert "backward_marker" not in types
+    assert "sgd" not in types
+    drop = [o for o in test_prog.global_block().ops if o.type == "dropout"]
+    assert drop and drop[0].attrs["is_test"] is True
+
+
+def test_variable_operator_overloads():
+    main, startup = fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = x * 2.0 + 1.0
+        z = y - x
+        w = z / 2.0
+    assert w.shape == (-1, 4)
