@@ -1,0 +1,126 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+reference: benchmark/fluid/fluid_benchmark.py (imgs/sec reporting with
+--use_fake_data).  Headline: ResNet-50 ImageNet training imgs/sec/chip
+(BASELINE.json metric).  vs_baseline compares against the reference's
+only published ResNet-50 training number (81.69 img/s, MKL-DNN Xeon 6148,
+benchmark/IntelOptimizedPaddle.md:40-45).
+
+Run on the real TPU chip: `python bench.py [--model resnet50|transformer]
+[--batch N] [--steps N]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _timed_loop(exe, program, feed_dev, loss, steps, warmup):
+    """Device-resident fake-data loop (reference --use_fake_data):
+    feeds are placed on device once; timed steps run fetch-free so the
+    chip chains steps without host round-trips (the tunnel in this
+    environment has high host<->device latency); one final fetch
+    synchronizes and validates the loss."""
+    for _ in range(warmup):
+        exe.run(program, feed=feed_dev, fetch_list=[loss])
+    # compile the K-iteration fused step, then time it: the host
+    # dispatches ONCE and the chip chains `steps` training steps
+    exe.run(program, feed=feed_dev, fetch_list=[loss], iterations=steps)
+    t0 = time.perf_counter()
+    (lv,) = exe.run(program, feed=feed_dev, fetch_list=[loss],
+                    iterations=steps)
+    elapsed = time.perf_counter() - t0
+    return elapsed, float(np.asarray(lv).reshape(-1)[0])
+
+
+def bench_resnet50(batch_size: int, steps: int, warmup: int):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = resnet.build_model(dataset="flowers", depth=50,
+                                   class_dim=1000, learning_rate=0.1)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {
+            "data": jax.device_put(
+                rng.rand(batch_size, 3, 224, 224).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, 1000, (batch_size, 1)),
+                                 dtype=jnp.int64),
+        }
+        elapsed, last_loss = _timed_loop(exe, main, feed, model["loss"],
+                                         steps, warmup)
+    imgs_per_sec = batch_size * steps / elapsed
+    return {
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / 81.69, 3),
+        "detail": {"batch_size": batch_size, "steps": steps,
+                   "last_loss": last_loss},
+    }
+
+
+def bench_transformer(batch_size: int, steps: int, warmup: int,
+                      max_length: int = 256):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = transformer.build_model(
+            src_vocab_size=32000, trg_vocab_size=32000,
+            max_length=max_length, n_layer=6, n_head=8, d_model=512,
+            d_inner_hid=2048, dropout=0.1)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {k: jnp.asarray(v) for k, v in
+                transformer.make_fake_batch(batch_size, max_length,
+                                            32000, 32000).items()}
+        elapsed, last_loss = _timed_loop(exe, main, feed, model["loss"],
+                                         steps, warmup)
+    tokens_per_sec = batch_size * max_length * steps / elapsed
+    return {
+        "metric": "transformer_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # no reference-published transformer number
+        "detail": {"batch_size": batch_size, "max_length": max_length,
+                   "steps": steps, "last_loss": last_loss},
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "transformer"])
+    p.add_argument("--batch", type=int, default=0)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    args = p.parse_args()
+
+    if args.model == "resnet50":
+        batch = args.batch or 128
+        result = bench_resnet50(batch, args.steps, args.warmup)
+    else:
+        batch = args.batch or 32
+        result = bench_transformer(batch, args.steps, args.warmup)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,17 @@ from .core.program import (Block, Operator, Parameter, Program,  # noqa: F401
                            default_startup_program, name_scope,
                            program_guard)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import nets  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel.compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                                ExecutionStrategy)
+from .parallel.parallel_executor import ParallelExecutor  # noqa: F401
+from . import io  # noqa: F401
+from . import data  # noqa: F401
+from . import debugger  # noqa: F401
+from . import profiler  # noqa: F401
+from .data.data_feeder import DataFeeder  # noqa: F401
+from .flags import FLAGS  # noqa: F401
 
 
 class CPUPlace:

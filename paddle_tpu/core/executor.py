@@ -200,6 +200,53 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     return env
 
 
+def _debug_checks(fetch_names, fetches, new_state):
+    """FLAGS.check_nan_inf: the reference's post-op NaN scan
+    (operator.cc:943 under FLAGS_check_nan_inf), applied per run to
+    fetches and updated state; FLAGS.benchmark forces a blocking sync
+    (operator.cc:940)."""
+    from ..flags import FLAGS
+
+    if FLAGS.check_nan_inf:
+        for n, f in zip(fetch_names, fetches):
+            arr = np.asarray(f)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    f"NaN/Inf detected in fetched var {n!r}")
+        for n, v in new_state.items():
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    f"NaN/Inf detected in persistable var {n!r}")
+    elif FLAGS.benchmark:
+        for f in fetches:
+            getattr(f, "block_until_ready", lambda: None)()
+
+
+def chain_iterations(base_step, iterations: int):
+    """Iteration batching: chain K executions of the program over the
+    SAME feeds in one compiled call, amortizing host dispatch.  Note the
+    feeds are frozen for all K iterations — this accelerates fixed-input
+    loops (synthetic-data benchmarks, lr-search sweeps, steady-state
+    profiling), NOT epoch training; feeding fresh batches still requires
+    one run() per batch (device-side input pipelines come with the data
+    plane).  Valid because state shapes are step-invariant."""
+    if iterations <= 1:
+        return base_step
+    import jax
+
+    def step(state, feeds):
+        st, fetches = base_step(state, feeds)
+
+        def body(_, carry):
+            st, _f = carry
+            return base_step(st, feeds)
+
+        return jax.lax.fori_loop(1, iterations, body, (st, fetches))
+
+    return step
+
+
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
@@ -222,7 +269,8 @@ class Executor:
             fetch_list: Optional[Sequence[Any]] = None,
             scope: Optional[Scope] = None,
             return_numpy: bool = True,
-            use_program_cache: bool = True):
+            use_program_cache: bool = True,
+            iterations: int = 1):
         from .program import default_main_program
 
         import jax
@@ -236,10 +284,17 @@ class Executor:
             for f in (fetch_list or [])
         ]
 
+        # `program` may be a CompiledProgram (passed directly, fluid style)
+        # or a Program that was wrapped by CompiledProgram.
+        if hasattr(program, "_program") and hasattr(program, "run"):
+            return program.run(self, feed, fetch_names, scope,
+                               return_numpy=return_numpy,
+                               iterations=iterations)
         compiled = getattr(program, "_compiled_wrapper", None)
         if compiled is not None:
             return compiled.run(self, feed, fetch_names, scope,
-                                return_numpy=return_numpy)
+                                return_numpy=return_numpy,
+                                iterations=iterations)
 
         block = program.global_block()
 
@@ -253,11 +308,12 @@ class Executor:
             if v.persistable and scope.has_var(v.name)
         ))
         key = (id(program), program._version, tuple(sorted(feed)),
-               tuple(fetch_names), state_names)
+               tuple(fetch_names), state_names, iterations)
         fn = self._cache.get(key) if use_program_cache else None
         if fn is None:
             fn = self._build_step_fn(program, tuple(sorted(feed)),
-                                     tuple(fetch_names), state_names)
+                                     tuple(fetch_names), state_names,
+                                     iterations)
             if use_program_cache:
                 self._cache[key] = fn
 
@@ -270,6 +326,7 @@ class Executor:
         new_state, fetches = fn(state, feed_arrays)
         for name, val in new_state.items():
             scope.set_var(name, val)
+        _debug_checks(fetch_names, fetches, new_state)
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
@@ -279,7 +336,7 @@ class Executor:
 
     # -- compilation -----------------------------------------------------
     def _build_step_fn(self, program: Program, feed_names, fetch_names,
-                       state_names):
+                       state_names, iterations: int = 1):
         import jax
 
         persistable_names = tuple(sorted(
@@ -302,7 +359,8 @@ class Executor:
             fetches = [env[n] for n in fetch_names]
             return new_state, fetches
 
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(chain_iterations(step, iterations),
+                       donate_argnums=(0,))
 
 
 def _to_array(value, block):

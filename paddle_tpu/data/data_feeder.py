@@ -1,0 +1,69 @@
+"""DataFeeder: python samples → feed dict of dense arrays.
+
+reference: python/paddle/fluid/data_feeder.py — converts lists of sample
+tuples to LoDTensors with lod construction.  Here ragged (lod_level=1)
+slots are padded to the longest sequence in the batch (bucketed up to
+`pad_to_multiple` to bound XLA retraces) and a `<name>.seq_len` int32
+array carries the true lengths (SURVEY.md §5.7 segment-based design).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..core.program import Program, Variable
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None,
+                 pad_to_multiple: int = 8):
+        self.feed_vars: List[Variable] = []
+        for v in feed_list:
+            if isinstance(v, str):
+                from ..core.program import default_main_program
+
+                prog = program or default_main_program()
+                v = prog.global_block().var(v)
+            self.feed_vars.append(v)
+        self.pad_to_multiple = pad_to_multiple
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: list of sample tuples aligned with feed_list."""
+        rows = list(iterable)
+        if not rows:
+            raise ValueError("empty batch")
+        out: Dict[str, np.ndarray] = {}
+        for i, var in enumerate(self.feed_vars):
+            column = [row[i] for row in rows]
+            if var.lod_level > 0:
+                padded, lens = self._pad(column, var)
+                out[var.name] = padded
+                out[f"{var.name}.seq_len"] = lens
+            else:
+                dtype = np.dtype(var.dtype)
+                out[var.name] = np.asarray(column, dtype=dtype)
+                want = var.shape
+                got = out[var.name].shape
+                if len(want) == len(got) + 1 and want[-1] == 1:
+                    out[var.name] = out[var.name][..., None]
+        return out
+
+    def _pad(self, column, var):
+        dtype = np.dtype(var.dtype)
+        seqs = [np.asarray(s, dtype=dtype) for s in column]
+        lens = np.asarray([len(s) for s in seqs], np.int32)
+        max_len = int(lens.max())
+        m = self.pad_to_multiple
+        max_len = ((max_len + m - 1) // m) * m
+        # fixed max length from the var shape wins (static-shape mode)
+        if len(var.shape) >= 2 and var.shape[1] not in (-1, 0):
+            max_len = var.shape[1]
+        tail = seqs[0].shape[1:]
+        padded = np.zeros((len(seqs), max_len) + tail, dtype=dtype)
+        for i, s in enumerate(seqs):
+            n = min(len(s), max_len)
+            padded[i, :n] = s[:n]
+        lens = np.minimum(lens, max_len)
+        return padded, lens

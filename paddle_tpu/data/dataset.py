@@ -1,0 +1,137 @@
+"""Built-in datasets.
+
+reference: python/paddle/dataset/ — mnist, cifar, uci_housing, imdb,
+imikolov, movielens, wmt14/16 auto-download readers.  This environment is
+zero-egress, so each dataset is a deterministic synthetic generator with
+the REAL dataset's shapes, dtypes, and label spaces (documented
+divergence); plug a download-backed reader in by replacing the generator
+while keeping the reader contract (zero-arg callable yielding samples).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _synthetic_classification(n, feature_shape, num_classes, seed,
+                              flatten=False):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(num_classes, *feature_shape).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            y = int(r.randint(num_classes))
+            x = centers[y] + 0.5 * r.randn(*feature_shape).astype(np.float32)
+            if flatten:
+                x = x.reshape(-1)
+            yield x, y
+
+    return reader
+
+
+class mnist:
+    """28x28 grayscale digits, labels 0-9 (dataset/mnist.py shapes)."""
+
+    @staticmethod
+    def train(n=60000, seed=0):
+        return _synthetic_classification(n, (1, 28, 28), 10, seed)
+
+    @staticmethod
+    def test(n=10000, seed=7):
+        return _synthetic_classification(n, (1, 28, 28), 10, seed)
+
+
+class cifar:
+    @staticmethod
+    def train10(n=50000, seed=1):
+        return _synthetic_classification(n, (3, 32, 32), 10, seed)
+
+    @staticmethod
+    def test10(n=10000, seed=8):
+        return _synthetic_classification(n, (3, 32, 32), 10, seed)
+
+    @staticmethod
+    def train100(n=50000, seed=2):
+        return _synthetic_classification(n, (3, 32, 32), 100, seed)
+
+
+class flowers:
+    @staticmethod
+    def train(n=6149, seed=3):
+        return _synthetic_classification(n, (3, 224, 224), 102, seed)
+
+    @staticmethod
+    def test(n=1020, seed=9):
+        return _synthetic_classification(n, (3, 224, 224), 102, seed)
+
+
+class uci_housing:
+    """13 features → scalar price (dataset/uci_housing.py)."""
+
+    @staticmethod
+    def train(n=404, seed=4):
+        rng = np.random.RandomState(seed)
+        w = rng.randn(13).astype(np.float32)
+
+        def reader():
+            r = np.random.RandomState(seed + 1)
+            for _ in range(n):
+                x = r.randn(13).astype(np.float32)
+                y = float(x @ w + 0.1 * r.randn())
+                yield x, np.asarray([y], np.float32)
+
+        return reader
+
+    test = train
+
+
+class imdb:
+    """Variable-length token sequences, binary sentiment
+    (dataset/imdb.py)."""
+
+    word_dict_size = 5147
+
+    @staticmethod
+    def word_dict():
+        return {i: i for i in range(imdb.word_dict_size)}
+
+    @staticmethod
+    def train(word_dict=None, n=25000, seed=5, max_len=200):
+        vocab = imdb.word_dict_size
+
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                length = int(r.randint(10, max_len))
+                label = int(r.randint(2))
+                # class-dependent token bias so models can actually learn
+                lo = 0 if label == 0 else vocab // 2
+                tokens = r.randint(lo, lo + vocab // 2,
+                                   size=(length,)).astype(np.int64)
+                yield tokens, label
+
+        return reader
+
+    @staticmethod
+    def test(word_dict=None, n=25000, seed=11, max_len=200):
+        return imdb.train(word_dict, n, seed, max_len)
+
+
+class imikolov:
+    """N-gram LM windows (dataset/imikolov.py)."""
+
+    @staticmethod
+    def build_dict(min_word_freq=50):
+        return {i: i for i in range(2073)}
+
+    @staticmethod
+    def train(word_dict=None, n=5, seed=6, samples=100000):
+        vocab = len(word_dict) if word_dict else 2073
+
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(samples):
+                yield tuple(int(x) for x in r.randint(0, vocab, size=(n,)))
+
+        return reader

@@ -1,0 +1,190 @@
+"""Reader decorators.
+
+reference: python/paddle/reader/decorator.py:58-338 — a reader is a
+zero-arg callable returning an iterable of samples; decorators compose
+readers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable, Iterable, List
+
+
+def map_readers(func, *readers):
+    """Apply func elementwise across readers (decorator.py map_readers)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Pool-shuffle with a bounded buffer (decorator.py shuffle)."""
+
+    def reader_():
+        buf: List = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into tuple samples (decorator.py compose)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        iters = itertools.zip_longest(*rs)
+        for outputs in iters:
+            if check_alignment and any(o is None for o in outputs):
+                raise RuntimeError("readers have different lengths")
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size: int):
+    """Background-thread prefetch buffer (decorator.py buffered) — the
+    host-side analog of the reference's double-buffer reader op."""
+
+    end = object()
+
+    def reader_():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+                q.put(end)
+            except BaseException as e:  # propagate to the consumer
+                q.put(_ReaderError(e))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is end:
+                break
+            if isinstance(sample, _ReaderError):
+                raise sample.error
+            yield sample
+
+    return reader_
+
+
+class _ReaderError:
+    """Exception carrier across reader threads."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def firstn(reader, n: int):
+    def reader_():
+        yield from itertools.islice(reader(), n)
+
+    return reader_
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group samples into lists (paddle.batch)."""
+
+    def reader_():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over a thread pool (decorator.py xmap_readers)."""
+
+    end = object()
+
+    def reader_():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+                for _ in range(process_num):
+                    in_q.put(end)
+            except BaseException as e:
+                out_q.put(_ReaderError(e))
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:
+                    out_q.put(_ReaderError(e))
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if isinstance(item, _ReaderError):
+                raise item.error
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return reader_

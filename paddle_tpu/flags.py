@@ -1,0 +1,108 @@
+"""Typed runtime flags with env-var bridge.
+
+reference: the gflags system (SURVEY.md §5.6) — ~60 DEFINE_* flags read
+from env via python __bootstrap__ (python/paddle/fluid/__init__.py:
+125-147).  One typed registry replaces point-of-use globals; env vars
+`FLAGS_<name>` override defaults at import, matching the reference's
+exposure convention.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    help: str
+    type: type
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._defs: Dict[str, _FlagDef] = {}
+        self._values: Dict[str, Any] = {}
+
+    def define(self, name: str, default, help_: str = ""):
+        t = type(default)
+        self._defs[name] = _FlagDef(name, default, help_, t)
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            if t is bool:
+                self._values[name] = env.lower() in ("1", "true", "yes")
+            else:
+                self._values[name] = t(env)
+        else:
+            self._values[name] = default
+
+    def __getattr__(self, name: str):
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(f"unknown flag {name!r}")
+
+    def __setattr__(self, name: str, value):
+        if name in ("_defs", "_values"):
+            object.__setattr__(self, name, value)
+            return
+        if name not in self._defs:
+            raise AttributeError(f"unknown flag {name!r}")
+        self._values[name] = self._defs[name].type(value)
+        if name == "fraction_of_tpu_memory_to_use":
+            os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(
+                self._values[name])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+FLAGS = FlagRegistry()
+
+# Correctness / debugging (reference: operator.cc:943 FLAGS_check_nan_inf,
+# §5.2 determinism flags — XLA is deterministic by default on TPU).
+FLAGS.define("check_nan_inf", False,
+             "scan every fetch for NaN/Inf after each step")
+FLAGS.define("benchmark", False,
+             "block after every run for accurate timing "
+             "(reference operator.cc:940)")
+FLAGS.define("cpu_deterministic", True, "kept for parity; XLA/TPU is "
+             "deterministic by default")
+# Memory (reference: FLAGS_fraction_of_gpu_memory_to_use & allocator
+# strategy — XLA owns HBM; preallocation toggles via env)
+FLAGS.define("fraction_of_tpu_memory_to_use", 0.9,
+             "exported as XLA_PYTHON_CLIENT_MEM_FRACTION; takes effect "
+             "only when set before the first device use")
+
+
+def _export_mem_fraction():
+    # reference: FLAGS_fraction_of_gpu_memory_to_use sizes the buddy
+    # allocator chunk (memory/allocation/legacy_allocator.cc); on TPU the
+    # XLA client owns HBM preallocation, configured via this env var.
+    # Exported only when the user explicitly set the flag, so the XLA
+    # default stays in effect otherwise.
+    os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(
+        FLAGS.fraction_of_tpu_memory_to_use)
+
+
+if "FLAGS_fraction_of_tpu_memory_to_use" in os.environ:
+    _export_mem_fraction()
+# Executor behavior
+FLAGS.define("use_mkldnn", False, "parity no-op (MKLDNN is x86-only)")
+FLAGS.define("reader_queue_speed_test_mode", False,
+             "non-destructive reader queue for throughput tests")
+FLAGS.define("eager_delete_tensor_gb", 0.0,
+             "parity no-op; XLA buffer liveness handles eager deletion")
+
+
+def init_from_env():
+    """Re-read FLAGS_* env vars (the reference's __bootstrap__ pass)."""
+    for name, d in FLAGS._defs.items():
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            setattr(FLAGS, name,
+                    env.lower() in ("1", "true", "yes")
+                    if d.type is bool else d.type(env))

@@ -1,0 +1,170 @@
+"""Model/checkpoint IO.
+
+reference: python/paddle/fluid/io.py — save_vars:89, save_params:222,
+save_persistables:270, load_vars:313, load_params, load_persistables,
+save_inference_model:570, load_inference_model:704.  The reference
+implements save/load as `save`/`load_combine` *ops* appended to throwaway
+programs; here persistence is host-side (numpy container + JSON manifest
+with program-format versioning) since checkpoint IO is not a TPU
+computation.  Sharded arrays gather transparently via np.asarray; a
+tensorstore/orbax-style sharded writer can slot in behind the same API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.desc import (PROGRAM_FORMAT_VERSION, dump_program_dict,
+                        load_program_dict)
+from .core.executor import Executor, Scope, global_scope
+from .core.program import Parameter, Program, Variable
+
+MODEL_FILENAME = "__model__"
+MANIFEST = "__manifest__.json"
+
+
+def _is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def _collect(program: Program, predicate) -> List[Variable]:
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def save_vars(executor: Executor, dirname: str,
+              main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None,
+              predicate=None, filename: Optional[str] = None):
+    """Persist variables from the scope (reference io.py:89)."""
+    from .core.program import default_main_program
+
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _collect(program, predicate or (lambda v: v.persistable))
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    names = []
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(f"variable {v.name!r} has no value in scope")
+        arrays[v.name] = np.asarray(val)
+        names.append(v.name)
+    fname = filename or "params.npz"
+    np.savez(os.path.join(dirname, fname), **arrays)
+    manifest = {
+        "version": PROGRAM_FORMAT_VERSION,
+        "file": fname,
+        "vars": names,
+    }
+    with open(os.path.join(dirname, MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_vars(executor: Executor, dirname: str,
+              main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None,
+              predicate=None, filename: Optional[str] = None):
+    from .core.program import default_main_program
+
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _collect(program, predicate or (lambda v: v.persistable))
+    with open(os.path.join(dirname, MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("version", 0) > PROGRAM_FORMAT_VERSION:
+        raise RuntimeError("checkpoint written by a newer format version")
+    data = np.load(os.path.join(dirname, filename or manifest["file"]))
+    scope = global_scope()
+    import jax.numpy as jnp
+
+    for v in vars:
+        if v.name not in data:
+            raise RuntimeError(f"checkpoint missing variable {v.name!r}")
+        arr = data[v.name]
+        if tuple(arr.shape) != tuple(v.shape) and -1 not in v.shape:
+            raise RuntimeError(
+                f"shape mismatch for {v.name!r}: checkpoint "
+                f"{arr.shape} vs program {v.shape}")
+        scope.set_var(v.name, jnp.asarray(arr))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# Inference export
+# ---------------------------------------------------------------------------
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable],
+                         executor: Executor,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    """Prune to the inference subgraph and export (reference io.py:570):
+    writes `__model__` (serialized program) + params."""
+    from .core.executor import prune_ops
+    from .core.program import default_main_program
+
+    program = (main_program or default_main_program()).clone(for_test=True)
+    fetch_names = [t.name for t in target_vars]
+
+    # prune ops to fetch ancestors, then drop unused vars
+    program._backward_info = None
+    kept_ops = prune_ops(program, fetch_names)
+    block = program.global_block()
+    block.ops = list(kept_ops)
+    used = set(fetch_names) | set(feeded_var_names)
+    for op in block.ops:
+        used.update(op.desc.input_names())
+        used.update(op.desc.output_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME),
+              "w") as f:
+        d = program.to_dict()
+        d["feed_var_names"] = list(feeded_var_names)
+        d["fetch_var_names"] = fetch_names
+        f.write(dump_program_dict(d))
+    params = [v for v in program.list_vars() if v.persistable]
+    save_vars(executor, dirname, program, vars=params,
+              filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    """reference io.py:704 — returns (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
+        d = load_program_dict(f.read())
+    program = Program.from_dict(d)
+    load_vars(executor, dirname, program,
+              predicate=lambda v: v.persistable, filename=params_filename)
+    fetch_vars = [program.global_block().var(n)
+                  for n in d.get("fetch_var_names", [])]
+    return program, d.get("feed_var_names", []), fetch_vars

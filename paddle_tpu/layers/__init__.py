@@ -6,7 +6,21 @@ schedulers.
 """
 
 from .io import data  # noqa: F401
+from .learning_rate_scheduler import (cosine_decay,  # noqa: F401
+                                      exponential_decay, inverse_time_decay,
+                                      linear_lr_warmup, natural_exp_decay,
+                                      noam_decay, piecewise_decay,
+                                      polynomial_decay)
 from .metric_op import accuracy, auc  # noqa: F401
+from .sequence import (add_position_encoding, dynamic_gru,  # noqa: F401
+                       dynamic_lstm, gru_unit, im2sequence, lstm_unit,
+                       row_conv, seq_len_var, sequence_concat,
+                       sequence_conv, sequence_enumerate, sequence_erase,
+                       sequence_expand, sequence_expand_as,
+                       sequence_first_step, sequence_last_step,
+                       sequence_mask, sequence_pad, sequence_pool,
+                       sequence_reverse, sequence_slice, sequence_softmax,
+                       sequence_unpad)
 from .nn import *  # noqa: F401,F403
 from .nn import elementwise_op  # noqa: F401
 from .ops import *  # noqa: F401,F403

@@ -817,6 +817,24 @@ def grid_sampler(x, grid, name=None):
     return out
 
 
+def flash_attention(q, k, v, bias=None, scale=None, causal=False,
+                    use_pallas=False, name=None):
+    """Fused multi-head attention over (N, H, T, D) tensors (see
+    ops/attention.py).  The TPU-native replacement for composing
+    matmul+softmax+matmul by hand."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    ins = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    attrs = {"causal": causal, "use_pallas": use_pallas}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type="flash_attention", inputs=ins,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
 def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
                                    max=1.0, input_dim_idx=0,
                                    output_dim_idx=0, seed=0):

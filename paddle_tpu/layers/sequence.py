@@ -1,0 +1,346 @@
+"""Sequence + RNN layer functions.
+
+reference: python/paddle/fluid/layers/nn.py (dynamic_lstm, dynamic_gru,
+sequence_* family).  Ragged inputs are padded (N, T, ...) vars with a
+companion `<name>.seq_len` var (created by layers.data(lod_level=1) and
+fed by DataFeeder); these wrappers wire the companion through ops and
+propagate it to outputs that stay sequences.
+"""
+
+from __future__ import annotations
+
+from ..core.program import Variable, default_main_program
+from ..initializer import Constant, Xavier
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def seq_len_var(x: Variable):
+    """The companion length var of a sequence variable, if any."""
+    block = default_main_program().global_block()
+    name = f"{x.name}.seq_len"
+    return block.var(name) if block.has_var(name) else None
+
+
+def _propagate_seq_len(src: Variable, dst: Variable):
+    sl = seq_len_var(src)
+    if sl is None:
+        return
+    block = default_main_program().global_block()
+    new = block.create_var(name=f"{dst.name}.seq_len", shape=sl.shape,
+                           dtype=sl.dtype, stop_gradient=True)
+    block.append_op(type="assign", inputs={"X": [sl]},
+                    outputs={"Out": [new]})
+
+
+def _seq_inputs(x: Variable, slot="X"):
+    ins = {slot: [x]}
+    sl = seq_len_var(x)
+    if sl is not None:
+        ins["SeqLen"] = [sl]
+    return ins
+
+
+# ---------------------------------------------------------------------------
+# RNNs
+# ---------------------------------------------------------------------------
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """reference layers/nn.py dynamic_lstm — input must be (N, T, 4*hidden)
+    (the x-projection fc is applied by the caller, as in fluid); size is
+    4*hidden."""
+    helper = LayerHelper("lstm", name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, shape=[hidden, 4 * hidden],
+                                dtype=dtype)
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    b = helper.create_parameter(ParamAttr._to_attr(bias_attr) or ParamAttr(),
+                                shape=[1, bias_size], dtype=dtype,
+                                is_bias=True)
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    cell_out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    ins = _seq_inputs(input, "Input")
+    ins.update({"Weight": [w], "Bias": [b]})
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    helper.append_op(
+        type="dynamic_lstm", inputs=ins,
+        outputs={"Hidden": [hidden_out], "Cell": [cell_out],
+                 "LastH": [last_h], "LastC": [last_c]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    _propagate_seq_len(input, hidden_out)
+    _propagate_seq_len(input, cell_out)
+    return hidden_out, cell_out
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32",
+                name=None):
+    """reference layers/nn.py dynamic_gru — input (N, T, 3*size)."""
+    helper = LayerHelper("gru", name=name)
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                dtype=dtype)
+    b = helper.create_parameter(ParamAttr._to_attr(bias_attr) or ParamAttr(),
+                                shape=[1, 3 * size], dtype=dtype,
+                                is_bias=True)
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    ins = _seq_inputs(input, "Input")
+    ins.update({"Weight": [w], "Bias": [b]})
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    helper.append_op(
+        type="dynamic_gru", inputs=ins,
+        outputs={"Hidden": [hidden_out], "LastH": [last_h]},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "activation": candidate_activation})
+    _propagate_seq_len(input, hidden_out)
+    return hidden_out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference layers/nn.py lstm_unit): fc([x, h]) →
+    lstm_unit op."""
+    from . import nn as nn_layers
+    from .tensor import concat as concat_layer
+
+    helper = LayerHelper("lstm_unit", name=name)
+    size = cell_t_prev.shape[-1]
+    # fluid computes the gate projection with one fc over [x, h]
+    xh = concat_layer([x_t, hidden_t_prev], axis=1)
+    gates = nn_layers.fc(xh, size=4 * size, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit")
+    hidden_dim = size // 3
+    w = helper.create_parameter(param_attr, shape=[hidden_dim, 3 * hidden_dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(ParamAttr._to_attr(bias_attr) or ParamAttr(),
+                                shape=[1, 3 * hidden_dim], dtype=input.dtype,
+                                is_bias=True)
+    out_h = helper.create_variable_for_type_inference(input.dtype)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset_h = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden], "Weight": [w],
+                "Bias": [b]},
+        outputs={"Hidden": [out_h], "Gate": [gate],
+                 "ResetHiddenPrev": [reset_h]},
+        attrs={"activation": activation,
+               "gate_activation": gate_activation})
+    return out_h, reset_h, gate
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", act=act)
+    f = helper.create_parameter(
+        param_attr, shape=[future_context_size, input.shape[-1]],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [f]},
+                     outputs={"Out": [out]})
+    _propagate_seq_len(input, out)
+    return helper.append_activation(out)
+
+
+# ---------------------------------------------------------------------------
+# sequence_* family
+# ---------------------------------------------------------------------------
+
+def sequence_pool(input, pool_type, is_test=False):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    max_index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="sequence_pool",
+                     inputs=_seq_inputs(input),
+                     outputs={"Out": [out], "MaxIndex": [max_index]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_softmax",
+                     inputs=_seq_inputs(input),
+                     outputs={"Out": [out]})
+    _propagate_seq_len(input, out)
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    _propagate_seq_len(y, out)
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand_as",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    _propagate_seq_len(y, out)
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": input},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen if maxlen else -1,
+                            "out_dtype": dtype})
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_reverse", inputs=_seq_inputs(x),
+                     outputs={"Y": [out]})
+    _propagate_seq_len(x, out)
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64")
+    ins = _seq_inputs(x)
+    ins["PadValue"] = [pad_value]
+    helper.append_op(type="sequence_pad", inputs=ins,
+                     outputs={"Out": [out], "Length": [length]},
+                     attrs={"padded_length": maxlen if maxlen else -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_enumerate", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    _propagate_seq_len(input, out)
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_erase", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"tokens": list(tokens)})
+    _propagate_seq_len(input, out)
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", name=name, act=act,
+                         bias_attr=bias_attr)
+    d = input.shape[-1]
+    f = helper.create_parameter(param_attr,
+                                shape=[filter_size * d, num_filters],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = _seq_inputs(input)
+    ins["Filter"] = [f]
+    helper.append_op(type="sequence_conv", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": -(filter_size // 2),
+                            "contextStride": filter_stride})
+    _propagate_seq_len(input, out)
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def _pp(v, n):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": _pp(filter_size, 2),
+                            "strides": _pp(stride, 2),
+                            "paddings": _pp(padding, 4)})
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="add_position_encoding", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": alpha, "beta": beta})
+    _propagate_seq_len(input, out)
+    return out

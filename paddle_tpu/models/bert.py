@@ -1,0 +1,130 @@
+"""BERT-base pretraining (MLM + NSP).
+
+reference: BASELINE.json configs ("BERT-base pretraining — gelu,
+layer_norm, embedding").  Encoder-only transformer with learned position
+embeddings, masked-LM head tied style, next-sentence head.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers, optimizer
+from ..initializer import Constant, Normal, TruncatedNormal
+from ..param_attr import ParamAttr
+from .transformer import encoder_layer, pre_post_process
+
+
+def bert_encoder(src_ids, sent_ids, input_mask_bias, vocab_size, max_len,
+                 n_layer=12, n_head=12, d_model=768, d_inner=3072,
+                 dropout=0.1, use_flash=False):
+    init = TruncatedNormal(0.0, 0.02)
+    word_emb = layers.embedding(
+        src_ids, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name="word_embedding", initializer=init))
+    # learned position embedding: ids 0..T-1 per row
+    # (1, T, D) position embedding broadcasts over the batch in the add
+    pos_ids = layers.reshape(layers.range(0, max_len, 1, "int64"),
+                             shape=[1, max_len])
+    pos_emb = layers.embedding(
+        pos_ids, size=[max_len, d_model],
+        param_attr=ParamAttr(name="pos_embedding", initializer=init))
+    sent_emb = layers.embedding(
+        sent_ids, size=[2, d_model],
+        param_attr=ParamAttr(name="sent_embedding", initializer=init))
+    emb = layers.elementwise_add(
+        layers.elementwise_add(word_emb, sent_emb), pos_emb)
+    emb = layers.layer_norm(emb, begin_norm_axis=2)
+    if dropout:
+        emb = layers.dropout(emb, dropout_prob=dropout,
+                             dropout_implementation="upscale_in_train")
+    x = emb
+    for _ in range(n_layer):
+        x = encoder_layer(x, input_mask_bias, n_head, d_model // n_head,
+                          d_model // n_head, d_model, d_inner, dropout,
+                          use_flash=use_flash)
+    return pre_post_process(None, x, "n")
+
+
+def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
+                d_model=768, d_inner=3072, max_predictions=20,
+                learning_rate=1e-4, warmup_steps=10000, dropout=0.1,
+                with_optimizer=True, use_flash=False):
+    src_ids = layers.data(name="src_ids", shape=[max_len], dtype="int64")
+    sent_ids = layers.data(name="sent_ids", shape=[max_len], dtype="int64")
+    seq_len = layers.data(name="seq_len", shape=[], dtype="int32")
+    mask_pos = layers.data(name="mask_pos", shape=[max_predictions],
+                           dtype="int64")
+    mask_label = layers.data(name="mask_label", shape=[max_predictions],
+                             dtype="int64")
+    mask_weight = layers.data(name="mask_weight", shape=[max_predictions],
+                              dtype="float32")
+    nsp_label = layers.data(name="nsp_label", shape=[1], dtype="int64")
+
+    m = layers.sequence_mask(seq_len, maxlen=max_len, dtype="float32")
+    bias = layers.scale(m, scale=1e9, bias=-1e9)
+    bias = layers.unsqueeze(layers.unsqueeze(bias, axes=[1]), axes=[1])
+
+    enc = bert_encoder(src_ids, sent_ids, bias, vocab_size, max_len,
+                       n_layer, n_head, d_model, d_inner, dropout,
+                       use_flash=use_flash)
+
+    # --- masked LM head: gather masked positions per row
+    gathered = _gather_rows(enc, mask_pos)
+    mlm = layers.fc(gathered, size=d_model, act="gelu", num_flatten_dims=2)
+    mlm = layers.layer_norm(mlm, begin_norm_axis=2)
+    mlm_logits = layers.fc(mlm, size=vocab_size, num_flatten_dims=2)
+    mlm_loss = layers.softmax_with_cross_entropy(
+        mlm_logits, layers.unsqueeze(mask_label, axes=[2]))
+    mlm_loss = layers.elementwise_mul(
+        layers.squeeze(mlm_loss, axes=[2]), mask_weight)
+    denom = layers.elementwise_max(
+        layers.reduce_sum(mask_weight),
+        layers.fill_constant([1], "float32", 1.0))
+    mlm_loss = layers.elementwise_div(layers.reduce_sum(mlm_loss), denom)
+
+    # --- NSP head on [CLS] (position 0)
+    cls = layers.slice(enc, axes=[1], starts=[0], ends=[1])
+    cls = layers.squeeze(cls, axes=[1])
+    pooled = layers.fc(cls, size=d_model, act="tanh")
+    nsp_logits = layers.fc(pooled, size=2)
+    nsp_loss = layers.mean(
+        layers.softmax_with_cross_entropy(nsp_logits, nsp_label))
+
+    loss = layers.elementwise_add(mlm_loss, nsp_loss)
+    if with_optimizer:
+        lr = layers.linear_lr_warmup(
+            layers.polynomial_decay(learning_rate, 1000000, 0.0, 1.0),
+            warmup_steps, 0.0, learning_rate)
+        opt = optimizer.AdamOptimizer(learning_rate=lr)
+        opt.minimize(loss)
+    feeds = ["src_ids", "sent_ids", "seq_len", "mask_pos", "mask_label",
+             "mask_weight", "nsp_label"]
+    return {"loss": loss, "mlm_loss": mlm_loss, "nsp_loss": nsp_loss,
+            "feeds": feeds}
+
+
+def _gather_rows(enc, pos):
+    """Per-row gather of masked positions: enc (N,T,D), pos (N,P) →
+    (N,P,D) via one_hot matmul (XLA-friendly, no dynamic indexing)."""
+    t = enc.shape[1]
+    oh = layers.one_hot(pos, depth=t)           # (N, P, T)
+    return layers.matmul(oh, enc)               # (N, P, D)
+
+
+def make_fake_batch(batch_size, max_len=128, vocab_size=30522,
+                    max_predictions=20, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "src_ids": rng.randint(0, vocab_size,
+                               (batch_size, max_len)).astype(np.int64),
+        "sent_ids": rng.randint(0, 2,
+                                (batch_size, max_len)).astype(np.int64),
+        "seq_len": np.full((batch_size,), max_len, np.int32),
+        "mask_pos": rng.randint(0, max_len,
+                                (batch_size, max_predictions)).astype(np.int64),
+        "mask_label": rng.randint(0, vocab_size,
+                                  (batch_size, max_predictions)).astype(np.int64),
+        "mask_weight": np.ones((batch_size, max_predictions), np.float32),
+        "nsp_label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64),
+    }

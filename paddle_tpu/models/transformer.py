@@ -1,0 +1,235 @@
+"""Transformer NMT (encoder-decoder), the flagship benchmark model.
+
+reference: benchmark/fluid's Transformer config (machine translation) and
+the fluid Transformer implementation pattern (pre/post-process wrappers
+around multi-head attention + FFN).  Attention is composed from
+matmul/softmax layers — XLA fuses the chain onto the MXU; masks are
+additive biases built in-graph from sequence lengths (segment-style
+replacement for LoD, SURVEY.md §5.7).  A Pallas flash-attention kernel
+(ops/pallas/flash_attention.py) can replace the composed attention via
+use_flash=True.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers, optimizer
+from ..param_attr import ParamAttr
+from ..initializer import Normal
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head, dropout_rate=0.0,
+                         use_flash=False):
+    if keys is None:  # self-attention
+        keys, values = queries, queries
+    q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+    k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+    v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+
+    def split_heads(x, d):
+        # (N, T, H*d) -> (N, H, T, d)
+        r = layers.reshape(x, shape=[0, 0, n_head, d])
+        return layers.transpose(r, perm=[0, 2, 1, 3])
+
+    q = split_heads(q, d_key)
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    if use_flash:
+        ctx = layers.flash_attention(q, k, v, attn_bias,
+                                     scale=d_key ** -0.5)
+    else:
+        product = layers.matmul(q, k, transpose_y=True,
+                                alpha=d_key ** -0.5)
+        if attn_bias is not None:
+            product = layers.elementwise_add(product, attn_bias)
+        weights = layers.softmax(product)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                     dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, v)
+
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, 0, n_head * d_value])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def positionwise_feed_forward(x, d_inner, d_model, act="relu"):
+    hidden = layers.fc(x, size=d_inner, num_flatten_dims=2, act=act)
+    return layers.fc(hidden, size=d_model, num_flatten_dims=2)
+
+
+def pre_post_process(prev_out, out, process_cmd, dropout_rate=0.0):
+    """'a' residual-add, 'n' layer-norm, 'd' dropout (reference
+    pre_process_layer/post_process_layer convention)."""
+    for cmd in process_cmd:
+        if cmd == "a":
+            out = layers.elementwise_add(out, prev_out) \
+                if prev_out is not None else out
+        elif cmd == "n":
+            out = layers.layer_norm(out, begin_norm_axis=len(out.shape) - 1)
+        elif cmd == "d":
+            if dropout_rate:
+                out = layers.dropout(
+                    out, dropout_prob=dropout_rate,
+                    dropout_implementation="upscale_in_train")
+    return out
+
+
+def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
+                  dropout, use_flash=False):
+    attn = multi_head_attention(
+        pre_post_process(None, x, "n"), None, None, attn_bias, d_key,
+        d_value, d_model, n_head, dropout, use_flash=use_flash)
+    attn = pre_post_process(x, attn, "ad", dropout)
+    ff = positionwise_feed_forward(pre_post_process(None, attn, "n"),
+                                   d_inner, d_model)
+    return pre_post_process(attn, ff, "ad", dropout)
+
+
+def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
+                  d_model, d_inner, dropout, use_flash=False):
+    self_attn = multi_head_attention(
+        pre_post_process(None, x, "n"), None, None, self_bias, d_key,
+        d_value, d_model, n_head, dropout, use_flash=use_flash)
+    self_attn = pre_post_process(x, self_attn, "ad", dropout)
+    q = pre_post_process(None, self_attn, "n")
+    cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
+                                 d_value, d_model, n_head, dropout)
+    cross = pre_post_process(self_attn, cross, "ad", dropout)
+    ff = positionwise_feed_forward(pre_post_process(None, cross, "n"),
+                                   d_inner, d_model)
+    return pre_post_process(cross, ff, "ad", dropout)
+
+
+def _word_embedding(ids, vocab_size, d_model, name):
+    emb = layers.embedding(
+        ids, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=name,
+                             initializer=Normal(0.0, d_model ** -0.5)))
+    return layers.scale(emb, scale=d_model ** 0.5)
+
+
+def _prepare_input(ids, vocab_size, d_model, max_len, dropout, name):
+    emb = _word_embedding(ids, vocab_size, d_model, name)
+    emb = layers.add_position_encoding(emb)
+    if dropout:
+        emb = layers.dropout(emb, dropout_prob=dropout,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def _padding_bias(seq_len, max_len):
+    """(N,) lengths → additive attention bias (N, 1, 1, T): 0 valid,
+    -1e9 padded."""
+    m = layers.sequence_mask(seq_len, maxlen=max_len, dtype="float32")
+    bias = layers.scale(m, scale=1e9, bias=-1e9)
+    return layers.unsqueeze(layers.unsqueeze(bias, axes=[1]), axes=[1])
+
+
+def _causal_bias(max_len):
+    """(1, 1, T, T) additive bias: 0 where col <= row else -1e9."""
+    r = layers.range(0, max_len, 1, "float32")
+    row = layers.reshape(r, shape=[max_len, 1])
+    col = layers.reshape(r, shape=[1, max_len])
+    allowed = layers.cast(layers.less_equal(col, row), "float32")
+    bias = layers.scale(allowed, scale=1e9, bias=-1e9)
+    return layers.unsqueeze(layers.unsqueeze(bias, axes=[0]), axes=[0])
+
+
+def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
+                n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+                d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
+                use_flash=False):
+    """Build the full training graph; returns (avg_cost, logits, feeds)."""
+    src_word = layers.data(name="src_word", shape=[max_length],
+                           dtype="int64")
+    trg_word = layers.data(name="trg_word", shape=[max_length],
+                           dtype="int64")
+    lbl_word = layers.data(name="lbl_word", shape=[max_length],
+                           dtype="int64")
+    src_len = layers.data(name="src_len", shape=[], dtype="int32")
+    trg_len = layers.data(name="trg_len", shape=[], dtype="int32")
+
+    src_bias = _padding_bias(src_len, max_length)
+    trg_pad_bias = _padding_bias(trg_len, max_length)
+    causal = _causal_bias(max_length)
+    self_bias = layers.elementwise_add(trg_pad_bias, causal)
+
+    # encoder
+    enc_in = _prepare_input(src_word, src_vocab_size, d_model, max_length,
+                            dropout, "src_word_emb")
+    x = enc_in
+    for _ in range(n_layer):
+        x = encoder_layer(x, src_bias, n_head, d_key, d_value, d_model,
+                          d_inner_hid, dropout, use_flash=use_flash)
+    enc_out = pre_post_process(None, x, "n")
+
+    # decoder
+    dec_in = _prepare_input(trg_word, trg_vocab_size, d_model, max_length,
+                            dropout, "trg_word_emb")
+    y = dec_in
+    for _ in range(n_layer):
+        y = decoder_layer(y, enc_out, self_bias, src_bias, n_head, d_key,
+                          d_value, d_model, d_inner_hid, dropout,
+                          use_flash=use_flash)
+    dec_out = pre_post_process(None, y, "n")
+
+    logits = layers.fc(dec_out, size=trg_vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+
+    if label_smooth_eps:
+        label = layers.label_smooth(
+            layers.one_hot(lbl_word, depth=trg_vocab_size),
+            epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(logits, label,
+                                                 soft_label=True)
+    else:
+        lbl3 = layers.unsqueeze(lbl_word, axes=[2])
+        cost = layers.softmax_with_cross_entropy(logits, lbl3)
+
+    # mask padded target positions out of the loss
+    tmask = layers.sequence_mask(trg_len, maxlen=max_length,
+                                 dtype="float32")
+    cost = layers.elementwise_mul(layers.squeeze(cost, axes=[2]), tmask)
+    sum_cost = layers.reduce_sum(cost)
+    token_num = layers.reduce_sum(tmask)
+    avg_cost = layers.elementwise_div(sum_cost, token_num)
+    feeds = ["src_word", "trg_word", "lbl_word", "src_len", "trg_len"]
+    return avg_cost, logits, feeds
+
+
+def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
+                n_layer=6, n_head=8, d_model=512, d_inner_hid=2048,
+                dropout=0.1, learning_rate=2.0, warmup_steps=4000,
+                with_optimizer=True, label_smooth_eps=0.1, use_flash=False):
+    avg_cost, logits, feeds = transformer(
+        src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
+        d_model // n_head, d_model // n_head, d_model, d_inner_hid,
+        dropout, label_smooth_eps, use_flash=use_flash)
+    if with_optimizer:
+        lr = layers.noam_decay(d_model, warmup_steps)
+        lr = layers.elementwise_mul(
+            lr, layers.fill_constant([1], "float32", learning_rate))
+        opt = optimizer.AdamOptimizer(learning_rate=lr, beta1=0.9,
+                                      beta2=0.997, epsilon=1e-9)
+        opt.minimize(avg_cost)
+    return {"loss": avg_cost, "logits": logits, "feeds": feeds}
+
+
+def make_fake_batch(batch_size, max_length=64, src_vocab=10000,
+                    trg_vocab=10000, seed=0):
+    """Synthetic NMT batch for benchmarking (reference --use_fake_data)."""
+    rng = np.random.RandomState(seed)
+    src = rng.randint(1, src_vocab, (batch_size, max_length)).astype(np.int64)
+    trg = rng.randint(1, trg_vocab, (batch_size, max_length)).astype(np.int64)
+    lbl = rng.randint(1, trg_vocab, (batch_size, max_length)).astype(np.int64)
+    src_len = np.full((batch_size,), max_length, np.int32)
+    trg_len = np.full((batch_size,), max_length, np.int32)
+    return {"src_word": src, "trg_word": trg, "lbl_word": lbl,
+            "src_len": src_len, "trg_len": trg_len}

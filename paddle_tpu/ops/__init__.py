@@ -6,9 +6,13 @@ of the static-initialization pass that populates the kernel maps.
 """
 
 from ..core.registry import register_op, registered_ops  # noqa: F401
+from . import attention  # noqa: F401
 from . import basic  # noqa: F401
 from . import nn  # noqa: F401
 from . import optim  # noqa: F401
+from . import rnn  # noqa: F401
+from . import sequence  # noqa: F401
+from . import sparse  # noqa: F401
 
 
 @register_op("backward_marker")

@@ -1,0 +1,49 @@
+"""Fused attention op.
+
+The reference composes attention from matmul/softmax primitives
+(nets.py scaled_dot_product_attention; the 2018 codebase has no fused
+kernel — SURVEY.md §5.7 marks this a capability gap to fill natively).
+`flash_attention` is the single-op attention: inputs Q/K/V laid out
+(N, H, T, D) plus an optional additive Bias; the default implementation
+is a numerically-stable lax composition (XLA fuses it well on TPU), and
+ops/pallas/flash_attention.py provides the tiled Pallas kernel used when
+`use_pallas` is set and we're on TPU (forward via custom_vjp).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, opt_in, out
+
+
+def _xla_attention(q, k, v, bias, scale, causal):
+    logits = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        t_q, t_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), jnp.bool_))
+        logits = jnp.where(mask, logits, -1e9)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    o = jnp.einsum("nhqk,nhkd->nhqd", weights.astype(q.dtype), v)
+    return o
+
+
+@register_op("flash_attention")
+def flash_attention(ctx, ins, attrs):
+    q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
+    bias = opt_in(ins, "Bias")
+    scale = attrs.get("scale", None)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    causal = attrs.get("causal", False)
+    if attrs.get("use_pallas", False):
+        from .pallas.flash_attention import pallas_flash_attention
+
+        o = pallas_flash_attention(q, k, v, bias, scale, causal)
+    else:
+        o = _xla_attention(q, k, v, bias, scale, causal)
+    return out(Out=o)

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels — the custom-kernel tier.
+
+Analog of the reference's hand-written CUDA kernels and JIT codegen tier
+(operators/math/*.cu, operators/jit/ xbyak codegen, SURVEY.md §2.2): ops
+whose fusion XLA can't do on its own get tiled Pallas implementations.
+"""

@@ -1,0 +1,216 @@
+"""Recurrent ops: LSTM / GRU over padded batches with lax.scan.
+
+reference: paddle/fluid/operators/lstm_op.cc, gru_op.cc, lstm_unit_op.cc,
+gru_unit_op.cc, row_conv_op.cc + math/lstm_compute, math/gru_compute.
+The reference consumes LoD (concatenated variable-length) batches via
+sequence2batch reordering; here batches are padded (N, T, ...) with an
+optional SeqLen companion (segment-based ragged support, SURVEY.md §5.7)
+and recurrence is lax.scan — XLA unrolls onto the MXU per step, and padded
+steps are masked so states freeze past each sequence's end.
+
+Gate layouts follow the reference exactly: dynamic_lstm gates are
+[candidate, input, forget, output] (lstm_op.cc:131 "Bias = {b_c, b_i,
+b_f, b_o}", lstm_cpu_kernel.h:50-53 value_in/ig/fg/og); lstm_unit gates
+are [input, forget, output, candidate] (lstm_unit_op.h:63-66); GRU gates
+are [update, reset | candidate] with h = (1-u)*h_prev + u*c
+(math/detail/gru_kernel.h).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import first, opt_in, out
+
+
+def _act(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda v: v,
+    }[name]
+
+
+@register_op("dynamic_lstm")
+def dynamic_lstm(ctx, ins, attrs):
+    """Input (N, T, 4H) — already projected by the preceding fc, matching
+    the reference contract (lstm_op.cc expects x @ W_x done outside).
+    Weight (H, 4H) recurrent projection; Bias (1, 4H) or (1, 7H) with
+    peepholes."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = opt_in(ins, "Bias")
+    seq_len = opt_in(ins, "SeqLen")
+    h0 = opt_in(ins, "H0")
+    c0 = opt_in(ins, "C0")
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    use_peepholes = attrs.get("use_peepholes", False)
+    is_reverse = attrs.get("is_reverse", False)
+
+    n, t, g4 = x.shape
+    h_dim = g4 // 4
+    w_ic = w_fc = w_oc = jnp.zeros((h_dim,), x.dtype)
+    if bias is not None:
+        b_gates = bias.reshape(-1)[: 4 * h_dim]
+        x = x + b_gates
+        if use_peepholes:
+            peep = bias.reshape(-1)[4 * h_dim: 7 * h_dim]
+            w_ic = peep[:h_dim]
+            w_fc = peep[h_dim: 2 * h_dim]
+            w_oc = peep[2 * h_dim:]
+    h_prev = h0 if h0 is not None else jnp.zeros((n, h_dim), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((n, h_dim), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T, N, 4H)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    steps = jnp.arange(t)
+    if is_reverse:
+        steps = jnp.flip(steps)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, tidx = inp
+        gates = xt + h @ w
+        # reference order: candidate, input, forget, output
+        cand, i, f, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i = gate_act(i)
+        f = gate_act(f)
+        c_new = f * c + i * cand_act(cand)
+        if use_peepholes:
+            o = o + c_new * w_oc
+        o = gate_act(o)
+        h_new = o * cell_act(c_new)
+        if seq_len is not None:
+            valid = (tidx < seq_len)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+            c_new = jnp.where(valid, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = lax.scan(step, (h_prev, c_prev),
+                                          (xs, steps))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+        "LastH": [h_last],
+        "LastC": [c_last],
+    }
+
+
+@register_op("dynamic_gru")
+def dynamic_gru(ctx, ins, attrs):
+    """Input (N, T, 3H) pre-projected; Weight is the recurrent
+    (H, 3H) = [update|reset | candidate] split like gru_op.cc."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = opt_in(ins, "Bias")
+    seq_len = opt_in(ins, "SeqLen")
+    h0 = opt_in(ins, "H0")
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    is_reverse = attrs.get("is_reverse", False)
+
+    n, t, g3 = x.shape
+    h_dim = g3 // 3
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    w_ur = w[:, : 2 * h_dim]
+    w_c = w[:, 2 * h_dim:]
+    h_prev = h0 if h0 is not None else jnp.zeros((n, h_dim), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    steps = jnp.arange(t)
+    if is_reverse:
+        steps = jnp.flip(steps)
+
+    def step(h, inp):
+        xt, tidx = inp
+        x_ur = xt[:, : 2 * h_dim]
+        x_c = xt[:, 2 * h_dim:]
+        ur = gate_act(x_ur + h @ w_ur)
+        u, r = jnp.split(ur, 2, axis=-1)
+        c = cand_act(x_c + (r * h) @ w_c)
+        # reference convention (math/detail/gru_kernel.h:62):
+        # h = (1-u)*h_prev + u*candidate
+        h_new = (1 - u) * h + u * c
+        if seq_len is not None:
+            valid = (tidx < seq_len)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+        return h_new, h_new
+
+    h_last, hs = lax.scan(step, h_prev, (xs, steps))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx, ins, attrs):
+    """Single-step LSTM cell (reference lstm_unit_op.cc): X = gates
+    (N, 4H), C_prev (N, H)."""
+    x, c_prev = first(ins, "X"), first(ins, "C_prev")
+    forget_bias = attrs.get("forget_bias", 0.0)
+    # reference order (lstm_unit_op.h:63-66): input, forget, output, cand
+    i, f, o, cand = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(cand)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("gru_unit")
+def gru_unit(ctx, ins, attrs):
+    x = first(ins, "Input")
+    h_prev = first(ins, "HiddenPrev")
+    w = first(ins, "Weight")
+    bias = opt_in(ins, "Bias")
+    h_dim = h_prev.shape[-1]
+    gate_act = _act({1: "sigmoid", 2: "tanh", 0: "identity",
+                     3: "relu"}.get(attrs.get("gate_activation", 1),
+                                    "sigmoid")
+                    if isinstance(attrs.get("gate_activation", 1), int)
+                    else attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act({1: "sigmoid", 2: "tanh", 0: "identity",
+                     3: "relu"}.get(attrs.get("activation", 2), "tanh")
+                    if isinstance(attrs.get("activation", 2), int)
+                    else attrs.get("activation", "tanh"))
+    g = x
+    if bias is not None:
+        g = g + bias.reshape(-1)
+    w_ur = w[:, : 2 * h_dim]
+    w_c = w[:, 2 * h_dim:]
+    ur = gate_act(g[:, : 2 * h_dim] + h_prev @ w_ur)
+    u, r = jnp.split(ur, 2, axis=-1)
+    c = cand_act(g[:, 2 * h_dim:] + (r * h_prev) @ w_c)
+    # reference convention (gru_unit_op.h:116): h = (1-u)*h_prev + u*c
+    h = (1 - u) * h_prev + u * c
+    return {"Hidden": [h], "Gate": [jnp.concatenate([ur, c], -1)],
+            "ResetHiddenPrev": [r * h_prev]}
+
+
+@register_op("row_conv")
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference row_conv_op.cc): X (N, T, D),
+    Filter (future_context, D)."""
+    x, f = first(ins, "X"), first(ins, "Filter")
+    ctx_len = f.shape[0]
+    n, t, d = x.shape
+    padded = jnp.pad(x, ((0, 0), (0, ctx_len - 1), (0, 0)))
+    o = jnp.zeros_like(x)
+    for k in range(ctx_len):
+        o = o + padded[:, k: k + t, :] * f[k]
+    return out(Out=o)

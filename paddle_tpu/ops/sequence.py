@@ -1,0 +1,263 @@
+"""Sequence ops over padded (N, T, ...) batches with explicit lengths.
+
+reference: paddle/fluid/operators/sequence_ops/ (46 files) — seq_pool,
+seq_softmax, seq_expand, seq_pad/unpad, seq_mask, seq_reverse, seq_conv,
+seq_concat, seq_slice, seq_enumerate + math/sequence_pooling etc.
+
+The reference stores ragged batches as LoD (concatenated rows + offset
+table, lod_tensor.h:38-58).  The TPU-native representation is padded
+dense (N, T, ...) plus an int32 `SeqLen` (N,) — static shapes for XLA,
+masking instead of offset iteration (SURVEY.md §5.7).  Ops accept SeqLen
+as an optional input; without it the full padded length is used.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import first, opt_in, out
+
+
+def _mask(x_len, t, dtype=jnp.float32):
+    """(N, T) validity mask from lengths."""
+    return (jnp.arange(t)[None, :] < x_len[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool")
+def sequence_pool(ctx, ins, attrs):
+    x = first(ins, "X")  # (N, T, D...)
+    seq_len = opt_in(ins, "SeqLen")
+    pool = attrs.get("pooltype", "AVERAGE").upper()
+    n, t = x.shape[0], x.shape[1]
+    if seq_len is None:
+        seq_len = jnp.full((n,), t, jnp.int32)
+    m = _mask(seq_len, t, x.dtype).reshape((n, t) + (1,) * (x.ndim - 2))
+    lens = jnp.maximum(seq_len, 1).astype(x.dtype).reshape(
+        (n,) + (1,) * (x.ndim - 2))
+    if pool == "SUM":
+        o = jnp.sum(x * m, axis=1)
+    elif pool == "AVERAGE":
+        o = jnp.sum(x * m, axis=1) / lens
+    elif pool == "SQRT":
+        o = jnp.sum(x * m, axis=1) / jnp.sqrt(lens)
+    elif pool == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        o = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif pool == "FIRST":
+        o = x[:, 0]
+    elif pool == "LAST":
+        idx = jnp.maximum(seq_len - 1, 0)
+        o = jnp.take_along_axis(
+            x, idx.reshape((n, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    else:
+        raise ValueError(f"unknown pooltype {pool}")
+    return {"Out": [o], "MaxIndex": [jnp.zeros((n,), jnp.int32)]}
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx, ins, attrs):
+    x = first(ins, "X")  # (N, T) or (N, T, 1)
+    seq_len = opt_in(ins, "SeqLen")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x.reshape(x.shape[:2]) if squeeze else x
+    n, t = v.shape
+    if seq_len is None:
+        seq_len = jnp.full((n,), t, jnp.int32)
+    m = _mask(seq_len, t, jnp.bool_)
+    v = jnp.where(m, v, -jnp.inf)
+    o = jax.nn.softmax(v, axis=1)
+    o = jnp.where(m, o, 0.0)
+    if squeeze:
+        o = o[..., None]
+    return out(Out=o)
+
+
+@register_op("sequence_expand")
+def sequence_expand(ctx, ins, attrs):
+    """Expand each row of X to match Y's per-sequence repetition
+    (reference sequence_expand_op).  Padded semantics: X (N, D) or
+    (N, 1, D) broadcast along Y's time axis."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.ndim == y.ndim:
+        return out(Out=jnp.broadcast_to(x, y.shape[:2] + x.shape[2:]))
+    o = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
+    return out(Out=o)
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(ctx, ins, attrs):
+    return sequence_expand(ctx, ins, attrs)
+
+
+@register_op("sequence_mask")
+def sequence_mask(ctx, ins, attrs):
+    x = first(ins, "X")  # lengths (N,) or (N,1)
+    lens = x.reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask requires static maxlen under XLA")
+    from .common import to_jnp_dtype
+
+    dtype = to_jnp_dtype(attrs.get("out_dtype", "int64"))
+    m = (jnp.arange(maxlen)[None, :] < lens[:, None]).astype(dtype)
+    return {"Y": [m]}
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ctx, ins, attrs):
+    x = first(ins, "X")  # (N, T, ...)
+    seq_len = opt_in(ins, "SeqLen")
+    n, t = x.shape[0], x.shape[1]
+    if seq_len is None:
+        return {"Y": [jnp.flip(x, axis=1)]}
+    # reverse only the valid prefix of each row
+    idx = jnp.arange(t)[None, :]
+    rev_idx = jnp.where(idx < seq_len[:, None],
+                        seq_len[:, None] - 1 - idx, idx)
+    o = jnp.take_along_axis(
+        x, rev_idx.reshape((n, t) + (1,) * (x.ndim - 2)), axis=1)
+    return {"Y": [o]}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx, ins, attrs):
+    # padded semantics: concat along time
+    return out(Out=jnp.concatenate(ins["X"], axis=1))
+
+
+@register_op("sequence_pad")
+def sequence_pad(ctx, ins, attrs):
+    """Already-padded representation: pads/truncates to padded_length."""
+    x = first(ins, "X")
+    seq_len = opt_in(ins, "SeqLen")
+    pad_value = first(ins, "PadValue") if ins.get("PadValue") else None
+    padded_length = attrs.get("padded_length", -1)
+    n, t = x.shape[0], x.shape[1]
+    if seq_len is None:
+        seq_len = jnp.full((n,), t, jnp.int32)
+    target = padded_length if padded_length and padded_length > 0 else t
+    if target > t:
+        cfg = [(0, 0), (0, target - t)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, cfg)
+    elif target < t:
+        x = x[:, :target]
+    m = _mask(seq_len, target, x.dtype).reshape(
+        (n, target) + (1,) * (x.ndim - 2))
+    fill = pad_value.reshape(()) if pad_value is not None else 0.0
+    o = x * m + fill * (1 - m)
+    return {"Out": [o], "Length": [seq_len.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(ctx, ins, attrs):
+    """Inverse of sequence_pad.  Padded world: zero the invalid tail and
+    pass lengths through (downstream seq ops mask again)."""
+    x = first(ins, "X")
+    length = first(ins, "Length").reshape(-1)
+    n, t = x.shape[0], x.shape[1]
+    m = _mask(length, t, x.dtype).reshape((n, t) + (1,) * (x.ndim - 2))
+    return out(Out=x * m)
+
+
+@register_op("sequence_slice")
+def sequence_slice(ctx, ins, attrs):
+    x = first(ins, "X")
+    offset = first(ins, "Offset").reshape(-1)
+    length = first(ins, "Length").reshape(-1)
+    n, t = x.shape[0], x.shape[1]
+    idx = offset[:, None] + jnp.arange(t)[None, :]
+    idx = jnp.clip(idx, 0, t - 1)
+    g = jnp.take_along_axis(
+        x, idx.reshape((n, t) + (1,) * (x.ndim - 2)), axis=1)
+    m = _mask(length, t, x.dtype).reshape((n, t) + (1,) * (x.ndim - 2))
+    return out(Out=g * m)
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(ctx, ins, attrs):
+    x = first(ins, "X")  # (N, T) int ids
+    win = attrs["win_size"]
+    pad_value = attrs.get("pad_value", 0)
+    n, t = x.shape[0], x.shape[1]
+    cols = []
+    for k in range(win):
+        shifted = jnp.pad(x[:, k:], ((0, 0), (0, k)),
+                          constant_values=pad_value)
+        cols.append(shifted)
+    return out(Out=jnp.stack(cols, axis=-1))
+
+
+@register_op("sequence_erase")
+def sequence_erase(ctx, ins, attrs):
+    """Mark erased tokens with -1 (static shapes forbid true removal; the
+    companion mask/SeqLen convention treats negatives as holes)."""
+    x = first(ins, "X")
+    tokens = jnp.asarray(attrs.get("tokens", []), dtype=x.dtype)
+    if tokens.size == 0:
+        return out(Out=x)
+    hit = jnp.isin(x, tokens)
+    return out(Out=jnp.where(hit, -1, x))
+
+
+@register_op("sequence_conv")
+def sequence_conv(ctx, ins, attrs):
+    """Window convolution over time (reference sequence_conv_op.cc):
+    X (N, T, D), Filter (context_length*D, num_filters)."""
+    x = first(ins, "X")
+    f = first(ins, "Filter")
+    seq_len = opt_in(ins, "SeqLen")
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    n, t, d = x.shape
+    if seq_len is not None:
+        m = _mask(seq_len, t, x.dtype)[..., None]
+        x = x * m
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        if off < 0:
+            shifted = jnp.pad(x[:, :t + off], ((0, 0), (-off, 0), (0, 0)))
+        elif off > 0:
+            shifted = jnp.pad(x[:, off:], ((0, 0), (0, off), (0, 0)))
+        else:
+            shifted = x
+        cols.append(shifted)
+    im = jnp.concatenate(cols, axis=-1)  # (N, T, ctx_len*D)
+    o = im.reshape(n * t, ctx_len * d) @ f
+    return out(Out=o.reshape(n, t, -1))
+
+
+@register_op("im2sequence")
+def im2sequence(ctx, ins, attrs):
+    """Image → patch sequence (reference im2sequence_op.cc): NCHW →
+    (N, num_patches, C*kh*kw)."""
+    x = first(ins, "X")
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0, 0, 0])[:2]
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, cd, oh, ow = patches.shape
+    o = jnp.transpose(patches.reshape(n, cd, oh * ow), (0, 2, 1))
+    return out(Out=o)
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ctx, ins, attrs):
+    x = first(ins, "X")  # (N, T, D)
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    n, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.exp(jnp.arange(0, d, 2, dtype=jnp.float32)
+                  * (-jnp.log(10000.0) / d))
+    pe = jnp.zeros((t, d), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
+    pe = pe.at[:, 1::2].set(jnp.cos(pos * div[: d // 2]))
+    return out(Out=(alpha * x + beta * pe[None]).astype(x.dtype))

@@ -1,0 +1,19 @@
+"""Distributed execution over a TPU device mesh.
+
+TPU-native replacement for the reference's distributed stack
+(SURVEY.md §2.3/§5.8): ParallelExecutor's per-device SSA graphs + NCCL
+all-reduce op handles (framework/details/) become jit with
+NamedSharding annotations — XLA GSPMD partitions the single program and
+inserts ICI collectives; the pserver/DistributeTranspiler path is
+subsumed by parameter sharding (FSDP-style) and sharded embedding
+tables.
+"""
+
+from .collectives import (all_gather, all_reduce, all_to_all,  # noqa: F401
+                          barrier, ppermute, psum, reduce_scatter)
+from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                       ExecutionStrategy)
+from .mesh import get_default_mesh, make_mesh, set_default_mesh  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .strategies import ShardingRules  # noqa: F401

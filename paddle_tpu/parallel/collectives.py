@@ -1,0 +1,113 @@
+"""Functional collectives over the mesh.
+
+Replaces the reference's NCCL op handles and raw nccl ops
+(details/all_reduce_op_handle.cc, operators/nccl/nccl_op.cu.cc,
+collective_server).  These are thin shard_map wrappers around XLA
+collectives (psum / all_gather / ppermute / all_to_all) for code that
+wants explicit communication (ring attention, expert dispatch); ordinary
+data/tensor parallelism never calls these — GSPMD inserts collectives
+from sharding annotations alone.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce(x, mesh, axis: str, shard_dim: int = 0, op: str = "sum"):
+    """Reduce per-device values stacked along `shard_dim` to one
+    replicated result with that dim removed (the PE all-reduce,
+    details/all_reduce_op_handle.cc: N per-device grads → one summed
+    grad everywhere)."""
+    spec = [None] * x.ndim
+    spec[shard_dim] = axis
+
+    def f(xs):
+        if op == "sum":
+            r = jax.lax.psum(xs, axis)
+        elif op == "max":
+            r = jax.lax.pmax(xs, axis)
+        elif op == "mean":
+            r = jax.lax.pmean(xs, axis)
+        else:
+            raise ValueError(op)
+        return jax.numpy.squeeze(r, shard_dim)
+
+    out_spec = [None] * (x.ndim - 1)
+    return _shard_map(f, mesh, (P(*spec),), P(*out_spec))(x)
+
+
+def all_gather(x, mesh, axis: str, shard_dim: int = 0):
+    spec = [None] * x.ndim
+    spec[shard_dim] = axis
+
+    def f(xs):
+        return jax.lax.all_gather(xs, axis, axis=shard_dim, tiled=True)
+
+    return _shard_map(f, mesh, (P(*spec),), P(*[None] * x.ndim))(x)
+
+
+def reduce_scatter(x, mesh, axis: str, shard_dim: int = 0):
+    """Replicated-in, sharded-out sum (the kReduce build-strategy mode,
+    build_strategy.h:55)."""
+    def f(xs):
+        return jax.lax.psum_scatter(xs, axis, scatter_dimension=shard_dim,
+                                    tiled=True)
+
+    out_spec = [None] * x.ndim
+    out_spec[shard_dim] = axis
+    return _shard_map(f, mesh, (P(*[None] * x.ndim),), P(*out_spec))(x)
+
+
+def ppermute(x, mesh, axis: str, perm, shard_dim: int = 0):
+    """Neighbor exchange over the ring (ICI) — building block for ring
+    attention."""
+    spec = [None] * x.ndim
+    spec[shard_dim] = axis
+
+    def f(xs):
+        return jax.lax.ppermute(xs, axis, perm)
+
+    return _shard_map(f, mesh, (P(*spec),), P(*spec))(x)
+
+
+def all_to_all(x, mesh, axis: str, split_dim: int, concat_dim: int):
+    """Ulysses-style head/sequence exchange."""
+    n = mesh.shape[axis]
+    in_spec = [None] * x.ndim
+    in_spec[concat_dim] = axis
+
+    def f(xs):
+        return jax.lax.all_to_all(xs, axis, split_axis=split_dim,
+                                  concat_axis=concat_dim, tiled=True)
+
+    out_spec = [None] * x.ndim
+    out_spec[split_dim] = axis
+    return _shard_map(f, mesh, (P(*in_spec),), P(*out_spec))(x)
+
+
+def barrier(mesh, axis: str):
+    """Synchronization barrier (the reference's send_barrier /
+    fetch_barrier ops) — a trivial psum forces a cross-replica sync."""
+    def f():
+        return jax.lax.psum(jnp.ones(()), axis)
+
+    return _shard_map(f, mesh, (), P())()

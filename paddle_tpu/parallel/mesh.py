@@ -1,0 +1,51 @@
+"""Device mesh construction.
+
+Replaces the reference's device topology handling (NCCLContextMap over
+places, platform/nccl_helper.h:86; multi-trainer ranks at
+parallel_executor.cc:254).  A Mesh names the parallelism axes; shardings
+reference axes by name and XLA routes collectives over ICI (fast, within
+slice) vs DCN (across slices) according to mesh layout.
+
+Conventional axis names: "dp" (data), "mp" (tensor/model), "sp"
+(sequence/context), "pp" (pipeline), "ep" (expert).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(axes: Dict[str, int], devices=None):
+    """Build a jax.sharding.Mesh with named axes, e.g.
+    make_mesh({"dp": 4, "mp": 2})."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices, only {len(devices)} available")
+    arr = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+_default_mesh = None
+
+
+def set_default_mesh(mesh):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh(create_dp: bool = True):
+    """The process-wide mesh; lazily a pure-DP mesh over all devices."""
+    global _default_mesh
+    if _default_mesh is None and create_dp:
+        import jax
+
+        _default_mesh = make_mesh({"dp": len(jax.devices())})
+    return _default_mesh

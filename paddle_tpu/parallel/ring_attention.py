@@ -1,0 +1,147 @@
+"""Sequence/context parallelism: ring attention + Ulysses.
+
+The reference has NO long-context machinery (SURVEY.md §5.7 marks this
+an explicit capability gap: its long-sequence story was LoD no-padding
+batching).  These are the TPU-native fills:
+
+- **Ring attention**: q/k/v sharded over the sequence axis; k/v shards
+  rotate around the ICI ring via collective-permute while each device
+  accumulates attention for its local queries with online-softmax
+  merging.  Memory per device is O(T/P); compute overlaps communication
+  around the ring.
+- **Ulysses**: all-to-all exchanges sequence sharding for head sharding,
+  runs dense local attention (the Pallas flash kernel), and exchanges
+  back.  One a2a pair instead of P-1 permutes; needs H divisible by P.
+
+Both are differentiable (pure jax + collectives) and tested against
+single-device full attention on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _local_attention_with_lse(q, k, v, q_off, k_off, scale, causal):
+    """Chunk attention returning (o, lse); positions are global offsets
+    so causal masking works across rotated chunks.
+    q: (N, H, Tq, D), k/v: (N, H, Tk, D)."""
+    s = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        q_pos = q_off + jnp.arange(t_q)[:, None]
+        k_pos = k_off + jnp.arange(t_k)[None, :]
+        s = jnp.where(q_pos >= k_pos, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # guard fully-masked rows
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("nhqk,nhkd->nhqd", p.astype(q.dtype), v)
+    lse = m_safe + jnp.log(jnp.maximum(l, 1e-30))
+    o = o / jnp.maximum(l, 1e-30).astype(o.dtype)
+    return o, lse[..., 0]  # (N,H,Tq,D), (N,H,Tq)
+
+
+def _merge(o_a, lse_a, o_b, lse_b):
+    """Combine two normalized partial attentions via their logsumexps."""
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)[..., None]
+    wb = jnp.exp(lse_b - m)[..., None]
+    o = (o_a.astype(jnp.float32) * wa + o_b.astype(jnp.float32) * wb) / \
+        (wa + wb)
+    lse = m + jnp.log(wa[..., 0] + wb[..., 0])
+    return o.astype(o_a.dtype), lse
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
+                   causal: bool = False):
+    """q/k/v: GLOBAL (N, H, T, D) logically sharded over T on `axis`.
+    Returns the full attention output with the same sharding."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[axis]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    t_total = q.shape[2]
+    t_local = t_total // n_dev
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def local_fn(q_l, k_l, v_l):
+        idx = jax.lax.axis_index(axis)
+        q_off = idx * t_local
+
+        def body(j, carry):
+            o, lse, k_cur, v_cur = carry
+            # chunk j originated on device (idx - j) mod n_dev
+            src = (idx - j) % n_dev
+            k_off = src * t_local
+            o_j, lse_j = _local_attention_with_lse(
+                q_l, k_cur, v_cur, q_off, k_off, scale, causal)
+            o, lse = _merge(o, lse, o_j, lse_j)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return o, lse, k_nxt, v_nxt
+
+        o0 = jnp.zeros_like(q_l)
+        lse0 = jnp.full(q_l.shape[:-1], -1e30, jnp.float32)
+        o, lse, _, _ = jax.lax.fori_loop(
+            0, n_dev, body, (o0, lse0, k_l, v_l))
+        return o
+
+    spec = P(None, None, axis, None)
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
+                      causal: bool = False, use_pallas: bool = False):
+    """Ulysses sequence parallelism: a2a seq→heads, dense local
+    attention, a2a heads→seq.  q/k/v: GLOBAL (N, H, T, D) sharded over T
+    on `axis`; H must be divisible by the axis size."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[axis]
+    n, h, t, d = q.shape
+    if h % n_dev != 0:
+        raise ValueError(f"Ulysses needs heads ({h}) divisible by "
+                         f"mesh axis {axis!r} size ({n_dev})")
+    if scale is None:
+        scale = d ** -0.5
+
+    def local_fn(q_l, k_l, v_l):
+        def seq_to_heads(x):
+            # (N, H, T/P, D) -> (N, H/P, T, D)
+            return jax.lax.all_to_all(x, axis, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        qh, kh, vh = seq_to_heads(q_l), seq_to_heads(k_l), seq_to_heads(v_l)
+        if use_pallas:
+            from ..ops.pallas.flash_attention import pallas_flash_attention
+
+            oh = pallas_flash_attention(qh, kh, vh, scale=scale,
+                                        causal=causal)
+        else:
+            oh, _ = _local_attention_with_lse(qh, kh, vh, 0, 0, scale,
+                                              causal)
+        return heads_to_seq(oh)
+
+    spec = P(None, None, axis, None)
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)

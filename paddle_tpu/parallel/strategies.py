@@ -1,0 +1,73 @@
+"""Parameter/activation sharding rules.
+
+Replaces the reference's BuildStrategy reduce modes + DistributeTranspiler
+param slicing (build_strategy.h:55, distribute_transpiler.py:80 — params
+sliced into blocks round-robin over pservers).  Here a rule maps var-name
+patterns to PartitionSpecs; GSPMD does the slicing and inserts the
+collectives.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ShardingRules:
+    """Ordered (regex, spec) rules; first match wins.
+
+    spec is a tuple of mesh-axis names (or None) per tensor dim, e.g.
+    (None, "mp") shards dim 1 over the "mp" axis.  `default` applies to
+    unmatched params (None = replicated; "fsdp" = shard dim 0 over the
+    given axis when divisible).
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, tuple]]] = None,
+                 default: Optional[str] = None,
+                 fsdp_axis: str = "dp"):
+        self.rules = [(re.compile(p), spec) for p, spec in (rules or [])]
+        self.default = default
+        self.fsdp_axis = fsdp_axis
+
+    def spec_for(self, name: str, shape, mesh) -> tuple:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return self._validate(spec, shape, mesh)
+        if self.default == "fsdp":
+            ax_size = mesh.shape[self.fsdp_axis]
+            for dim, d in enumerate(shape):
+                if d % ax_size == 0 and d >= ax_size:
+                    spec = [None] * len(shape)
+                    spec[dim] = self.fsdp_axis
+                    return tuple(spec)
+        return (None,) * len(shape)
+
+    @staticmethod
+    def _validate(spec, shape, mesh) -> tuple:
+        spec = tuple(spec) + (None,) * (len(shape) - len(spec))
+        out = []
+        for d, ax in zip(shape, spec):
+            if ax is None:
+                out.append(None)
+            else:
+                size = mesh.shape[ax]
+                out.append(ax if d % size == 0 else None)
+        return tuple(out)
+
+
+# Ready-made rule set for the transformer/bert models in models/:
+# embedding tables sharded over "mp" on the vocab dim, and every fc
+# weight column-parallel (output dim over "mp").  Column-everywhere is a
+# valid TP layout — GSPMD inserts the reduce where a row-parallel layout
+# would have placed its all-reduce; a name-aware column/row split
+# (classic Megatron, one collective per block) needs per-layer naming
+# and is a later-round refinement.
+def megatron_transformer_rules(fsdp: bool = False) -> ShardingRules:
+    return ShardingRules(
+        rules=[
+            (r"(word_emb|src_word_emb|trg_word_emb|word_embedding|fm_emb)",
+             ("mp", None)),
+            (r"fc_\d+\.w_\d+", (None, "mp")),
+        ],
+        default="fsdp" if fsdp else None,
+    )

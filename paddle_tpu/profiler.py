@@ -1,0 +1,82 @@
+"""Profiling.
+
+reference: python/paddle/fluid/profiler.py:221 profiler context manager +
+platform/profiler.h RecordEvent ranges + CUPTI DeviceTracer →
+chrome-trace (SURVEY.md §5.1).  TPU equivalent: jax.profiler traces
+(XPlane/Perfetto, viewable in TensorBoard or ui.perfetto.dev) with the
+same op-name annotation convention via TraceAnnotation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: str = "/tmp/profile"):
+    """Drop-in for fluid.profiler.profiler: captures a device+host trace
+    for the enclosed region.  `state`/`sorted_key` are accepted for API
+    parity; the trace contains both host and device activity."""
+    import jax
+
+    os.makedirs(profile_path, exist_ok=True)
+    jax.profiler.start_trace(profile_path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RecordEvent RAII range (platform/profiler.h:72): annotates the
+    enclosed host region; annotations flow into device traces."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def start_profiler(state: str = "All",
+                   profile_path: str = "/tmp/profile"):
+    import jax
+
+    os.makedirs(profile_path, exist_ok=True)
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+def cuda_profiler(*args, **kwargs):
+    raise NotImplementedError(
+        "cuda_profiler is CUDA-specific; use profiler()/record_event, "
+        "which capture TPU device traces")
+
+
+class Timer:
+    """Host-side timer (platform/timer.h) for benchmark reporting."""
+
+    def __init__(self):
+        self._start = None
+        self.elapsed = 0.0
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def pause(self):
+        if self._start is not None:
+            self.elapsed += time.perf_counter() - self._start
+            self._start = None
+
+    def reset(self):
+        self._start = None
+        self.elapsed = 0.0

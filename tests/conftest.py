@@ -15,3 +15,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402  (after env setup)
+
+# The environment's sitecustomize pins the platform to the TPU plugin
+# before conftest runs; force the virtual 8-device CPU backend for tests.
+jax.config.update("jax_platforms", "cpu")
+
+# Numeric comparisons against float64 numpy references need full-precision
+# matmuls; the framework itself keeps the fast TPU default.
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,104 @@
+"""Pallas flash attention vs composed XLA reference (interpret mode on
+CPU; the same kernel runs compiled on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _ref_attention(q, k, v, bias=None, scale=None, causal=False):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((t_q, t_k), bool)), s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("nhqk,nhkd->nhqd", p.astype(q.dtype), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    n, h, t, d = 1, 2, 256, 128
+    q = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    got = _interpreted(fa, q, k, v, None, None, causal)
+    want = _ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_padding_bias():
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    rng = np.random.RandomState(1)
+    n, h, t, d = 2, 1, 128, 128
+    q = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    lens = np.array([96, 128])
+    bias = np.zeros((n, 1, 1, t), np.float32)
+    for i, L in enumerate(lens):
+        bias[i, :, :, L:] = -1e9
+    bias = jnp.asarray(bias)
+    got = _interpreted(fa, q, k, v, bias, None, False)
+    want = _ref_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_grad_matches_reference():
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    rng = np.random.RandomState(2)
+    n, h, t, d = 1, 1, 128, 128
+    q = jnp.asarray(rng.randn(n, h, t, d), jnp.float32) * 0.5
+    k = jnp.asarray(rng.randn(n, h, t, d), jnp.float32) * 0.5
+    v = jnp.asarray(rng.randn(n, h, t, d), jnp.float32) * 0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(_interpreted(fa, q, k, v, None, None, False) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
+
+
+# -- helpers ---------------------------------------------------------------
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _noop():
+    yield
+
+
+def _interpreted(fa, q, k, v, bias, scale, causal):
+    """Run pallas_flash_attention with the kernel in interpret mode
+    (pallas_call(interpret=True)) so it executes on the CPU backend."""
+    from jax.experimental import pallas as pl
+    import unittest.mock as mock
+
+    real_call = pl.pallas_call
+
+    def patched(kernel, **kw):
+        kw["interpret"] = True
+        return real_call(kernel, **kw)
+
+    with mock.patch.object(pl, "pallas_call", patched):
+        return fa.pallas_flash_attention(q, k, v, bias=bias, scale=scale,
+                                         causal=causal)
