@@ -307,7 +307,7 @@ class Executor:
             v.name for v in block.vars.values()
             if v.persistable and scope.has_var(v.name)
         ))
-        key = (id(program), program._version, tuple(sorted(feed)),
+        key = (program._uid, program._version, tuple(sorted(feed)),
                tuple(fetch_names), state_names, iterations)
         fn = self._cache.get(key) if use_program_cache else None
         if fn is None:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -329,12 +330,17 @@ class Program:
     (parameter/state initialization, run once by Executor.run(startup)).
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.random_seed: int = 0
-        # Monotonic edit counter; the Executor uses (id, version) as its
+        # Monotonic edit counter; the Executor uses (uid, version) as its
         # compile-cache key, so any mutation invalidates cached executables.
+        # The uid is process-unique (unlike id(), which can be reused after
+        # garbage collection and alias a stale cache entry).
         self._version = 0
+        self._uid = next(Program._uid_counter)
         # Set by append_backward: index boundary and grad bookkeeping.
         self._backward_info: Optional[Dict[str, Any]] = None
 

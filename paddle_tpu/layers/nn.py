@@ -764,7 +764,9 @@ def image_resize(input, out_shape=None, scale=None, name=None,
     helper.append_op(type="interpolate", inputs={"X": [input]},
                      outputs={"Out": [out]},
                      attrs={"out_h": int(h), "out_w": int(w),
-                            "interp_method": resample.lower()})
+                            "interp_method": resample.lower(),
+                            "align_corners": bool(align_corners),
+                            "align_mode": int(align_mode)})
     return out
 
 

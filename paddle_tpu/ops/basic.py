@@ -454,7 +454,7 @@ def top_k(ctx, ins, attrs):
     x = first(ins, "X")
     k = attrs["k"]
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
 
 
 @register_op("argsort")
@@ -462,19 +462,19 @@ def argsort(ctx, ins, attrs):
     x = first(ins, "X")
     axis = attrs.get("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int32)]}
 
 
 @register_op("arg_max")
 def arg_max(ctx, ins, attrs):
     x = first(ins, "X")
-    return out(Out=jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+    return out(Out=jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int32))
 
 
 @register_op("arg_min")
 def arg_min(ctx, ins, attrs):
     x = first(ins, "X")
-    return out(Out=jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+    return out(Out=jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int32))
 
 
 @register_op("cumsum")

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -45,4 +46,15 @@ def pair(value, n=2):
 
 
 def to_jnp_dtype(name: str):
+    """API dtype → runtime jnp dtype.
+
+    The fluid API declares int64/float64 widely (labels, indices); with
+    jax x64 disabled those silently truncate to 32-bit with a warning per
+    call site.  Map them explicitly so the declared dtype matches the real
+    runtime precision and the warnings disappear.
+    """
+    name = str(name)
+    if not jax.config.jax_enable_x64:
+        name = {"int64": "int32", "uint64": "uint32",
+                "float64": "float32"}.get(name, name)
     return jnp.dtype(name)

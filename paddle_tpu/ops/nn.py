@@ -223,10 +223,43 @@ def pool2d(ctx, ins, attrs):
 
 @register_op("pool2d_with_index")
 def pool2d_with_index(ctx, ins, attrs):
+    """reference: operators/pool_with_index_op.cc — max pool returning the
+    flattened H*W position of each window max (consumed by unpool)."""
     x = first(ins, "X")
-    o = pool2d(ctx, ins, dict(attrs, pooling_type="max"))["Out"][0]
-    # Mask indices are rarely consumed; provide argmax-compatible zeros.
-    return {"Out": [o], "Mask": [jnp.zeros_like(o, dtype=jnp.int32)]}
+    n, c, h, w = x.shape
+    if attrs.get("global_pooling", False):
+        # reference pool_with_index_op.cc:48 — ksize becomes the full
+        # spatial extent and paddings are ignored
+        kh, kw = h, w
+        sh, sw = h, w
+        ph, pw = 0, 0
+    else:
+        kh, kw = pair(attrs["ksize"])
+        sh, sw = pair(attrs.get("strides", 1))
+        ph, pw = pair(attrs.get("paddings", 0))
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    pos = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
+    pos = jnp.broadcast_to(pos, (n, c, h, w))
+    posp = jnp.pad(pos, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                   constant_values=-1)
+    vals, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            sl = (slice(None), slice(None),
+                  slice(i, i + (oh - 1) * sh + 1, sh),
+                  slice(j, j + (ow - 1) * sw + 1, sw))
+            vals.append(xp[sl])
+            idxs.append(posp[sl])
+    v = jnp.stack(vals)                     # (kh*kw, N, C, OH, OW)
+    am = jnp.argmax(v, axis=0)
+    o = jnp.take_along_axis(v, am[None], axis=0)[0]
+    mask = jnp.take_along_axis(jnp.stack(idxs), am[None], axis=0)[0]
+    return {"Out": [o.astype(x.dtype)], "Mask": [mask]}
 
 
 # --------------------------------------------------------------------------
@@ -557,8 +590,8 @@ def accuracy(ctx, ins, attrs):
     indices, label = first(ins, "Indices"), first(ins, "Label")
     lbl = label.reshape((-1, 1))
     correct = jnp.any(indices == lbl, axis=1)
-    total = jnp.asarray(indices.shape[0], jnp.int64)
-    num_correct = jnp.sum(correct).astype(jnp.int64)
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    num_correct = jnp.sum(correct).astype(jnp.int32)
     acc = (num_correct.astype(jnp.float32) / total.astype(jnp.float32))
     return {"Accuracy": [acc.reshape((1,))],
             "Correct": [num_correct.reshape((1,))],
@@ -600,13 +633,53 @@ def auc(ctx, ins, attrs):
 
 @register_op("interpolate")
 def interpolate(ctx, ins, attrs):
+    """reference: operators/interpolate_op.cc — NCHW bilinear/nearest with
+    align_corners (default True) and align_mode (0 = half-pixel,
+    1 = asymmetric src = dst*scale) sampling conventions."""
     x = first(ins, "X")  # NCHW
     out_h = attrs.get("out_h")
     out_w = attrs.get("out_w")
     method = attrs.get("interp_method", "bilinear")
-    n, c = x.shape[0], x.shape[1]
-    o = jax.image.resize(x, (n, c, out_h, out_w),
-                         method="nearest" if method == "nearest" else "bilinear")
+    align_corners = attrs.get("align_corners", True)
+    align_mode = attrs.get("align_mode", 1)
+    n, c, h, w = x.shape
+
+    def src_coords(out_n, in_n):
+        if align_corners:
+            if out_n == 1:
+                return jnp.zeros((1,), jnp.float32)
+            return jnp.linspace(0.0, in_n - 1.0, out_n)
+        scale = in_n / out_n
+        d = jnp.arange(out_n, dtype=jnp.float32)
+        if align_mode == 0:
+            return (d + 0.5) * scale - 0.5
+        return d * scale
+
+    ys = jnp.clip(src_coords(out_h, h), 0, h - 1)
+    xs = jnp.clip(src_coords(out_w, w), 0, w - 1)
+    if method == "nearest":
+        # reference interpolate_op.h rounds half-up (int(x + 0.5)),
+        # not numpy's half-to-even
+        yi = (jnp.floor(ys + 0.5) if align_corners else jnp.floor(ys)
+              ).astype(jnp.int32)
+        xi = (jnp.floor(xs + 0.5) if align_corners else jnp.floor(xs)
+              ).astype(jnp.int32)
+        o = x[:, :, yi][:, :, :, xi]
+    else:
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0).astype(jnp.float32)[None, None, :, None]
+        wx = (xs - x0).astype(jnp.float32)[None, None, None, :]
+        xf = x.astype(jnp.float32)
+        g00 = xf[:, :, y0][:, :, :, x0]
+        g01 = xf[:, :, y0][:, :, :, x1]
+        g10 = xf[:, :, y1][:, :, :, x0]
+        g11 = xf[:, :, y1][:, :, :, x1]
+        top = g00 * (1 - wx) + g01 * wx
+        bot = g10 * (1 - wx) + g11 * wx
+        o = top * (1 - wy) + bot * wy
     return out(Out=o.astype(x.dtype))
 
 

@@ -31,7 +31,8 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                t_k):
     from jax.experimental import pallas as pl
 
     kb = pl.program_id(2)
@@ -58,12 +59,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         if bias_ref is not None:
             s = s + bias_ref[0, 0].astype(jnp.float32)
 
+        # Always mask k-positions past the true sequence length: when
+        # t_k % block_k != 0 the last k-block is padded and its garbage
+        # columns would otherwise corrupt the online softmax and lse.
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < t_k
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:]                 # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -71,8 +77,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)            # (block_q, block_k)
         alpha = jnp.exp(m_prev - m_new)   # (block_q, 1)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        # Zero padded v-rows: block padding is undefined memory and
+        # 0 * NaN would poison the accumulator even though p==0 there.
+        v_rows = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)
+        vv = jnp.where(v_rows < t_k, v_ref[0], 0)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
@@ -109,12 +120,12 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
         args.append(bias)
         kern = functools.partial(
             _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k)
+            block_k=block_k, t_k=t_k)
     else:
         def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, acc):
             _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, m, l,
                         acc, scale=scale, causal=causal, block_q=block_q,
-                        block_k=block_k)
+                        block_k=block_k, t_k=t_k)
 
     o, lse = pl.pallas_call(
         kern,

@@ -150,7 +150,7 @@ def sequence_pad(ctx, ins, attrs):
         (n, target) + (1,) * (x.ndim - 2))
     fill = pad_value.reshape(()) if pad_value is not None else 0.0
     o = x * m + fill * (1 - m)
-    return {"Out": [o], "Length": [seq_len.astype(jnp.int64)]}
+    return {"Out": [o], "Length": [seq_len.astype(jnp.int32)]}
 
 
 @register_op("sequence_unpad")

@@ -49,4 +49,4 @@ def hash_op(ctx, ins, attrs):
     v = v ^ (v >> 16)
     v = v * jnp.uint32(0x45D9F3B)
     v = v ^ (v >> 16)
-    return out(Out=(v % jnp.uint32(mod_by)).astype(jnp.int64))
+    return out(Out=(v % jnp.uint32(mod_by)).astype(jnp.int32))

@@ -128,7 +128,7 @@ class CompiledProgram:
         # replicated layout rather than reuse the sharded executable
         feed_sig = tuple(sorted(
             (n, str(s.spec)) for n, s in feed_shardings.items()))
-        key = (id(program), program._version, feed_sig,
+        key = (program._uid, program._version, feed_sig,
                tuple(fetch_names), state_names, id(self._mesh), iterations)
         entry = self._cache.get(key)
 

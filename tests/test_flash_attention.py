@@ -55,6 +55,23 @@ def test_flash_padding_bias():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("t,causal", [(320, False), (384, True), (320, True)])
+def test_flash_nondivisible_tk(t, causal):
+    """Regression: t_k % block_k != 0 must mask the padded k-tail
+    (ADVICE.md round-1 high finding)."""
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    rng = np.random.RandomState(3)
+    n, h, d = 1, 2, 128
+    q = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(n, h, t, d), jnp.float32)
+    got = _interpreted(fa, q, k, v, None, None, causal, block_k=256)
+    want = _ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_flash_grad_matches_reference():
     import paddle_tpu.ops.pallas.flash_attention as fa
 
@@ -87,7 +104,7 @@ def _noop():
     yield
 
 
-def _interpreted(fa, q, k, v, bias, scale, causal):
+def _interpreted(fa, q, k, v, bias, scale, causal, **kw_extra):
     """Run pallas_flash_attention with the kernel in interpret mode
     (pallas_call(interpret=True)) so it executes on the CPU backend."""
     from jax.experimental import pallas as pl
@@ -101,4 +118,4 @@ def _interpreted(fa, q, k, v, bias, scale, causal):
 
     with mock.patch.object(pl, "pallas_call", patched):
         return fa.pallas_flash_attention(q, k, v, bias=bias, scale=scale,
-                                         causal=causal)
+                                         causal=causal, **kw_extra)

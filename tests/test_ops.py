@@ -297,3 +297,50 @@ def test_conv2d_grad():
                attrs={"strides": [1, 1], "paddings": [1, 1],
                       "dilations": [1, 1]},
                out_slot="Output", max_relative_error=1e-2)
+
+
+def test_pool2d_with_index_argmax():
+    """Mask must contain real flattened-H*W argmax positions
+    (ADVICE.md round-1 finding)."""
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    attrs = {"ksize": [2, 2], "strides": [2, 2]}
+    outs = run_op("pool2d_with_index", {"X": x}, attrs=attrs)
+    mask = run_op("pool2d_with_index", {"X": x}, attrs=attrs,
+                  out_slot="Mask")
+    # numpy reference
+    want_o = np.zeros((2, 3, 3, 3), np.float32)
+    want_m = np.zeros((2, 3, 3, 3), np.int64)
+    for n in range(2):
+        for c in range(3):
+            for i in range(3):
+                for j in range(3):
+                    win = x[n, c, 2*i:2*i+2, 2*j:2*j+2]
+                    a = np.argmax(win)
+                    want_o[n, c, i, j] = win.flat[a]
+                    di, dj = divmod(a, 2)
+                    want_m[n, c, i, j] = (2*i + di) * 6 + (2*j + dj)
+    np.testing.assert_allclose(outs, want_o)
+    np.testing.assert_array_equal(mask, want_m)
+
+
+def test_interpolate_align_corners_bilinear():
+    """align_corners=True must use scale (in-1)/(out-1) — the reference
+    default (operators/interpolate_op.cc)."""
+    x = rng.randn(1, 1, 4, 4).astype(np.float32)
+    got = run_op("interpolate", {"X": x},
+                 attrs={"out_h": 7, "out_w": 7,
+                        "interp_method": "bilinear",
+                        "align_corners": True})
+    ys = np.linspace(0, 3, 7)
+    want = np.zeros((1, 1, 7, 7), np.float32)
+    for i, sy in enumerate(ys):
+        for j, sx in enumerate(ys):
+            y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+            y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+            wy, wx = sy - y0, sx - x0
+            want[0, 0, i, j] = (
+                x[0, 0, y0, x0] * (1-wy) * (1-wx)
+                + x[0, 0, y0, x1] * (1-wy) * wx
+                + x[0, 0, y1, x0] * wy * (1-wx)
+                + x[0, 0, y1, x1] * wy * wx)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
