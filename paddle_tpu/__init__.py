@@ -8,6 +8,7 @@ is JAX/XLA: programs compile to single fused TPU computations, parallelism
 is pjit/GSPMD over a device Mesh, and kernels are jnp/lax/Pallas.
 """
 
+from . import amp  # noqa: F401
 from . import clip  # noqa: F401
 from . import initializer  # noqa: F401
 from . import layers  # noqa: F401

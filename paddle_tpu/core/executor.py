@@ -100,12 +100,14 @@ def scope_guard(scope: Scope):
 # Program interpretation (used inside jit traces)
 # ---------------------------------------------------------------------------
 
-def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0):
+def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
+            amp_lists=None):
     """Interpret a straight-line op list over `env` (name → traced array).
 
     This runs under jax tracing: each op impl emits jaxpr; nothing executes
     eagerly.  Equivalent of the executor hot loop (executor.cc:448) but as a
-    trace, compiled once.
+    trace, compiled once.  With `amp_lists` set (paddle_tpu/amp.py), the
+    bf16 dtype policy is applied at each op boundary inside the trace.
     """
     for i, op in enumerate(ops):
         desc = op.desc
@@ -114,6 +116,10 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0):
             slot: [env[n] for n in names]
             for slot, names in desc.inputs.items()
         }
+        if amp_lists is not None:
+            from ..amp import cast_ins_for_op
+
+            ins = cast_ins_for_op(desc.type, ins, amp_lists)
         ctx = OpContext(rng_key, op_index=start_index + i)
         outs = impl(ctx, ins, desc.attrs)
         for slot, names in desc.outputs.items():
@@ -168,8 +174,10 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     import jax
 
     info = program._backward_info
+    amp_lists = getattr(program, "_amp_lists", None)
     if info is None:
-        return run_ops(prune_ops(program, fetch_names), env, rng_key)
+        return run_ops(prune_ops(program, fetch_names), env, rng_key,
+                       amp_lists=amp_lists)
     ops = program.global_block().ops
 
     k = info["index"]
@@ -180,7 +188,7 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     def fwd(params, base_env):
         e = dict(base_env)
         e.update(params)
-        run_ops(fwd_ops, e, rng_key)
+        run_ops(fwd_ops, e, rng_key, amp_lists=amp_lists)
         loss = e[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp
@@ -196,7 +204,8 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     for pname, g in grads.items():
         env[grad_var_name(pname)] = g
     # rest_ops[0] is the `backward_marker` op itself; skip it.
-    run_ops(rest_ops[1:], env, rng_key, start_index=k + 1)
+    run_ops(rest_ops[1:], env, rng_key, start_index=k + 1,
+            amp_lists=amp_lists)
     return env
 
 
@@ -296,13 +305,53 @@ class Executor:
                                 return_numpy=return_numpy,
                                 iterations=iterations)
 
-        block = program.global_block()
+        fn, state, feed_arrays = self._prepare(
+            program, feed, fetch_names, scope, iterations,
+            use_program_cache)
+        new_state, fetches = fn(state, feed_arrays)
+        for name, val in new_state.items():
+            scope.set_var(name, val)
+        _debug_checks(fetch_names, fetches, new_state)
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
+        return fetches
 
+    def close(self):
+        self._cache.clear()
+
+    def cost_analysis(self, program: Program, feed=None, fetch_list=None,
+                      scope: Optional[Scope] = None):
+        """XLA cost analysis of the compiled one-iteration step (flops,
+        bytes accessed).  TPU analog of the reference profiler's per-op
+        accounting — here the unit is the whole fused step.  Returns the
+        backend's dict (keys like 'flops', 'bytes accessed').  Note: the
+        analysis needs an AOT `.lower().compile()`, one extra XLA compile
+        beyond run()'s own jit cache (the jit-internal executable is not
+        introspectable); the traced step fn itself is shared via the
+        program cache."""
+        feed = dict(feed or {})
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        fn, state, feed_arrays = self._prepare(
+            program, feed, fetch_names, scope or global_scope(), 1, True)
+        compiled = fn.lower(state, feed_arrays).compile()
+        analyses = compiled.cost_analysis()
+        # PJRT returns one dict (or a list with one per executable)
+        if isinstance(analyses, (list, tuple)):
+            analyses = analyses[0]
+        return dict(analyses)
+
+    def _prepare(self, program: Program, feed, fetch_names, scope,
+                 iterations: int, use_program_cache: bool):
+        """Shared run()/cost_analysis() setup: RNG init, state gathering,
+        program-cache lookup, feed conversion."""
+        import jax
+
+        block = program.global_block()
         # Ensure RNG state exists whenever any op may need randomness.
         if RNG_STATE_VAR not in scope.vars:
             scope.set_var(RNG_STATE_VAR,
                           jax.random.PRNGKey(program.random_seed))
-
         state_names = tuple(sorted(
             v.name for v in block.vars.values()
             if v.persistable and scope.has_var(v.name)
@@ -316,23 +365,10 @@ class Executor:
                                      iterations)
             if use_program_cache:
                 self._cache[key] = fn
-
         state = {n: scope.find_var(n) for n in state_names}
         state[RNG_STATE_VAR] = scope.find_var(RNG_STATE_VAR)
-        feed_arrays = {
-            name: _to_array(value, block)
-            for name, value in feed.items()
-        }
-        new_state, fetches = fn(state, feed_arrays)
-        for name, val in new_state.items():
-            scope.set_var(name, val)
-        _debug_checks(fetch_names, fetches, new_state)
-        if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
-        return fetches
-
-    def close(self):
-        self._cache.clear()
+        feed_arrays = {n: _to_array(v, block) for n, v in feed.items()}
+        return fn, state, feed_arrays
 
     # -- compilation -----------------------------------------------------
     def _build_step_fn(self, program: Program, feed_names, fetch_names,

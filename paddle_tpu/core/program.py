@@ -341,6 +341,8 @@ class Program:
         # garbage collection and alias a stale cache entry).
         self._version = 0
         self._uid = next(Program._uid_counter)
+        # bf16 mixed-precision policy (paddle_tpu/amp.py); None = full f32.
+        self._amp_lists = None
         # Set by append_backward: index boundary and grad bookkeeping.
         self._backward_info: Optional[Dict[str, Any]] = None
 
@@ -387,6 +389,7 @@ class Program:
             blk.ops.append(Operator(blk, desc))
         if not for_test:
             p._backward_info = copy.deepcopy(self._backward_info)
+        p._amp_lists = self._amp_lists
         return p
 
     # --- serialization --------------------------------------------------
@@ -398,6 +401,10 @@ class Program:
             "params": [v.name for v in self.all_parameters()],
             "ops": [op.desc.to_dict() for op in self.global_block().ops],
             "backward_info": self._backward_info,
+            "amp": (None if self._amp_lists is None else {
+                "white": sorted(self._amp_lists.white_list),
+                "black": sorted(self._amp_lists.black_list),
+            }),
         }
 
     @staticmethod
@@ -415,6 +422,14 @@ class Program:
         for od in d["ops"]:
             blk.ops.append(Operator(blk, OpDesc.from_dict(od)))
         p._backward_info = d.get("backward_info")
+        amp = d.get("amp")
+        if amp is not None:
+            from ..amp import AutoMixedPrecisionLists
+
+            lists = AutoMixedPrecisionLists()
+            lists.white_list = set(amp["white"])
+            lists.black_list = set(amp["black"])
+            p._amp_lists = lists
         return p
 
     def __str__(self):

@@ -88,7 +88,8 @@ def resnet_cifar10(input, class_dim, depth=32, is_train=True):
 
 
 def build_model(dataset="flowers", depth=50, class_dim=1000,
-                learning_rate=0.01, with_optimizer=True, is_train=True):
+                learning_rate=0.01, with_optimizer=True, is_train=True,
+                use_amp=False):
     """reference benchmark/fluid/models/resnet.py get_model."""
     if dataset == "cifar10":
         dshape = [3, 32, 32]
@@ -107,6 +108,10 @@ def build_model(dataset="flowers", depth=50, class_dim=1000,
     if with_optimizer:
         opt = optimizer.MomentumOptimizer(learning_rate=learning_rate,
                                           momentum=0.9)
+        if use_amp:
+            from .. import amp as amp_mod
+
+            opt = amp_mod.decorate(opt)
         opt.minimize(avg_cost)
     return {"loss": avg_cost, "accuracy": batch_acc,
             "feeds": ["data", "label"], "predict": predict}

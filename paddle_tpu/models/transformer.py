@@ -207,7 +207,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
 def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_model=512, d_inner_hid=2048,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
-                with_optimizer=True, label_smooth_eps=0.1, use_flash=False):
+                with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
+                use_amp=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
@@ -218,6 +219,10 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
             lr, layers.fill_constant([1], "float32", learning_rate))
         opt = optimizer.AdamOptimizer(learning_rate=lr, beta1=0.9,
                                       beta2=0.997, epsilon=1e-9)
+        if use_amp:
+            from .. import amp as amp_mod
+
+            opt = amp_mod.decorate(opt)
         opt.minimize(avg_cost)
     return {"loss": avg_cost, "logits": logits, "feeds": feeds}
 

@@ -128,7 +128,9 @@ def conv2d(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        # no preferred_element_type: the MXU accumulates bf16 convs in
+        # f32 internally, and a widened output dtype breaks the conv
+        # transpose rule under AD (f32 cotangent vs bf16 filter)
     )
     return {"Output": [o.astype(x.dtype)]}
 

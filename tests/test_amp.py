@@ -1,0 +1,95 @@
+"""bf16 mixed-precision policy (paddle_tpu/amp.py).
+
+Capability analog of the reference fp16 transpiler
+(paddle/contrib/float16/float16_transpiler.py): white-list compute in
+bf16, f32 master weights, f32 loss path.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build(use_amp):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[16], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=32, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if use_amp:
+            opt = fluid.amp.decorate(opt)
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+    return main, startup, scope, loss, exe
+
+
+def test_amp_marks_program():
+    main, _, _, _, _ = _build(True)
+    assert main._amp_lists is not None
+    assert "mul" in main._amp_lists.white_list
+    assert "softmax_with_cross_entropy" in main._amp_lists.black_list
+
+
+def test_amp_trains_and_matches_f32():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(32, 16).astype(np.float32),
+            "y": rng.randint(0, 4, (32, 1)).astype(np.int64)}
+
+    losses = {}
+    for use_amp in (False, True):
+        main, _, scope, loss, exe = _build(use_amp)
+        with fluid.scope_guard(scope):
+            vals = []
+            for _ in range(20):
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                vals.append(float(np.asarray(lv).reshape(-1)[0]))
+        losses[use_amp] = vals
+    # both train; bf16 path stays close to f32 (bf16 has ~3 decimal
+    # digits, so tolerance is loose but catches gross policy bugs)
+    assert losses[True][-1] < losses[True][0]
+    np.testing.assert_allclose(losses[True][0], losses[False][0],
+                               rtol=0.05)
+    np.testing.assert_allclose(losses[True][-1], losses[False][-1],
+                               rtol=0.25, atol=0.05)
+
+
+def test_amp_params_stay_f32():
+    main, _, scope, loss, exe = _build(True)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(8, 16).astype(np.float32),
+            "y": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=feed, fetch_list=[loss])
+        for p in main.all_parameters():
+            arr = scope.find_var(p.name)
+            assert str(np.asarray(arr).dtype) == "float32", p.name
+
+
+def test_amp_white_op_outputs_bf16():
+    """A forward-only program: fc (mul) output must be bf16 under amp."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        h = layers.fc(x, size=8, bias_attr=False)
+        main._amp_lists = fluid.amp.AutoMixedPrecisionLists()
+        exe = fluid.Executor()
+        exe.run(startup)
+        (hv,) = exe.run(main, feed={"x": np.ones((2, 8), np.float32)},
+                        fetch_list=[h], return_numpy=False)
+        assert str(hv.dtype) == "bfloat16"
+
+
+def test_amp_survives_serialization():
+    main, _, _, _, _ = _build(True)
+    d = main.to_dict()
+    assert d["amp"] is not None
+    p2 = fluid.Program.from_dict(d)
+    assert p2._amp_lists is not None
+    assert p2._amp_lists.white_list == main._amp_lists.white_list
