@@ -40,13 +40,17 @@ _DEFAULT_PEAK = 197e12
 
 
 def _peak_flops():
+    import sys
+
     import jax
 
     kind = jax.devices()[0].device_kind
     for key, val in _PEAK_FLOPS.items():
         if kind.startswith(key):
             return val, kind
-    return _DEFAULT_PEAK, kind
+    print(f"warning: unknown device kind {kind!r}; assuming v5e peak "
+          f"{_DEFAULT_PEAK/1e12:.0f} TFLOP/s for MFU", file=sys.stderr)
+    return _DEFAULT_PEAK, f"{kind} (assumed v5e peak)"
 
 
 def _timed_loop(exe, program, feed_dev, loss, steps, warmup):
@@ -96,6 +100,10 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                                          steps, warmup)
     imgs_per_sec = batch_size * steps / elapsed
     step_flops = float(cost.get("flops", 0.0))
+    if step_flops <= 0:
+        raise RuntimeError(
+            f"XLA cost_analysis returned no flops (keys: {sorted(cost)}); "
+            "refusing to report a fabricated MFU")
     peak, kind = _peak_flops()
     mfu = (step_flops * steps / elapsed) / peak
     return {
@@ -138,6 +146,10 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                                          steps, warmup)
     tokens_per_sec = batch_size * max_length * steps / elapsed
     step_flops = float(cost.get("flops", 0.0))
+    if step_flops <= 0:
+        raise RuntimeError(
+            f"XLA cost_analysis returned no flops (keys: {sorted(cost)}); "
+            "refusing to report a fabricated MFU")
     peak, kind = _peak_flops()
     mfu = (step_flops * steps / elapsed) / peak
     return {

@@ -389,7 +389,7 @@ class Program:
             blk.ops.append(Operator(blk, desc))
         if not for_test:
             p._backward_info = copy.deepcopy(self._backward_info)
-        p._amp_lists = self._amp_lists
+        p._amp_lists = copy.deepcopy(self._amp_lists)
         return p
 
     # --- serialization --------------------------------------------------

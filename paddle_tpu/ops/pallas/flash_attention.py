@@ -1,4 +1,4 @@
-"""Tiled flash-attention forward kernel (Pallas, TPU).
+"""Tiled flash-attention forward AND backward kernels (Pallas, TPU).
 
 Online-softmax attention: never materializes the (Tq, Tk) score matrix in
 HBM — q-blocks stream k/v-blocks through VMEM keeping running max /
@@ -6,10 +6,17 @@ normalizer / accumulator (the standard flash algorithm).  This is the
 modern TPU equivalent of the LoD no-padding efficiency story
 (SURVEY.md §5.7): padding positions are masked via an additive key bias.
 
-Forward runs in Pallas; backward is a custom-VJP recompute in plain XLA
-using the saved logsumexp (correct, O(Tq*Tk) memory in the backward —
-the Pallas backward kernel is a later-round upgrade; ring attention
-(parallel/ring_attention.py) is the long-context training path).
+The backward is also tiled (two kernels): dk/dv accumulates over q-blocks
+and dq over k-blocks, both recomputing p = exp(s - lse) from the saved
+logsumexp — end-to-end O(T) memory so long-context training never
+materializes the score matrix.  Score blocks are kept in (k, q)
+orientation in the backward so the per-q lse/delta vectors broadcast
+along the TPU lane dimension (no transposes in-kernel).
+
+Ring-attention support (parallel/ring_attention.py): the kernel takes
+dynamic global position offsets (SMEM scalars) so causal masking works
+across rotated k/v chunks, and can return the per-row logsumexp whose
+cotangent folds into the backward as ds = p*(dp - (delta - dlse)).
 
 Supported bias: additive key-padding bias broadcastable as (N, 1, 1, Tk),
 plus in-kernel causal masking.  Richer biases fall back to the XLA
@@ -22,6 +29,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Tuned on v5e (seq 2048, d 128): q=256/k=1024 beats the XLA-composed
 # attention; both dims are clamped to the actual sequence length.
@@ -30,7 +38,30 @@ DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+def _interpret() -> bool:
+    """Pallas kernels compile only on TPU; on the CPU backend (tests,
+    virtual meshes) run them through the Pallas interpreter so the same
+    code path is exercised everywhere."""
+    return jax.default_backend() != "tpu"
+
+
+def _pallas_call(*args, **kw):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(*args, interpret=_interpret(), **kw)
+
+
+def _offs(offs_ref):
+    """(q_off, k_off) global position offsets from the SMEM scalar input
+    (zero when no offsets were passed)."""
+    if offs_ref is None:
+        return 0, 0
+    return offs_ref[0, 0], offs_ref[0, 1]
+
+
+# -- forward ----------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, offs_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
                 t_k):
     from jax.experimental import pallas as pl
@@ -45,8 +76,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     qb = pl.program_id(1)
-    # causal: skip k-blocks strictly above the diagonal
-    run = (qb + 1) * block_q > kb * block_k if causal else True
+    q_off, k_off = _offs(offs_ref)
+    # causal: skip k-blocks strictly above the (offset) diagonal
+    run = (q_off + (qb + 1) * block_q > k_off + kb * block_k) \
+        if causal else True
 
     @pl.when(run)
     def _compute():
@@ -68,7 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            valid = valid & (q_pos >= k_pos)
+            valid = valid & (q_off + q_pos >= k_off + k_pos)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:]                 # (block_q, 1)
@@ -98,7 +131,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -114,20 +147,27 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
         pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
     ]
     args = [q, k, v]
-    if bias is not None:
+    has_bias = bias is not None
+    has_offs = offsets is not None
+    if has_bias:
         in_specs.append(
             pl.BlockSpec((1, 1, 1, block_k), lambda h, i, j: (h, 0, 0, j)))
         args.append(bias)
-        kern = functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, t_k=t_k)
-    else:
-        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, acc):
-            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, m, l,
-                        acc, scale=scale, causal=causal, block_q=block_q,
-                        block_k=block_k, t_k=t_k)
+    if has_offs:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(offsets)
 
-    o, lse = pl.pallas_call(
+    def kern(*refs):
+        n_in = 3 + has_bias + has_offs
+        ins, outs = refs[:n_in], refs[n_in:]
+        q_r, k_r, v_r = ins[:3]
+        b_r = ins[3] if has_bias else None
+        of_r = ins[3 + has_bias] if has_offs else None
+        _fwd_kernel(q_r, k_r, v_r, b_r, of_r, *outs, scale=scale,
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    t_k=t_k)
+
+    o, lse = _pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
@@ -148,42 +188,302 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
     return o, lse[:, 0, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
-    return o
+# -- backward kernels -------------------------------------------------------
+#
+# Standard flash backward math, recomputing p from the saved lse:
+#   p  = exp(s - lse);      dv = p^T do;       dp = do v^T
+#   ds = p * (dp - delta),  delta = rowsum(do * o) - dlse
+#   dq = scale * ds k;      dk = scale * ds^T q;   db = sum_q ds
+# Score blocks are held transposed, sT: (block_k, block_q), so the per-q
+# vectors (lse, delta) broadcast along lanes.
 
+def _bwd_p_ds(q, k, v, do, lse_row, delta_row, bias_col, q_off, k_off, *,
+              scale, causal, kb, qb, block_q, block_k, t_q, t_k):
+    """Shared (block_k, block_q)-oriented recompute of p and ds.
 
-def _flash_vjp_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
-    return o, (q, k, v, bias, o, lse)
-
-
-def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, bias, o, lse = res
-    # Recompute-based backward (standard flash bwd math, XLA-fused):
-    # p = exp(s - lse); dv = p^T do; dp = do v^T;
-    # ds = p * (dp - rowsum(do*o)); dq = ds k; dk = ds^T q.
-    s = jnp.einsum("hqd,hkd->hqk", q, k).astype(jnp.float32) * scale
-    if bias is not None:
-        s = s + bias[:, 0].astype(jnp.float32)
+    q/do must already have invalid rows zeroed by the caller; invalid
+    (padded) score positions are masked here via `valid`, never letting
+    undefined block padding reach an accumulator (0 * NaN poisons).
+    ds is d(loss)/d(s_with_bias): unscaled — the q/k grads multiply by
+    `scale` at their accumulation (chain rule through s = scale*qk^T),
+    while the bias grad uses ds directly."""
+    sT = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if bias_col is not None:
+        sT = sT + bias_col                  # (block_k, 1) over lanes
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1)
+    valid = (k_pos < t_k) & (q_pos < t_q)
     if causal:
-        t_q, t_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((t_q, t_k), jnp.bool_))
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    do_f = do.astype(jnp.float32)
-    dv = jnp.einsum("hqk,hqd->hkd", p, do_f)
-    dp = jnp.einsum("hqd,hkd->hqk", do_f, v.astype(jnp.float32))
-    delta = jnp.sum(do_f * o.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("hqk,hkd->hqd", ds, k.astype(jnp.float32)) * scale
-    dk = jnp.einsum("hqk,hqd->hkd", ds, q.astype(jnp.float32)) * scale
-    dbias = None
-    if bias is not None:
-        db = jnp.sum(ds, axis=1)[:, None, None, :]  # sum over q
-        dbias = db.astype(bias.dtype)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias
+        valid = valid & (q_off + q_pos >= k_off + k_pos)
+    p = jnp.where(valid, jnp.exp(sT - lse_row), 0.0)
+    dp = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = jnp.where(valid, p * (dp - delta_row), 0.0)
+    return p, ds
+
+
+def _row_clean(ref, base, limit, block):
+    """Load a (block, d) tile zeroing rows at absolute position >= limit
+    (undefined padding of the final block)."""
+    x = ref[0]
+    rows = base + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+    return jnp.where(rows < limit, x, 0)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    bias_ref, offs_ref, dk_ref, dv_ref, db_ref, dk_scr,
+                    dv_scr, db_scr, *, scale, causal, block_q, block_k,
+                    t_q, t_k):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        if db_scr is not None:
+            db_scr[:] = jnp.zeros_like(db_scr)
+
+    q_off, k_off = _offs(offs_ref)
+    # causal: this k-block sees no q-block strictly below the diagonal
+    run = (q_off + (qb + 1) * block_q > k_off + kb * block_k) \
+        if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = _row_clean(q_ref, qb * block_q, t_q, block_q)
+        do = _row_clean(do_ref, qb * block_q, t_q, block_q)
+        k = k_ref[0]
+        v = v_ref[0]
+        bias_col = None if bias_ref is None else \
+            bias_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), do.astype(jnp.float32),
+            lse_ref[0][None, :], delta_ref[0][None, :], bias_col,
+            q_off, k_off, scale=scale, causal=causal, kb=kb, qb=qb,
+            block_q=block_q, block_k=block_k, t_q=t_q, t_k=t_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if db_scr is not None:
+            db_scr[:] += jnp.sum(ds, axis=1, keepdims=True)
+
+    @pl.when(qb == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        if db_ref is not None:
+            db_ref[0] = db_scr[:].astype(db_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   bias_ref, offs_ref, dq_ref, dq_scr, *, scale, causal,
+                   block_q, block_k, t_q, t_k):
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_off, k_off = _offs(offs_ref)
+    run = (q_off + (qb + 1) * block_q > k_off + kb * block_k) \
+        if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = _row_clean(q_ref, qb * block_q, t_q, block_q)
+        do = _row_clean(do_ref, qb * block_q, t_q, block_q)
+        k = _row_clean(k_ref, kb * block_k, t_k, block_k)
+        v = v_ref[0]
+        bias_col = None if bias_ref is None else \
+            bias_ref[0].astype(jnp.float32)
+        _, ds = _bwd_p_ds(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), do.astype(jnp.float32),
+            lse_ref[0][None, :], delta_ref[0][None, :], bias_col,
+            q_off, k_off, scale=scale, causal=causal, kb=kb, qb=qb,
+            block_q=block_q, block_k=block_k, t_q=t_q, t_k=t_k)
+        # dq[q,d] = scale * sum_k ds[k,q] * k[k,d]
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
+               block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    nq = pl.cdiv(t_q, block_q)
+    nk = pl.cdiv(t_k, block_k)
+
+    # delta = rowsum(do * o) - dlse: tiny (nh, t_q) XLA reduction.  The
+    # dlse term carries the cotangent of a returned lse (ring attention's
+    # online-softmax merge differentiates through lse).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    # bias arrives (nh, 1, 1, t_k); kernels want it as a (block_k, 1)
+    # column so it broadcasts over the lane (q) dimension
+    bias_t = None if bias is None else bias.reshape(nh, t_k, 1)
+    has_bias = bias_t is not None
+    has_offs = offsets is not None
+
+    def specs(order):
+        """order: 'kq' → grid (h, kb, qb); 'qk' → grid (h, qb, kb)."""
+        if order == "kq":
+            qi = lambda h, a, b: (h, b, 0)     # noqa: E731
+            ki = lambda h, a, b: (h, a, 0)     # noqa: E731
+            vi = lambda h, a, b: (h, b)        # noqa: E731  (lse/delta by q)
+            bi = lambda h, a, b: (h, a, 0)     # noqa: E731  (bias by k)
+        else:
+            qi = lambda h, a, b: (h, a, 0)     # noqa: E731
+            ki = lambda h, a, b: (h, b, 0)     # noqa: E731
+            vi = lambda h, a, b: (h, a)        # noqa: E731
+            bi = lambda h, a, b: (h, b, 0)     # noqa: E731
+        sp = [
+            pl.BlockSpec((1, block_q, d), qi),
+            pl.BlockSpec((1, block_k, d), ki),
+            pl.BlockSpec((1, block_k, d), ki),
+            pl.BlockSpec((1, block_q, d), qi),
+            pl.BlockSpec((1, block_q), vi),
+            pl.BlockSpec((1, block_q), vi),
+        ]
+        if has_bias:
+            sp.append(pl.BlockSpec((1, block_k, 1), bi))
+        if has_offs:
+            sp.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        return sp
+
+    args = [q, k, v, do, lse, delta]
+    if has_bias:
+        args.append(bias_t)
+    if has_offs:
+        args.append(offsets)
+    n_in = 6 + has_bias + has_offs
+
+    def unpack(refs):
+        ins = refs[:n_in]
+        b_r = ins[6] if has_bias else None
+        of_r = ins[6 + has_bias] if has_offs else None
+        return ins[:6], b_r, of_r, refs[n_in:]
+
+    # dk/dv (+db): grid (h, kb, qb), accumulate over q-blocks
+    def dkv_kern(*refs):
+        (q_r, k_r, v_r, do_r, lse_r, dl_r), b_r, of_r, rest = unpack(refs)
+        if has_bias:
+            dk_r, dv_r, db_r, dk_s, dv_s, db_s = rest
+        else:
+            dk_r, dv_r, dk_s, dv_s = rest
+            db_r = db_s = None
+        _bwd_dkv_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, b_r, of_r,
+                        dk_r, dv_r, db_r, dk_s, dv_s, db_s, scale=scale,
+                        causal=causal, block_q=block_q, block_k=block_k,
+                        t_q=t_q, t_k=t_k)
+
+    kq_out_specs = [
+        pl.BlockSpec((1, block_k, d), lambda h, a, b: (h, a, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, a, b: (h, a, 0)),
+    ]
+    kq_out_shape = [
+        jax.ShapeDtypeStruct((nh, t_k, d), q.dtype),
+        jax.ShapeDtypeStruct((nh, t_k, d), q.dtype),
+    ]
+    kq_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    if has_bias:
+        kq_out_specs.append(
+            pl.BlockSpec((1, block_k, 1), lambda h, a, b: (h, a, 0)))
+        kq_out_shape.append(
+            jax.ShapeDtypeStruct((nh, t_k, 1), jnp.float32))
+        kq_scratch.append(pltpu.VMEM((block_k, 1), jnp.float32))
+
+    dkv_out = _pallas_call(
+        dkv_kern,
+        grid=(nh, nk, nq),
+        in_specs=specs("kq"),
+        out_specs=kq_out_specs,
+        out_shape=kq_out_shape,
+        scratch_shapes=kq_scratch,
+    )(*args)
+    if has_bias:
+        dk, dv, db = dkv_out
+        dbias = db.reshape(nh, 1, 1, t_k).astype(bias.dtype)
+    else:
+        dk, dv = dkv_out
+        dbias = None
+
+    # dq: grid (h, qb, kb), accumulate over k-blocks
+    def dq_kern(*refs):
+        (q_r, k_r, v_r, do_r, lse_r, dl_r), b_r, of_r, rest = unpack(refs)
+        dq_r, dq_s = rest
+        _bwd_dq_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, b_r, of_r, dq_r,
+                       dq_s, scale=scale, causal=causal, block_q=block_q,
+                       block_k=block_k, t_q=t_q, t_k=t_k)
+
+    dq = _pallas_call(
+        dq_kern,
+        grid=(nh, nq, nk),
+        in_specs=specs("qk"),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, 0)),
+        out_shape=jax.ShapeDtypeStruct((nh, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )(*args)
+
+    return dq, dk, dv, dbias
+
+
+# -- custom VJP -------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, bias, offsets, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q,
+                      block_k)
+
+
+def _flash_vjp_fwd(q, k, v, bias, offsets, scale, causal, block_q,
+                   block_k):
+    o, lse = _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q,
+                        block_k)
+    return (o, lse), (q, k, v, bias, offsets, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, cts):
+    q, k, v, bias, offsets, o, lse = res
+    do, dlse = cts
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, offsets, o, lse, do,
+                                   dlse, scale, causal, block_q, block_k)
+    doffs = None if offsets is None else \
+        np.zeros(offsets.shape, dtype=jax.dtypes.float0)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias, doffs)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -191,8 +491,16 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def pallas_flash_attention(q, k, v, bias=None, scale=None, causal=False,
                            block_q=DEFAULT_BLOCK_Q,
-                           block_k=DEFAULT_BLOCK_K):
-    """q/k/v: (N, H, T, D); bias: None or broadcastable (N, 1, 1, Tk)."""
+                           block_k=DEFAULT_BLOCK_K,
+                           q_offset=None, k_offset=None,
+                           return_lse=False):
+    """q/k/v: (N, H, T, D); bias: None or broadcastable (N, 1, 1, Tk).
+
+    q_offset/k_offset: optional GLOBAL position offsets (python ints or
+    traced scalars) applied in causal masking — ring attention passes the
+    rotated chunk's origin so the causal structure survives sharding.
+    With return_lse=True also returns the per-row logsumexp (N, H, T),
+    differentiable (the dlse cotangent folds into the backward)."""
     n, h, t_q, d = q.shape
     t_k = k.shape[2]
     if scale is None:
@@ -200,10 +508,21 @@ def pallas_flash_attention(q, k, v, bias=None, scale=None, causal=False,
     if bias is not None:
         bias = jnp.broadcast_to(bias, (n, 1, 1, t_k))
         bias = jnp.repeat(bias, h, axis=1).reshape(n * h, 1, 1, t_k)
+    offsets = None
+    if q_offset is not None or k_offset is not None:
+        offsets = jnp.stack([
+            jnp.asarray(q_offset if q_offset is not None else 0,
+                        jnp.int32),
+            jnp.asarray(k_offset if k_offset is not None else 0,
+                        jnp.int32),
+        ]).reshape(1, 2)
 
     qf = q.reshape(n * h, t_q, d)
     kf = k.reshape(n * h, t_k, d)
     vf = v.reshape(n * h, t_k, d)
-    o = _flash(qf, kf, vf, bias, float(scale), bool(causal),
-               int(block_q), int(block_k))
-    return o.reshape(n, h, t_q, d)
+    o, lse = _flash(qf, kf, vf, bias, offsets, float(scale), bool(causal),
+                    int(block_q), int(block_k))
+    o = o.reshape(n, h, t_q, d)
+    if return_lse:
+        return o, lse.reshape(n, h, t_q)
+    return o

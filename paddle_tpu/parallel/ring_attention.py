@@ -59,20 +59,36 @@ def _merge(o_a, lse_a, o_b, lse_b):
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
-                   causal: bool = False):
+                   causal: bool = False, use_pallas=None):
     """q/k/v: GLOBAL (N, H, T, D) logically sharded over T on `axis`.
-    Returns the full attention output with the same sharding."""
+    Returns the full attention output with the same sharding.
+
+    use_pallas: route each rotated chunk through the tiled Pallas flash
+    kernel (forward AND backward O(t_local) memory, causal masking via
+    the kernel's global-offset scalars).  Default: auto (on for TPU)."""
     try:
         from jax import shard_map
     except ImportError:
         from jax.experimental.shard_map import shard_map
 
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
     n_dev = mesh.shape[axis]
     if scale is None:
         scale = q.shape[-1] ** -0.5
     t_total = q.shape[2]
     t_local = t_total // n_dev
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def chunk_attn(q_l, k_cur, v_cur, q_off, k_off):
+        if use_pallas:
+            from ..ops.pallas.flash_attention import pallas_flash_attention
+
+            return pallas_flash_attention(
+                q_l, k_cur, v_cur, scale=scale, causal=causal,
+                q_offset=q_off, k_offset=k_off, return_lse=True)
+        return _local_attention_with_lse(q_l, k_cur, v_cur, q_off, k_off,
+                                         scale, causal)
 
     def local_fn(q_l, k_l, v_l):
         idx = jax.lax.axis_index(axis)
@@ -83,8 +99,7 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
             # chunk j originated on device (idx - j) mod n_dev
             src = (idx - j) % n_dev
             k_off = src * t_local
-            o_j, lse_j = _local_attention_with_lse(
-                q_l, k_cur, v_cur, q_off, k_off, scale, causal)
+            o_j, lse_j = chunk_attn(q_l, k_cur, v_cur, q_off, k_off)
             o, lse = _merge(o, lse, o_j, lse_j)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
