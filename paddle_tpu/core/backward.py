@@ -75,11 +75,71 @@ def append_backward(loss: Variable,
     return params_grads
 
 
-def gradients(targets, inputs, target_gradients=None):
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """Grad of targets w.r.t. arbitrary input vars (fluid calc_gradient,
-    backward.py:613).  Executed eagerly by the Executor at fetch time via a
-    dedicated sub-program is future work; currently supports the common
-    parameter case through append_backward."""
-    raise NotImplementedError(
-        "calc_gradient-style arbitrary-input grads land with the "
-        "control-flow milestone; use append_backward for parameters")
+    backward.py:613).
+
+    Appends a `calc_gradient` macro op (ops/control_flow.py) that captures
+    the op span [0, here) of the current block; at trace time the span is
+    re-traced as a pure function of `inputs` and differentiated with
+    jax.vjp (XLA CSE merges the recomputed subgraph with the original).
+    Returns the gradient Variables, one per input (fetchable / composable
+    with further ops — double grad works by calling gradients() again on a
+    gradient output).
+    """
+    del no_grad_set  # jax.vjp only flows grads to `inputs` anyway
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    if target_gradients is None:
+        target_gradients = []
+    elif isinstance(target_gradients, Variable):
+        target_gradients = [target_gradients]
+    if target_gradients and len(target_gradients) != len(targets):
+        raise ValueError("target_gradients must match targets 1:1")
+
+    program = targets[0].block.program
+    block = program.current_block()
+    if block.idx != 0:
+        raise RuntimeError(
+            "gradients() inside a control-flow sub-block is not supported; "
+            "call it in the main block")
+    index = len(block.ops)
+
+    grad_vars = []
+    grad_names = []
+    for x in inputs:
+        g = block.create_var(
+            name=unique_grad_name(block, x.name), shape=x.shape,
+            dtype=x.dtype, stop_gradient=True)
+        grad_vars.append(g)
+        grad_names.append(g.name)
+
+    block.append_op(
+        type="calc_gradient",
+        inputs={"Targets": [t.name for t in targets],
+                "Inputs": [x.name for x in inputs],
+                "TargetGradients": [g.name for g in target_gradients]},
+        outputs={"InputGrads": grad_names},
+        attrs={"targets": [t.name for t in targets],
+               "inputs": [x.name for x in inputs],
+               "op_range": [0, index],
+               "block": block.idx},
+    )
+    return grad_vars
+
+
+def unique_grad_name(block, name: str) -> str:
+    """`<name>@GRAD`, uniquified if taken (a var can be differentiated by
+    both append_backward and gradients(), or by gradients() twice)."""
+    g = grad_var_name(name)
+    if not block.has_var(g):
+        return g
+    i = 1
+    while block.has_var(f"{g}_{i}"):
+        i += 1
+    return f"{g}_{i}"
+
+
+calc_gradient = gradients  # fluid exposes both names (backward.py:613)

@@ -101,27 +101,40 @@ def scope_guard(scope: Scope):
 # ---------------------------------------------------------------------------
 
 def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
-            amp_lists=None):
+            amp_lists=None, program=None):
     """Interpret a straight-line op list over `env` (name → traced array).
 
     This runs under jax tracing: each op impl emits jaxpr; nothing executes
     eagerly.  Equivalent of the executor hot loop (executor.cc:448) but as a
     trace, compiled once.  With `amp_lists` set (paddle_tpu/amp.py), the
     bf16 dtype policy is applied at each op boundary inside the trace.
+    Macro (control-flow) ops receive the whole env + their OpDesc and lower
+    sub-blocks to lax primitives (ops/control_flow.py).
     """
+    from .registry import get_macro_op_impl, is_macro_op
+
     for i, op in enumerate(ops):
         desc = op.desc
-        impl = get_op_impl(desc.type)
-        ins = {
-            slot: [env[n] for n in names]
-            for slot, names in desc.inputs.items()
-        }
-        if amp_lists is not None:
-            from ..amp import cast_ins_for_op
+        try:
+            if is_macro_op(desc.type):
+                ctx = OpContext(rng_key, op_index=start_index + i,
+                                program=program, amp_lists=amp_lists)
+                get_macro_op_impl(desc.type)(ctx, env, desc)
+                continue
+            impl = get_op_impl(desc.type)
+            ins = {
+                slot: [env[n] for n in names]
+                for slot, names in desc.inputs.items()
+            }
+            if amp_lists is not None:
+                from ..amp import cast_ins_for_op
 
-            ins = cast_ins_for_op(desc.type, ins, amp_lists)
-        ctx = OpContext(rng_key, op_index=start_index + i)
-        outs = impl(ctx, ins, desc.attrs)
+                ins = cast_ins_for_op(desc.type, ins, amp_lists)
+            ctx = OpContext(rng_key, op_index=start_index + i,
+                            program=program, amp_lists=amp_lists)
+            outs = impl(ctx, ins, desc.attrs)
+        except Exception as exc:
+            _reraise_with_op_context(exc, desc, start_index + i)
         for slot, names in desc.outputs.items():
             values = outs.get(slot, [])
             if len(values) != len(names):
@@ -132,6 +145,25 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
             for name, val in zip(names, values):
                 env[name] = val
     return env
+
+
+def _reraise_with_op_context(exc: Exception, desc, op_index: int):
+    """Attach op type/index/io context to trace-time failures — the
+    reference's PADDLE_ENFORCE discipline (platform/enforce.h) so a failing
+    op inside a 500-op program is locatable.  The original traceback is
+    preserved via exception chaining."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        raise exc
+    detail = (
+        f"error while tracing op[{op_index}] {desc.type!r} "
+        f"(inputs={desc.inputs}, outputs={desc.outputs}, "
+        f"attrs={ {k: v for k, v in desc.attrs.items() if not str(k).startswith('_')} })"
+    )
+    try:
+        new_exc = type(exc)(f"{detail}\n  caused by: {exc}")
+    except Exception:
+        new_exc = RuntimeError(f"{detail}\n  caused by: {exc!r}")
+    raise new_exc from exc
 
 
 def prune_ops(program: Program, fetch_names):
@@ -177,7 +209,7 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     amp_lists = getattr(program, "_amp_lists", None)
     if info is None:
         return run_ops(prune_ops(program, fetch_names), env, rng_key,
-                       amp_lists=amp_lists)
+                       amp_lists=amp_lists, program=program)
     ops = program.global_block().ops
 
     k = info["index"]
@@ -188,7 +220,7 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     def fwd(params, base_env):
         e = dict(base_env)
         e.update(params)
-        run_ops(fwd_ops, e, rng_key, amp_lists=amp_lists)
+        run_ops(fwd_ops, e, rng_key, amp_lists=amp_lists, program=program)
         loss = e[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp
@@ -205,7 +237,7 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
         env[grad_var_name(pname)] = g
     # rest_ops[0] is the `backward_marker` op itself; skip it.
     run_ops(rest_ops[1:], env, rng_key, start_index=k + 1,
-            amp_lists=amp_lists)
+            amp_lists=amp_lists, program=program)
     return env
 
 

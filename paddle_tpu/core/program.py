@@ -215,9 +215,10 @@ class Block:
     """A straight-line list of ops plus a var table.
 
     The reference uses nested blocks for control flow (while/cond sub-blocks,
-    framework.py:992); here structured control flow is expressed inside op
-    implementations via lax.scan/cond/while_loop, so a program is typically a
-    single global block.  The Block abstraction is kept for API parity.
+    framework.py:992); here control-flow *layers* (layers/control_flow.py)
+    build sub-blocks the same way, and the control-flow op impls
+    (ops/control_flow.py) lower them to lax.while_loop/scan/cond at trace
+    time.  Name lookup chases the parent chain like fluid's _var_recursive.
     """
 
     def __init__(self, program: "Program", idx: int = 0, parent_idx: int = -1):
@@ -226,6 +227,12 @@ class Block:
         self.parent_idx = parent_idx
         self.vars: Dict[str, Variable] = {}
         self.ops: List[Operator] = []
+
+    @property
+    def parent(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
 
     # --- vars -----------------------------------------------------------
     def create_var(self, name: Optional[str] = None, shape=(), dtype="float32",
@@ -260,13 +267,26 @@ class Block:
         return param
 
     def var(self, name: str) -> Variable:
-        v = self.vars.get(name)
-        if v is None:
-            raise KeyError(f"variable {name!r} not found in block {self.idx}")
-        return v
+        """Recursive lookup through the parent chain (fluid
+        framework.py Block._var_recursive)."""
+        b: Optional[Block] = self
+        while b is not None:
+            v = b.vars.get(name)
+            if v is not None:
+                return v
+            b = b.parent
+        raise KeyError(f"variable {name!r} not found in block {self.idx}")
+
+    def var_local(self, name: str) -> Optional[Variable]:
+        return self.vars.get(name)
 
     def has_var(self, name: str) -> bool:
-        return name in self.vars
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return True
+            b = b.parent
+        return False
 
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
@@ -334,6 +354,10 @@ class Program:
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
+        # Stack of block indices the builder is appending into; control-flow
+        # layers push sub-blocks (fluid framework.py Program._create_block /
+        # _rollback).
+        self._block_stack: List[int] = [0]
         self.random_seed: int = 0
         # Monotonic edit counter; the Executor uses (uid, version) as its
         # compile-cache key, so any mutation invalidates cached executables.
@@ -353,7 +377,23 @@ class Program:
         return self.blocks[0]
 
     def current_block(self) -> Block:
-        return self.blocks[-1]
+        return self.blocks[self._block_stack[-1]]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        """Create a sub-block of the current block and make it current
+        (fluid framework.py Program._create_block)."""
+        parent = self._block_stack[-1] if parent_idx is None else parent_idx
+        blk = Block(self, len(self.blocks), parent)
+        self.blocks.append(blk)
+        self._block_stack.append(blk.idx)
+        self._bump()
+        return blk
+
+    def _rollback(self):
+        """Pop back to the parent block (fluid Program._rollback)."""
+        if len(self._block_stack) <= 1:
+            raise RuntimeError("cannot roll back from the global block")
+        self._block_stack.pop()
 
     def all_parameters(self) -> List[Parameter]:
         return self.global_block().all_parameters()
@@ -369,24 +409,30 @@ class Program:
         fluid.Program.clone(for_test=True)."""
         p = Program()
         p.random_seed = self.random_seed
-        blk = p.global_block()
-        for name, var in self.global_block().vars.items():
-            desc = copy.deepcopy(var.desc)
-            if isinstance(var, Parameter):
-                nv = Parameter(blk, desc, regularizer=var.regularizer,
-                               gradient_clip_attr=var.gradient_clip_attr,
-                               learning_rate=var.learning_rate)
+        for src_blk in self.blocks:
+            if src_blk.idx == 0:
+                blk = p.global_block()
             else:
-                nv = Variable(blk, desc)
-            blk.vars[name] = nv
-        ops = self.global_block().ops
-        if for_test and self._backward_info is not None:
-            ops = ops[: self._backward_info["index"]]
-        for op in ops:
-            desc = copy.deepcopy(op.desc)
-            if for_test and "is_test" in _TEST_MODE_OPS.get(desc.type, ()):
-                desc.attrs["is_test"] = True
-            blk.ops.append(Operator(blk, desc))
+                blk = Block(p, src_blk.idx, src_blk.parent_idx)
+                p.blocks.append(blk)
+            for name, var in src_blk.vars.items():
+                desc = copy.deepcopy(var.desc)
+                if isinstance(var, Parameter):
+                    nv = Parameter(blk, desc, regularizer=var.regularizer,
+                                   gradient_clip_attr=var.gradient_clip_attr,
+                                   learning_rate=var.learning_rate)
+                else:
+                    nv = Variable(blk, desc)
+                blk.vars[name] = nv
+            ops = src_blk.ops
+            if (for_test and src_blk.idx == 0
+                    and self._backward_info is not None):
+                ops = ops[: self._backward_info["index"]]
+            for op in ops:
+                desc = copy.deepcopy(op.desc)
+                if for_test and "is_test" in _TEST_MODE_OPS.get(desc.type, ()):
+                    desc.attrs["is_test"] = True
+                blk.ops.append(Operator(blk, desc))
         if not for_test:
             p._backward_info = copy.deepcopy(self._backward_info)
         p._amp_lists = copy.deepcopy(self._amp_lists)
@@ -394,7 +440,7 @@ class Program:
 
     # --- serialization --------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "version": PROGRAM_FORMAT_VERSION,
             "random_seed": self.random_seed,
             "vars": [v.desc.to_dict() for v in self.global_block().vars.values()],
@@ -406,6 +452,19 @@ class Program:
                 "black": sorted(self._amp_lists.black_list),
             }),
         }
+        # Sub-blocks (control flow); block 0 stays in the legacy top-level
+        # keys so version-1 programs load unchanged.
+        if len(self.blocks) > 1:
+            d["sub_blocks"] = [
+                {
+                    "idx": b.idx,
+                    "parent_idx": b.parent_idx,
+                    "vars": [v.desc.to_dict() for v in b.vars.values()],
+                    "ops": [op.desc.to_dict() for op in b.ops],
+                }
+                for b in self.blocks[1:]
+            ]
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Program":
@@ -421,6 +480,13 @@ class Program:
                 blk.vars[desc.name] = Variable(blk, desc)
         for od in d["ops"]:
             blk.ops.append(Operator(blk, OpDesc.from_dict(od)))
+        for bd in d.get("sub_blocks", []):
+            sub = Block(p, bd["idx"], bd["parent_idx"])
+            p.blocks.append(sub)
+            for vd in bd["vars"]:
+                sub.vars[vd["name"]] = Variable(sub, VarDesc.from_dict(vd))
+            for od in bd["ops"]:
+                sub.ops.append(Operator(sub, OpDesc.from_dict(od)))
         p._backward_info = d.get("backward_info")
         amp = d.get("amp")
         if amp is not None:

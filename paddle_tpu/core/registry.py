@@ -18,6 +18,14 @@ OpImpl = Callable[..., Dict[str, List[Any]]]
 
 _REGISTRY: Dict[str, OpImpl] = {}
 
+# Macro ops are interpreter-level: their impls receive the whole environment
+# and the OpDesc (signature impl(ctx, env, desc) -> None, mutating env) so
+# they can trace sub-blocks into lax control-flow primitives.  TPU-native
+# analog of the reference's interpreter-level control-flow operators
+# (reference: paddle/fluid/operators/controlflow/while_op.cc:50 — ops that
+# run sub-blocks via a nested Executor).
+_MACRO_OPS: Dict[str, Any] = {}
+
 
 def register_op(op_type: str):
     """Decorator registering an implementation for `op_type`."""
@@ -29,6 +37,26 @@ def register_op(op_type: str):
         return fn
 
     return deco
+
+
+def register_macro_op(op_type: str):
+    """Decorator registering an interpreter-level (env + sub-block) op."""
+
+    def deco(fn):
+        if op_type in _MACRO_OPS or op_type in _REGISTRY:
+            raise ValueError(f"op {op_type!r} registered twice")
+        _MACRO_OPS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def is_macro_op(op_type: str) -> bool:
+    return op_type in _MACRO_OPS
+
+
+def get_macro_op_impl(op_type: str):
+    return _MACRO_OPS[op_type]
 
 
 def get_op_impl(op_type: str) -> OpImpl:
@@ -58,10 +86,15 @@ class OpContext:
     paddle/fluid/framework/operator.cc:943).
     """
 
-    def __init__(self, rng_key, op_index: int = 0, is_test: bool = False):
+    def __init__(self, rng_key, op_index: int = 0, is_test: bool = False,
+                 program=None, amp_lists=None):
         self._rng_key = rng_key
         self.op_index = op_index
         self.is_test = is_test
+        # Set when executing inside a Program trace; macro (control-flow)
+        # ops use these to locate and interpret their sub-blocks.
+        self.program = program
+        self.amp_lists = amp_lists
 
     def rng(self):
         """A PRNG key unique to this op within the step."""
@@ -72,3 +105,21 @@ class OpContext:
                 "op requested randomness but executor has no RNG state"
             )
         return jax.random.fold_in(self._rng_key, self.op_index)
+
+    def run_block(self, block_idx: int, env):
+        """Trace a sub-block's ops over `env` (mutated in place).  Used by
+        control-flow macro ops; the sub-block gets a distinct RNG stream so
+        per-op keys don't collide with the parent block's."""
+        import jax
+
+        from .executor import run_ops
+
+        if self.program is None:
+            raise RuntimeError("OpContext has no program; sub-block "
+                               "execution requires a program trace")
+        block = self.program.blocks[block_idx]
+        sub_key = (None if self._rng_key is None
+                   else jax.random.fold_in(self._rng_key, 7919 + block_idx))
+        run_ops(block.ops, env, sub_key, amp_lists=self.amp_lists,
+                program=self.program)
+        return env

@@ -36,8 +36,13 @@ def _decode_shape(shape):
 
 
 # Op types that the executor handles specially or whose impls can't be
-# abstractly evaluated; their outputs keep declared shapes.
-_SKIP_INFERENCE = {"backward_marker", "py_func", "print"}
+# abstractly evaluated; their outputs keep declared shapes.  Tensor-array
+# ops carry (buffer, length) tuples that ShapeDtypeStructs can't model.
+_SKIP_INFERENCE = {
+    "backward_marker", "py_func", "print",
+    "create_array", "array_write", "array_read", "array_length",
+    "array_to_tensor",
+}
 
 
 def infer_op_shapes(op_desc, block) -> bool:

@@ -73,7 +73,10 @@ class LayerHelper:
         return param
 
     def create_variable_for_type_inference(self, dtype) -> Variable:
-        return self.main_program.global_block().create_var(
+        # Temporaries live in the *current* block so layers called inside
+        # control-flow sub-blocks (While/StaticRNN bodies) stay local to
+        # them; parameters always live in the global block, as in fluid.
+        return self.main_program.current_block().create_var(
             name=unique_name.generate(f"{self.name}.tmp"),
             dtype=dtype,
         )
@@ -107,7 +110,7 @@ class LayerHelper:
 
     # -- op appending -----------------------------------------------------
     def append_op(self, **kwargs):
-        return self.main_program.global_block().append_op(**kwargs)
+        return self.main_program.current_block().append_op(**kwargs)
 
     def append_activation(self, input_var: Variable) -> Variable:
         act = self.kwargs.get("act")

@@ -27,6 +27,7 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
                            is_data=True, stop_gradient=stop_gradient,
                            lod_level=lod_level)
     if lod_level > 0:
-        block.create_var(name=f"{name}.seq_len", shape=[-1], dtype="int32",
-                         is_data=True, stop_gradient=True)
+        # lengths share the data var's batch dim (static when it is)
+        block.create_var(name=f"{name}.seq_len", shape=[full_shape[0]],
+                         dtype="int32", is_data=True, stop_gradient=True)
     return var

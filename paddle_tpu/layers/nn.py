@@ -28,9 +28,10 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
        act=None, is_test=False, name=None):
     """Fully-connected layer (reference layers/nn.py fc) — mul + sum +
     bias + activation."""
-    helper = LayerHelper("fc", name=name, act=act, bias_attr=bias_attr)
-    dtype = helper.input_dtype() if isinstance(input, list) else input.dtype
+    helper = LayerHelper("fc", name=name, act=act, bias_attr=bias_attr,
+                         input=input)
     inputs = input if isinstance(input, list) else [input]
+    dtype = inputs[0].dtype
 
     mul_results = []
     for inp in inputs:
@@ -55,7 +56,13 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": [pre_bias]})
     pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
-    return helper.append_activation(pre_act)
+    result = helper.append_activation(pre_act)
+    if num_flatten_dims >= 2 and not isinstance(input, list):
+        # sequence-preserving projection: keep the seq_len companion
+        from .sequence import _propagate_seq_len
+
+        _propagate_seq_len(input, result)
+    return result
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
@@ -71,7 +78,11 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper.append_op(
         type="lookup_table", inputs={"Ids": [input], "W": [w]},
         outputs={"Out": [out]},
-        attrs={"padding_idx": -1 if padding_idx is None else padding_idx})
+        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+               "is_sparse": bool(is_sparse)})
+    from .sequence import _propagate_seq_len
+
+    _propagate_seq_len(input, out)
     return out
 
 
@@ -145,32 +156,8 @@ def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
     return elementwise_op("elementwise_floordiv", x, y, axis, act, name)
 
 
-def less_than(x, y, force_cpu=None):
-    return elementwise_op("less_than", x, y, out_dtype="bool")
-
-
-def less_equal(x, y):
-    return elementwise_op("less_equal", x, y, out_dtype="bool")
-
-
-def greater_than(x, y):
-    return elementwise_op("greater_than", x, y, out_dtype="bool")
-
-
 def greater_equal(x, y):
     return elementwise_op("greater_equal", x, y, out_dtype="bool")
-
-
-def equal(x, y):
-    return elementwise_op("equal", x, y, out_dtype="bool")
-
-
-def not_equal(x, y):
-    return elementwise_op("not_equal", x, y, out_dtype="bool")
-
-
-def logical_and(x, y, out=None):
-    return elementwise_op("logical_and", x, y, out_dtype="bool")
 
 
 def logical_or(x, y, out=None):
@@ -179,6 +166,10 @@ def logical_or(x, y, out=None):
 
 def logical_xor(x, y, out=None):
     return elementwise_op("logical_xor", x, y, out_dtype="bool")
+
+# less_than / less_equal / greater_than / equal / not_equal / logical_and /
+# logical_not live in layers/control_flow.py (as in fluid) with the
+# cond=/out= write-into-var form that While loops need.
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
@@ -573,6 +564,14 @@ def reduce_min(input, dim=None, keep_dim=False, name=None):
 
 def reduce_prod(input, dim=None, keep_dim=False, name=None):
     return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", input, dim, keep_dim, name)
 
 
 def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):

@@ -17,7 +17,7 @@ from ..param_attr import ParamAttr
 
 def seq_len_var(x: Variable):
     """The companion length var of a sequence variable, if any."""
-    block = default_main_program().global_block()
+    block = default_main_program().current_block()
     name = f"{x.name}.seq_len"
     return block.var(name) if block.has_var(name) else None
 
@@ -26,7 +26,7 @@ def _propagate_seq_len(src: Variable, dst: Variable):
     sl = seq_len_var(src)
     if sl is None:
         return
-    block = default_main_program().global_block()
+    block = default_main_program().current_block()
     new = block.create_var(name=f"{dst.name}.seq_len", shape=sl.shape,
                            dtype=sl.dtype, stop_gradient=True)
     block.append_op(type="assign", inputs={"X": [sl]},

@@ -1,0 +1,424 @@
+"""Control-flow tests: While / Switch / IfElse / StaticRNN / DynamicRNN,
+tensor arrays, beam search, gradients().
+
+reference test pattern: python/paddle/fluid/tests/unittests/
+test_while_op.py, test_recurrent_op.py, test_dyn_rnn.py,
+test_beam_search_op.py, test_calc_gradient.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+@pytest.fixture()
+def exe():
+    return fluid.Executor()
+
+
+def test_while_sum(exe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=10)
+        acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(acc + layers.cast(i, "float32"), acc)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    acc_v, i_v = exe.run(main, fetch_list=[acc, i])
+    assert acc_v[0] == 45.0
+    assert i_v[0] == 10
+
+
+def test_while_with_array(exe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=5)
+        arr = layers.create_array("float32", element_shape=[2], capacity=8)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            v = layers.expand(layers.reshape(
+                layers.cast(i, "float32"), [1]), [2])
+            layers.array_write(v, i, arr)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        stacked, _ = layers.array_to_tensor(arr)
+        length = layers.array_length(arr)
+    s, ln = exe.run(main, fetch_list=[stacked, length])
+    np.testing.assert_allclose(s[:5, 0], np.arange(5, dtype=np.float32))
+    np.testing.assert_allclose(s[5:], 0.0)
+    assert ln[0] == 5
+
+
+def test_nested_while(exe):
+    # sum_{i<3} sum_{j<4} 1 == 12
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int32", 0)
+        ni = layers.fill_constant([1], "int32", 3)
+        total = layers.fill_constant([1], "float32", 0.0)
+        cond_o = layers.less_than(i, ni)
+        wo = layers.While(cond_o)
+        with wo.block():
+            j = layers.fill_constant([1], "int32", 0)
+            nj = layers.fill_constant([1], "int32", 4)
+            cond_i = layers.less_than(j, nj)
+            wi = layers.While(cond_i)
+            with wi.block():
+                layers.assign(total + 1.0, total)
+                layers.increment(j, 1)
+                layers.less_than(j, nj, cond=cond_i)
+            layers.increment(i, 1)
+            layers.less_than(i, ni, cond=cond_o)
+    (t,) = exe.run(main, fetch_list=[total])
+    assert t[0] == 12.0
+
+
+def test_switch_lr_schedule(exe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = layers.data("step", shape=[1], append_batch_size=False)
+        lr = layers.fill_constant([1], "float32", 0.0)
+        b1 = layers.fill_constant([1], "float32", 100.0)
+        b2 = layers.fill_constant([1], "float32", 200.0)
+        with layers.Switch() as sw:
+            with sw.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+            with sw.case(layers.less_than(step, b2)):
+                layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.001), lr)
+    for s, want in [(50.0, 0.1), (150.0, 0.01), (500.0, 0.001)]:
+        (v,) = exe.run(main, feed={"step": np.array([s], np.float32)},
+                       fetch_list=[lr])
+        assert v[0] == pytest.approx(want)
+
+
+def test_ifelse_per_row(exe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6, 1], append_batch_size=False)
+        zero = layers.fill_constant([6, 1], "float32", 0.0)
+        cond = layers.greater_than(x, zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(x), scale=2.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(x), scale=-1.0))
+        (out,) = ie()
+    xv = np.array([[-2.0], [3.0], [0.5], [-1.0], [0.0], [4.0]], np.float32)
+    (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    want = np.where(xv > 0, xv * 2.0, -xv)
+    np.testing.assert_allclose(o, want)
+
+
+def test_static_rnn_forward_and_grad(exe):
+    T, B, D, H = 5, 4, 3, 8
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+        h0 = layers.fill_constant([B, H], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.fc(input=[xt, h_prev], size=H, act="tanh",
+                          bias_attr=False)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = layers.reduce_mean(out)
+        opt = fluid.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    exe.run(startup2)
+    xv = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+    losses = [float(exe.run(main2, feed={"x": xv},
+                            fetch_list=[loss])[0]) for _ in range(6)]
+    # gradient flows through the scan: loss must move
+    assert losses[0] != losses[-1]
+    assert np.isfinite(losses).all()
+
+
+def test_static_rnn_cumsum_semantics(exe):
+    T, B, D = 4, 3, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+        z = layers.fill_constant([B, D], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            acc = rnn.memory(init=z)
+            s = layers.elementwise_add(acc, xt)
+            rnn.update_memory(acc, s)
+            rnn.step_output(s)
+        out = rnn()
+    xv = np.random.rand(T, B, D).astype(np.float32)
+    (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(o, np.cumsum(xv, axis=0), rtol=1e-6)
+
+
+def test_dynamic_rnn_masking(exe):
+    B, T, D = 3, 5, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False,
+                        lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            acc = drnn.memory(shape=[D], value=0.0)
+            s = layers.elementwise_add(acc, xt)
+            drnn.update_memory(acc, s)
+            drnn.output(s)
+        out = drnn()
+        last = layers.sequence_last_step(out)
+    xv = np.random.rand(B, T, D).astype(np.float32)
+    sl = np.array([2, 5, 3], np.int32)
+    o, lastv = exe.run(main, feed={"x": xv, "x.seq_len": sl},
+                       fetch_list=[out, last])
+    ref = np.cumsum(xv, axis=1)
+    for b, l in enumerate(sl):
+        ref[b, l:] = 0.0
+    np.testing.assert_allclose(o, ref, rtol=1e-6)
+    ref_last = np.stack([np.cumsum(xv, 1)[b, l - 1] for b, l in enumerate(sl)])
+    np.testing.assert_allclose(lastv, ref_last, rtol=1e-6)
+
+
+def test_dynamic_rnn_trains(exe):
+    B, T, D, H = 4, 6, 3, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False,
+                        lod_level=1)
+        y = layers.data("y", shape=[B, 1], append_batch_size=False)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            h_prev = drnn.memory(shape=[H], value=0.0)
+            h = layers.fc(input=[xt, h_prev], size=H, act="tanh",
+                          bias_attr=False)
+            drnn.update_memory(h_prev, h)
+            drnn.output(h)
+        out = drnn()
+        last = layers.sequence_last_step(out)
+        pred = layers.fc(last, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(B, T, D).astype(np.float32),
+            "x.seq_len": np.array([3, 6, 2, 5], np.int32),
+            "y": rng.rand(B, 1).astype(np.float32)}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_gradients_basic(exe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[3], append_batch_size=False)
+        y = layers.reduce_sum(layers.elementwise_mul(a, a))
+        (ga,) = fluid.gradients(y, a)
+    av = np.array([1.0, -2.0, 3.0], np.float32)
+    (g,) = exe.run(main, feed={"a": av}, fetch_list=[ga])
+    np.testing.assert_allclose(g, 2 * av, rtol=1e-6)
+
+
+def test_gradients_with_cotangent(exe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[3], append_batch_size=False)
+        w = layers.data("w", shape=[3], append_batch_size=False)
+        y = layers.elementwise_mul(a, a)
+        (ga,) = fluid.gradients([y], [a], target_gradients=[w])
+    av = np.array([1.0, 2.0, 3.0], np.float32)
+    wv = np.array([1.0, 0.0, 2.0], np.float32)
+    (g,) = exe.run(main, feed={"a": av, "w": wv}, fetch_list=[ga])
+    np.testing.assert_allclose(g, 2 * av * wv, rtol=1e-6)
+
+
+def test_gradients_wrt_intermediate_var(exe):
+    # grad w.r.t. a var that is itself produced by an op: the producer
+    # must not overwrite the traced binding (would silently yield zeros)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[3], append_batch_size=False)
+        b = layers.scale(a, scale=2.0)
+        y = layers.reduce_sum(layers.elementwise_mul(b, b))
+        (gb,) = fluid.gradients(y, b)
+    av = np.array([1.0, 2.0, 3.0], np.float32)
+    (g,) = exe.run(main, feed={"a": av}, fetch_list=[gb])
+    np.testing.assert_allclose(g, 2 * (2 * av), rtol=1e-6)  # dy/db = 2b
+
+
+def test_gradients_ignores_unrelated_unfed_branch(exe):
+    # ops off the inputs→targets path (over unfed data) must not be
+    # re-traced by calc_gradient
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[3], append_batch_size=False)
+        yy = layers.data("yy", shape=[4], append_batch_size=False)
+        _unused = layers.reduce_sum(yy)
+        t = layers.reduce_sum(layers.elementwise_mul(a, a))
+        (ga,) = fluid.gradients(t, a)
+    av = np.array([1.0, 2.0, 3.0], np.float32)
+    (g,) = exe.run(main, feed={"a": av}, fetch_list=[ga])
+    np.testing.assert_allclose(g, 2 * av, rtol=1e-6)
+
+
+def test_logical_wrappers_write_into_out():
+    # layers.logical_not/logical_and must be the control_flow (out=) forms,
+    # not the autogenerated unary wrappers (import-order shadowing guard)
+    assert layers.logical_not.__module__ == "paddle_tpu.layers.control_flow"
+    assert layers.less_than.__module__ == "paddle_tpu.layers.control_flow"
+
+
+def test_double_grad(exe):
+    # d2/dx2 sum(x^3) = 6x
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[3], append_batch_size=False)
+        y = layers.reduce_sum(
+            layers.elementwise_mul(layers.elementwise_mul(a, a), a))
+        (g1,) = fluid.gradients(y, a)      # 3x^2
+        s = layers.reduce_sum(g1)
+        (g2,) = fluid.gradients(s, a)      # 6x
+    av = np.array([1.0, 2.0, -1.0], np.float32)
+    g1v, g2v = exe.run(main, feed={"a": av}, fetch_list=[g1, g2])
+    np.testing.assert_allclose(g1v, 3 * av * av, rtol=1e-5)
+    np.testing.assert_allclose(g2v, 6 * av, rtol=1e-5)
+
+
+def test_beam_search_step(exe):
+    B, K, V = 2, 3, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pid = layers.data("pid", shape=[B, K], dtype="int64",
+                          append_batch_size=False)
+        psc = layers.data("psc", shape=[B, K], append_batch_size=False)
+        sc = layers.data("sc", shape=[B, K, V], append_batch_size=False)
+        ids, scores, parent = layers.beam_search(pid, psc, sc, beam_size=K,
+                                                 end_id=1)
+    rng = np.random.RandomState(0)
+    pidv = np.zeros((B, K), np.int64)
+    pscv = rng.rand(B, K).astype(np.float32)
+    scv = np.log(rng.dirichlet(np.ones(V), size=(B, K))).astype(np.float32)
+    idv, scov, parv = exe.run(
+        main, feed={"pid": pidv, "psc": pscv, "sc": scv},
+        fetch_list=[ids, scores, parent])
+    # numpy reference: top-k of pre_scores + logp over (K*V)
+    flat = (pscv[:, :, None] + scv).reshape(B, K * V)
+    order = np.argsort(-flat, axis=1)[:, :K]
+    np.testing.assert_allclose(np.sort(scov, 1),
+                               np.sort(np.take_along_axis(flat, order, 1), 1),
+                               rtol=1e-5)
+    np.testing.assert_array_equal(np.sort(parv, 1),
+                                  np.sort(order // V, 1))
+    np.testing.assert_array_equal(np.sort(idv, 1), np.sort(order % V, 1))
+
+
+def test_beam_search_finished_beams_frozen(exe):
+    B, K, V = 1, 2, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pid = layers.data("pid", shape=[B, K], dtype="int64",
+                          append_batch_size=False)
+        psc = layers.data("psc", shape=[B, K], append_batch_size=False)
+        sc = layers.data("sc", shape=[B, K, V], append_batch_size=False)
+        ids, scores, parent = layers.beam_search(pid, psc, sc, beam_size=K,
+                                                 end_id=1)
+    # beam 0 finished (id=1) with high score; it must survive unchanged
+    pidv = np.array([[1, 0]], np.int64)
+    pscv = np.array([[5.0, 0.0]], np.float32)
+    scv = np.full((B, K, V), -2.0, np.float32)
+    idv, scov, parv = exe.run(
+        main, feed={"pid": pidv, "psc": pscv, "sc": scv},
+        fetch_list=[ids, scores, parent])
+    assert idv[0, 0] == 1            # end token re-emitted
+    assert scov[0, 0] == pytest.approx(5.0)   # score frozen
+    assert parv[0, 0] == 0
+
+
+def test_beam_search_decode_backtrace(exe):
+    T, B, K = 3, 1, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[T, B, K], dtype="int64",
+                          append_batch_size=False)
+        par = layers.data("par", shape=[T, B, K], dtype="int32",
+                          append_batch_size=False)
+        sents = layers.beam_search_decode(ids, par, end_id=0)
+    # step0: beams pick tokens [5, 6]; step1 beam0<-parent1, beam1<-parent0;
+    # step2 both from parent 0
+    idv = np.array([[[5, 6]], [[7, 8]], [[9, 9]]], np.int64)
+    parv = np.array([[[0, 0]], [[1, 0]], [[0, 0]]], np.int32)
+    (s,) = exe.run(main, feed={"ids": idv, "par": parv}, fetch_list=[sents])
+    # hypothesis 0 at final step: t2 token 9 <- parent 0 (t1 token 7 beam0)
+    # t1 beam0 parent=1 -> t0 token 6
+    np.testing.assert_array_equal(s[0, 0], [6, 7, 9])
+    np.testing.assert_array_equal(s[0, 1], [6, 7, 9])
+
+
+def test_machine_translation_train_and_beam_decode(exe):
+    from paddle_tpu.models import machine_translation as mt
+
+    B, Tsrc, Ttrg, V = 4, 8, 7, 50
+    train_prog, train_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(train_prog, train_startup):
+        avg_cost, _feeds = mt.seq_to_seq_net(
+            src_vocab_size=V, trg_vocab_size=V, embed_dim=16, hidden_dim=32,
+            batch_size=B, max_src_len=Tsrc, max_trg_len=Ttrg)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+    exe.run(train_startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_word_id": rng.randint(2, V, (B, Tsrc)).astype(np.int64),
+        "src_word_id.seq_len": rng.randint(3, Tsrc + 1, B).astype(np.int32),
+        "trg_word_id": rng.randint(2, V, (B, Ttrg)).astype(np.int64),
+        "trg_word_id.seq_len": rng.randint(3, Ttrg + 1, B).astype(np.int32),
+        "trg_next_id": rng.randint(2, V, (B, Ttrg)).astype(np.int64),
+    }
+    losses = [float(exe.run(train_prog, feed=feed,
+                            fetch_list=[avg_cost])[0]) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+    K, L = 3, 6
+    infer_prog, infer_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer_prog, infer_startup):
+        sents, scores, _ifeeds = mt.beam_search_net(
+            src_vocab_size=V, trg_vocab_size=V, embed_dim=16, hidden_dim=32,
+            batch_size=B, max_src_len=Tsrc, beam_size=K, max_decode_len=L,
+            start_id=0, end_id=1)
+    out_s, out_sc = exe.run(
+        infer_prog,
+        feed={"src_word_id": feed["src_word_id"],
+              "src_word_id.seq_len": feed["src_word_id.seq_len"]},
+        fetch_list=[sents, scores])
+    assert out_s.shape == (B, K, L)
+    assert out_sc.shape == (B, K)
+    # beams are score-sorted per batch row
+    assert (np.diff(out_sc, axis=1) <= 1e-5).all()
+    assert np.isfinite(out_sc).all()
+
+
+def test_error_context_names_failing_op():
+    main, startup = fluid.Program(), fluid.Program()
+    exe = fluid.Executor()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        y = layers.data("y", shape=[5], append_batch_size=False)
+        z = layers.elementwise_add(x, y)  # shape mismatch at trace time
+    with pytest.raises(Exception) as ei:
+        exe.run(main, feed={"x": np.zeros(4, np.float32),
+                            "y": np.zeros(5, np.float32)},
+                fetch_list=[z])
+    assert "elementwise_add" in str(ei.value)
