@@ -201,8 +201,17 @@ def _split_params(program: Program, env: Dict[str, Any]):
 
 
 def interpret_program(program: Program, env: Dict[str, Any], rng_key,
-                      fetch_names=()):
-    """Run the full program (forward [+ backward + update ops]) over env."""
+                      fetch_names=(), accum_steps: int = 1,
+                      feed_names=()):
+    """Run the full program (forward [+ backward + update ops]) over env.
+
+    With accum_steps=K > 1, the feeds are split into K micro-batches along
+    dim 0 and the forward+backward runs as a lax.scan accumulating
+    (averaging) gradients before the optimizer ops execute once — the
+    TPU-native equivalent of the reference's batch-merge pass
+    (reference: paddle/fluid/framework/ir/multi_batch_merge_pass.cc:1,
+    which cloned the fwd/bwd subgraph K times and summed gradients).
+    """
     import jax
 
     info = program._backward_info
@@ -217,10 +226,10 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     fwd_ops, rest_ops = ops[:k], ops[k:]
     trainable = _split_params(program, env)
 
-    def fwd(params, base_env):
+    def fwd(params, base_env, key):
         e = dict(base_env)
         e.update(params)
-        run_ops(fwd_ops, e, rng_key, amp_lists=amp_lists, program=program)
+        run_ops(fwd_ops, e, key, amp_lists=amp_lists, program=program)
         loss = e[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp
@@ -228,10 +237,14 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
             loss = jnp.squeeze(loss)
         return loss, e
 
-    (loss_val, env_after), grads = jax.value_and_grad(fwd, has_aux=True)(
-        trainable, env
-    )
-    env = env_after
+    if accum_steps <= 1:
+        (loss_val, env_after), grads = jax.value_and_grad(
+            fwd, has_aux=True)(trainable, env, rng_key)
+        env = env_after
+    else:
+        loss_val, grads, env = _accumulate_gradients(
+            program, fwd, fwd_ops, trainable, env, rng_key,
+            accum_steps, feed_names, fetch_names, loss_name)
     env[grad_var_name(loss_name)] = loss_val * 0 + 1.0
     for pname, g in grads.items():
         env[grad_var_name(pname)] = g
@@ -239,6 +252,114 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     run_ops(rest_ops[1:], env, rng_key, start_index=k + 1,
             amp_lists=amp_lists, program=program)
     return env
+
+
+def _accumulate_gradients(program, fwd, fwd_ops, trainable, env, rng_key,
+                          accum_steps, feed_names, fetch_names, loss_name):
+    """K-micro-batch gradient accumulation as a lax.scan.
+
+    Feeds are reshaped (B, ...) → (K, B/K, ...); the scan body computes
+    per-micro-batch grads (each micro-step gets its own RNG stream so
+    dropout masks differ, like separate steps would).  Returns
+    (mean loss, mean grads, env) where env holds: forward activations from
+    a representative micro-batch for downstream ops, micro-averaged values
+    for fetched forward vars (batch-mean metrics stay correct), and
+    last-micro-batch values for persistable forward outputs (BN moving
+    stats follow the same last-wins rule as sequential steps).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    block = program.global_block()
+    feeds = {}
+    for n in feed_names:
+        if n not in env:
+            continue
+        v = env[n]
+        if v.ndim == 0 or v.shape[0] % accum_steps != 0:
+            raise ValueError(
+                f"gradient accumulation with {accum_steps} steps needs "
+                f"feed {n!r} batch dim divisible; got shape {v.shape}")
+        feeds[n] = v.reshape((accum_steps, v.shape[0] // accum_steps)
+                             + v.shape[1:])
+    if not feeds:
+        raise ValueError("gradient accumulation requires batched feeds")
+    base_env = {n: v for n, v in env.items() if n not in feeds}
+
+    fwd_out_names = set()
+    for op in fwd_ops:
+        fwd_out_names.update(op.desc.output_names())
+    # Vars the post-marker (optimizer/metric-update) ops read but the
+    # forward section produces — e.g. the lr-schedule value — must survive
+    # the scan; identical across micro-batches unless feed-dependent, so
+    # last-wins matches sequential-step semantics.
+    k = program._backward_info["index"]
+    rest_reads = set()
+    for op in block.ops[k + 1:]:
+        rest_reads.update(op.desc.input_names())
+    persist_written = sorted(
+        n for n in fwd_out_names
+        if (block.has_var(n) and block.var(n).persistable)
+        or n in rest_reads)
+    fetch_fwd = sorted(n for n in fetch_names
+                       if n in fwd_out_names and n != loss_name
+                       and n not in persist_written)
+
+    grad_fn = jax.value_and_grad(fwd, has_aux=True)
+    micro_b = next(iter(feeds.values())).shape[1]
+    # State-like names that pre-exist in env (BN moving stats) thread
+    # through the scan carry so K micro-batches compound K updates, exactly
+    # like K sequential steps (and multi_batch_merge_pass's K clones);
+    # names only computed inside the forward (the lr-schedule value) are
+    # surfaced via the scan outputs instead (last value).
+    carried = sorted(n for n in persist_written if n in env)
+    computed = sorted(n for n in persist_written if n not in env)
+
+    def body(carry, inp):
+        gacc, persist = carry
+        idx, mslice = inp
+        e_in = dict(base_env)
+        e_in.update(persist)
+        e_in.update(mslice)
+        key = jax.random.fold_in(rng_key, 31337 + idx)
+        (loss, e_after), grads = grad_fn(trainable, e_in, key)
+        gacc = jax.tree_util.tree_map(jnp.add, gacc, grads)
+        new_persist = {n: e_after[n] for n in carried}
+        ys = (loss, tuple(e_after[n] for n in fetch_fwd),
+              tuple(e_after[n] for n in computed))
+        return (gacc, new_persist), ys
+
+    gzero = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+    idxs = jnp.arange(accum_steps)
+    init_persist = {n: env[n] for n in carried}
+    (gsum, final_persist), (losses, fetch_stacks, computed_stacks) = \
+        jax.lax.scan(body, (gzero, init_persist), (idxs, feeds))
+    inv = 1.0 / accum_steps
+    grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
+    loss_val = jnp.mean(losses)
+
+    # Rebuild env for downstream (optimizer) ops: forward activations are
+    # not needed by them, but fetches and persistable updates are.
+    env = dict(base_env)
+    loss_decl = block.var(loss_name).shape if block.has_var(loss_name) else ()
+    env[loss_name] = (jnp.reshape(loss_val, loss_decl)
+                      if all(d > 0 for d in loss_decl) else loss_val)
+    for n, v in zip(fetch_fwd, fetch_stacks):
+        # v: (K, ...) stacked micro-batch values.  Per-example outputs
+        # (leading dim == micro batch) concatenate back to the full batch;
+        # batch-aggregate values (scalars/means) average — correct for
+        # equal-size micro-batches.
+        if v.ndim >= 2 and v.shape[1] == micro_b:
+            env[n] = v.reshape((-1,) + v.shape[2:])
+        else:
+            env[n] = jnp.mean(v, axis=0)
+    env.update(final_persist)
+    for n, v in zip(computed, computed_stacks):
+        env[n] = v[-1]
+    # keep full-batch feeds visible for any fetch of a feed var
+    for n in feeds:
+        env[n] = feeds[n].reshape((-1,) + feeds[n].shape[2:])
+    return loss_val, grads, env
 
 
 def _debug_checks(fetch_names, fetches, new_state):
@@ -311,7 +432,8 @@ class Executor:
             scope: Optional[Scope] = None,
             return_numpy: bool = True,
             use_program_cache: bool = True,
-            iterations: int = 1):
+            iterations: int = 1,
+            accumulation_steps: int = 1):
         from .program import default_main_program
 
         import jax
@@ -330,16 +452,18 @@ class Executor:
         if hasattr(program, "_program") and hasattr(program, "run"):
             return program.run(self, feed, fetch_names, scope,
                                return_numpy=return_numpy,
-                               iterations=iterations)
+                               iterations=iterations,
+                               accumulation_steps=accumulation_steps)
         compiled = getattr(program, "_compiled_wrapper", None)
         if compiled is not None:
             return compiled.run(self, feed, fetch_names, scope,
                                 return_numpy=return_numpy,
-                                iterations=iterations)
+                                iterations=iterations,
+                                accumulation_steps=accumulation_steps)
 
         fn, state, feed_arrays = self._prepare(
             program, feed, fetch_names, scope, iterations,
-            use_program_cache)
+            use_program_cache, accumulation_steps)
         new_state, fetches = fn(state, feed_arrays)
         for name, val in new_state.items():
             scope.set_var(name, val)
@@ -374,7 +498,8 @@ class Executor:
         return dict(analyses)
 
     def _prepare(self, program: Program, feed, fetch_names, scope,
-                 iterations: int, use_program_cache: bool):
+                 iterations: int, use_program_cache: bool,
+                 accumulation_steps: int = 1):
         """Shared run()/cost_analysis() setup: RNG init, state gathering,
         program-cache lookup, feed conversion."""
         import jax
@@ -389,12 +514,13 @@ class Executor:
             if v.persistable and scope.has_var(v.name)
         ))
         key = (program._uid, program._version, tuple(sorted(feed)),
-               tuple(fetch_names), state_names, iterations)
+               tuple(fetch_names), state_names, iterations,
+               accumulation_steps)
         fn = self._cache.get(key) if use_program_cache else None
         if fn is None:
             fn = self._build_step_fn(program, tuple(sorted(feed)),
                                      tuple(fetch_names), state_names,
-                                     iterations)
+                                     iterations, accumulation_steps)
             if use_program_cache:
                 self._cache[key] = fn
         state = {n: scope.find_var(n) for n in state_names}
@@ -404,7 +530,8 @@ class Executor:
 
     # -- compilation -----------------------------------------------------
     def _build_step_fn(self, program: Program, feed_names, fetch_names,
-                       state_names, iterations: int = 1):
+                       state_names, iterations: int = 1,
+                       accumulation_steps: int = 1):
         import jax
 
         persistable_names = tuple(sorted(
@@ -419,7 +546,9 @@ class Executor:
                         if k != RNG_STATE_VAR})
             env.update(feeds)
             env = interpret_program(program, env, rng_key,
-                                    fetch_names=fetch_names)
+                                    fetch_names=fetch_names,
+                                    accum_steps=accumulation_steps,
+                                    feed_names=feed_names)
             new_state = {
                 n: env[n] for n in persistable_names if n in env
             }

@@ -95,6 +95,24 @@ def logical_and(x, y, out=None):
     return out
 
 
+def logical_or(x, y, out=None):
+    helper = LayerHelper("logical_or")
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="logical_or", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def logical_xor(x, y, out=None):
+    helper = LayerHelper("logical_xor")
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="logical_xor", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
 def logical_not(x, out=None):
     helper = LayerHelper("logical_not")
     if out is None:

@@ -160,15 +160,8 @@ def greater_equal(x, y):
     return elementwise_op("greater_equal", x, y, out_dtype="bool")
 
 
-def logical_or(x, y, out=None):
-    return elementwise_op("logical_or", x, y, out_dtype="bool")
-
-
-def logical_xor(x, y, out=None):
-    return elementwise_op("logical_xor", x, y, out_dtype="bool")
-
-# less_than / less_equal / greater_than / equal / not_equal / logical_and /
-# logical_not live in layers/control_flow.py (as in fluid) with the
+# less_than / less_equal / greater_than / equal / not_equal and the
+# logical_* family live in layers/control_flow.py (as in fluid) with the
 # cond=/out= write-into-var form that While loops need.
 
 

@@ -13,6 +13,8 @@ from .collectives import (all_gather, all_reduce, all_to_all,  # noqa: F401
                           barrier, ppermute, psum, reduce_scatter)
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
+from .dist import (global_batch, init_distributed,  # noqa: F401
+                   make_multihost_mesh, shutdown_distributed)
 from .mesh import get_default_mesh, make_mesh, set_default_mesh  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

@@ -37,8 +37,14 @@ class BuildStrategy:
         self.sharding_rules: Optional[ShardingRules] = None
         self.memory_optimize = False  # XLA buffer liveness subsumes this
         self.enable_inplace = True
+        # multi-trainer (multi-host) topology; wired to jax.distributed by
+        # parallel/dist.py init_distributed (reference: nccl2 mode,
+        # parallel_executor.cc:254 num_trainers*ndev ranks)
         self.num_trainers = 1
         self.trainer_id = 0
+        # K-micro-batch gradient accumulation (reference:
+        # ir/multi_batch_merge_pass.cc)
+        self.gradient_accumulation_steps = 1
 
 
 class ExecutionStrategy:
@@ -59,6 +65,7 @@ class CompiledProgram:
         self._rules: Optional[ShardingRules] = None
         self._cache: Dict[Any, Any] = {}
         self._loss_name = None
+        self._accum_steps = 1
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -69,6 +76,8 @@ class CompiledProgram:
         self._mesh = mesh or get_default_mesh()
         self._batch_axis = batch_axis
         bs = build_strategy or BuildStrategy()
+        self._accum_steps = int(getattr(bs, "gradient_accumulation_steps",
+                                        1) or 1)
         if bs.sharding_rules is not None:
             self._rules = bs.sharding_rules
         elif bs.reduce_strategy == ReduceStrategy.Reduce:
@@ -101,8 +110,13 @@ class CompiledProgram:
 
     # -- execution -------------------------------------------------------
     def run(self, executor, feed: Dict[str, Any], fetch_names, scope,
-            return_numpy: bool = True, iterations: int = 1):
+            return_numpy: bool = True, iterations: int = 1,
+            accumulation_steps: int = 1):
         import jax
+
+        # an explicit per-run override wins over the BuildStrategy knob
+        accum = (accumulation_steps if accumulation_steps != 1
+                 else self._accum_steps)
 
         if self._mesh is None:
             # bare CompiledProgram(program): single-device compilation,
@@ -129,7 +143,8 @@ class CompiledProgram:
         feed_sig = tuple(sorted(
             (n, str(s.spec)) for n, s in feed_shardings.items()))
         key = (program._uid, program._version, feed_sig,
-               tuple(fetch_names), state_names, id(self._mesh), iterations)
+               tuple(fetch_names), state_names, id(self._mesh), iterations,
+               accum)
         entry = self._cache.get(key)
 
         state = {n: scope.find_var(n) for n in state_names}
@@ -141,12 +156,16 @@ class CompiledProgram:
             persistable_names = tuple(sorted(
                 v.name for v in block.vars.values() if v.persistable))
 
+            feed_names = tuple(sorted(feed))
+
             def step(st, feeds):
                 rng_key = st[RNG_STATE_VAR]
                 env = {k: v for k, v in st.items() if k != RNG_STATE_VAR}
                 env.update(feeds)
                 env = interpret_program(program, env, rng_key,
-                                        fetch_names=fetch_names)
+                                        fetch_names=fetch_names,
+                                        accum_steps=accum,
+                                        feed_names=feed_names)
                 new_state = {n: env[n] for n in persistable_names
                              if n in env}
                 new_state[RNG_STATE_VAR] = jax.random.split(rng_key, 1)[0]

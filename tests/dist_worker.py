@@ -1,0 +1,101 @@
+"""Multi-trainer worker used by test_dist.py (spawned as a subprocess).
+
+reference pattern: python/paddle/fluid/tests/unittests/test_dist_base.py:21
+— real localhost processes, RUN_STEP steps, losses pickled back to the
+parent for comparison against the single-process reference.
+"""
+
+import json
+import os
+import sys
+
+# Script-mode only (the test module also imports this file for build();
+# clobbering XLA_FLAGS there would shrink conftest's 8-device mesh):
+# one CPU device per trainer process.  XLA_FLAGS is read at backend init,
+# but the platform pin must go through jax.config — the environment's
+# sitecustomize imports jax before this script runs, freezing the
+# env-var default (same workaround as tests/conftest.py).
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.parallel import (global_batch, init_distributed,  # noqa: E402
+                                 make_mesh)
+
+RUN_STEP = 5
+LOCAL_B = 4
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2 * LOCAL_B, 4], append_batch_size=False)
+        y = layers.data("y", shape=[2 * LOCAL_B, 1], append_batch_size=False)
+        h = layers.fc(x, size=8, act="tanh",
+                      param_attr=fluid.ParamAttr(
+                          name="w1",
+                          initializer=fluid.initializer.Constant(0.3)),
+                      bias_attr=fluid.ParamAttr(
+                          name="b1",
+                          initializer=fluid.initializer.Constant(0.0)))
+        p = layers.fc(h, size=1,
+                      param_attr=fluid.ParamAttr(
+                          name="w2",
+                          initializer=fluid.initializer.Constant(0.1)),
+                      bias_attr=fluid.ParamAttr(
+                          name="b2",
+                          initializer=fluid.initializer.Constant(0.0)))
+        loss = layers.reduce_mean(layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    trainer_id = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    accum = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+
+    init_distributed(trainer_id=trainer_id, num_trainers=2,
+                     coordinator=coordinator)
+    assert jax.process_count() == 2, jax.process_count()
+    mesh = make_mesh({"dp": jax.device_count()})
+
+    main_prog, startup, loss = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    bs = fluid.BuildStrategy()
+    bs.num_trainers = 2
+    bs.trainer_id = trainer_id
+    bs.gradient_accumulation_steps = accum
+    compiled = fluid.CompiledProgram(main_prog).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs, mesh=mesh)
+
+    # deterministic global data; each trainer feeds its own half
+    rng = np.random.RandomState(7)
+    losses = []
+    for _step in range(RUN_STEP):
+        gx = rng.rand(2 * LOCAL_B, 4).astype("float32")
+        gy = rng.rand(2 * LOCAL_B, 1).astype("float32")
+        lo = trainer_id * LOCAL_B
+        feed = {"x": global_batch(mesh, gx[lo:lo + LOCAL_B]),
+                "y": global_batch(mesh, gy[lo:lo + LOCAL_B])}
+        (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    print("DIST_LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()
