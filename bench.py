@@ -166,10 +166,41 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
     }
 
 
+def bench_deepfm(batch_size: int, steps: int, warmup: int):
+    """DeepFM CTR config (BASELINE.json tracked set): examples/sec on the
+    sparse-embedding path (is_sparse lookups → SelectedRows-style grads,
+    lazy Adam row updates).  Gather/scatter-bound, so MFU against the MXU
+    peak is not the meaningful axis — throughput is."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deepfm
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_p, startup), fluid.scope_guard(scope):
+        model = deepfm.build_model()
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {k: jnp.asarray(v)
+                for k, v in deepfm.make_fake_batch(batch_size).items()}
+        elapsed, last_loss = _timed_loop(exe, main_p, feed, model["loss"],
+                                         steps, warmup)
+    _, kind = _peak_flops()
+    return {
+        "examples_per_sec": round(batch_size * steps / elapsed, 1),
+        "device": kind,
+        "batch_size": batch_size,
+        "steps": steps,
+        "sparse_grads": True,
+        "last_loss": last_loss,
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
-                   choices=["all", "resnet50", "transformer"])
+                   choices=["all", "resnet50", "transformer", "deepfm"])
     p.add_argument("--batch", type=int, default=0)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
@@ -186,16 +217,31 @@ def main():
         detail["transformer"] = bench_transformer(
             args.batch or 64, args.steps, args.warmup, use_amp=amp,
             use_flash=not args.no_flash)
+    if args.model in ("all", "deepfm"):
+        detail["deepfm"] = bench_deepfm(
+            args.batch or 4096, args.steps, args.warmup)
 
-    mfus = [d["mfu"] for d in detail.values()]
-    result = {
-        "metric": "min_train_mfu_resnet50_transformer"
-        if len(mfus) > 1 else f"{args.model}_train_mfu",
-        "value": round(min(mfus), 4),
-        "unit": "MFU (fraction of bf16 peak)",
-        "vs_baseline": round(min(mfus) / 0.35, 3),  # north-star >=0.35
-        "detail": detail,
-    }
+    # headline = min MFU across the MXU-bound headline models; the sparse
+    # deepfm config reports throughput in detail only
+    mfus = [d["mfu"] for d in detail.values() if "mfu" in d]
+    if mfus:
+        result = {
+            "metric": "min_train_mfu_resnet50_transformer"
+            if len(mfus) > 1 else f"{args.model}_train_mfu",
+            "value": round(min(mfus), 4),
+            "unit": "MFU (fraction of bf16 peak)",
+            "vs_baseline": round(min(mfus) / 0.35, 3),  # north-star >=0.35
+            "detail": detail,
+        }
+    else:
+        d = detail["deepfm"]
+        result = {
+            "metric": "deepfm_train_examples_per_sec",
+            "value": d["examples_per_sec"],
+            "unit": "examples/sec/chip",
+            "vs_baseline": 0.0,  # no reference-published CTR number
+            "detail": detail,
+        }
     print(json.dumps(result))
 
 

@@ -100,8 +100,14 @@ def scope_guard(scope: Scope):
 # Program interpretation (used inside jit traces)
 # ---------------------------------------------------------------------------
 
+# Optimizer ops with a SelectedRows-style sparse kernel (reference:
+# optimizers/*_op.h SelectedRows paths); every other op sees densified
+# gradients (reference analog: get_tensor_from_selected_rows).
+SPARSE_AWARE_OPS = {"sgd", "momentum", "adam", "adagrad"}
+
+
 def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
-            amp_lists=None, program=None):
+            amp_lists=None, program=None, sparse_rows=None):
     """Interpret a straight-line op list over `env` (name → traced array).
 
     This runs under jax tracing: each op impl emits jaxpr; nothing executes
@@ -112,6 +118,7 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
     sub-blocks to lax primitives (ops/control_flow.py).
     """
     from .registry import get_macro_op_impl, is_macro_op
+    from .selected_rows import densify
 
     for i, op in enumerate(ops):
         desc = op.desc
@@ -126,12 +133,16 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
                 slot: [env[n] for n in names]
                 for slot, names in desc.inputs.items()
             }
+            if desc.type not in SPARSE_AWARE_OPS:
+                ins = {slot: [densify(v) for v in vals]
+                       for slot, vals in ins.items()}
             if amp_lists is not None:
                 from ..amp import cast_ins_for_op
 
                 ins = cast_ins_for_op(desc.type, ins, amp_lists)
             ctx = OpContext(rng_key, op_index=start_index + i,
-                            program=program, amp_lists=amp_lists)
+                            program=program, amp_lists=amp_lists,
+                            sparse_rows=sparse_rows)
             outs = impl(ctx, ins, desc.attrs)
         except Exception as exc:
             _reraise_with_op_context(exc, desc, start_index + i)
@@ -226,10 +237,11 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     fwd_ops, rest_ops = ops[:k], ops[k:]
     trainable = _split_params(program, env)
 
-    def fwd(params, base_env, key):
+    def fwd(params, base_env, key, sparse_rows=None):
         e = dict(base_env)
         e.update(params)
-        run_ops(fwd_ops, e, key, amp_lists=amp_lists, program=program)
+        run_ops(fwd_ops, e, key, amp_lists=amp_lists, program=program,
+                sparse_rows=sparse_rows)
         loss = e[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp
@@ -237,11 +249,18 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
             loss = jnp.squeeze(loss)
         return loss, e
 
+    sparse_lookups = _find_sparse_lookups(fwd_ops, trainable, env)
     if accum_steps <= 1:
-        (loss_val, env_after), grads = jax.value_and_grad(
-            fwd, has_aux=True)(trainable, env, rng_key)
-        env = env_after
+        if sparse_lookups:
+            loss_val, grads, env = _sparse_value_and_grad(
+                fwd, fwd_ops, sparse_lookups, trainable, env, rng_key)
+        else:
+            (loss_val, env_after), grads = jax.value_and_grad(
+                fwd, has_aux=True)(trainable, env, rng_key)
+            env = env_after
     else:
+        # accumulation + sparse grads: dense fallback (SparseGrads don't
+        # zeros_like/add in the scan carry); correctness is identical
         loss_val, grads, env = _accumulate_gradients(
             program, fwd, fwd_ops, trainable, env, rng_key,
             accum_steps, feed_names, fetch_names, loss_name)
@@ -252,6 +271,77 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     run_ops(rest_ops[1:], env, rng_key, start_index=k + 1,
             amp_lists=amp_lists, program=program)
     return env
+
+
+def _find_sparse_lookups(fwd_ops, trainable, env):
+    """(op_index, table, ids_name, padding_idx) for every lookup_table op
+    eligible for the SelectedRows-style grad path: is_sparse=True, table
+    trainable, ids already in env (a feed/state var — ids computed by
+    earlier ops fall back to dense), and the table consumed by nothing
+    else (another consumer needs the dense grad for its own path, e.g.
+    weight-tied softmax)."""
+    candidates = []
+    table_lookup_ops = {}
+    for idx, op in enumerate(fwd_ops):
+        d = op.desc
+        if d.type == "lookup_table" and d.attrs.get("is_sparse"):
+            tbl = d.inputs["W"][0]
+            ids_n = d.inputs["Ids"][0]
+            if tbl in trainable and ids_n in env:
+                candidates.append(
+                    (idx, tbl, ids_n, d.attrs.get("padding_idx", -1)))
+                table_lookup_ops.setdefault(tbl, set()).add(idx)
+    if not candidates:
+        return []
+    ineligible = set()
+    for idx, op in enumerate(fwd_ops):
+        for tbl, own in table_lookup_ops.items():
+            if idx not in own and tbl in op.desc.input_names():
+                ineligible.add(tbl)
+    return [c for c in candidates if c[1] not in ineligible]
+
+
+def _sparse_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
+                           rng_key):
+    """Differentiate w.r.t. gathered embedding rows instead of whole
+    tables: the table grad materializes as SparseGrad (ids + rows),
+    O(touched) instead of O(vocab) — the SelectedRows capability
+    (reference: lookup_table_op.cc grad SelectedRows path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.sparse import gather_rows
+    from .selected_rows import SparseGrad
+
+    sparse_tables = {tbl for _i, tbl, _n, _p in sparse_lookups}
+    dense_trainable = {k: v for k, v in trainable.items()
+                       if k not in sparse_tables}
+    rows_init = {
+        idx: gather_rows(trainable[tbl], env[ids_n], pad)
+        for idx, tbl, ids_n, pad in sparse_lookups
+    }
+
+    def fwd_sparse(params_rows, base_env, key):
+        params, rows = params_rows
+        return fwd(params, base_env, key, sparse_rows=rows)
+
+    (loss_val, env_after), (dense_grads, rows_grads) = jax.value_and_grad(
+        fwd_sparse, has_aux=True)((dense_trainable, rows_init), env, rng_key)
+
+    grads = dict(dense_grads)
+    per_table = {}
+    for idx, tbl, ids_n, _pad in sparse_lookups:
+        d = trainable[tbl].shape[-1]
+        rows_g = rows_grads[idx].reshape(-1, d)
+        ids_flat = env[ids_n].reshape(-1).astype(jnp.int32)
+        per_table.setdefault(tbl, []).append((ids_flat, rows_g))
+    for tbl, pairs in per_table.items():
+        ids_c = (pairs[0][0] if len(pairs) == 1
+                 else jnp.concatenate([p[0] for p in pairs]))
+        rows_c = (pairs[0][1] if len(pairs) == 1
+                  else jnp.concatenate([p[1] for p in pairs]))
+        grads[tbl] = SparseGrad(ids_c, rows_c, trainable[tbl].shape)
+    return loss_val, grads, env_after
 
 
 def _accumulate_gradients(program, fwd, fwd_ops, trainable, env, rng_key,

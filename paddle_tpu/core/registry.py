@@ -87,7 +87,7 @@ class OpContext:
     """
 
     def __init__(self, rng_key, op_index: int = 0, is_test: bool = False,
-                 program=None, amp_lists=None):
+                 program=None, amp_lists=None, sparse_rows=None):
         self._rng_key = rng_key
         self.op_index = op_index
         self.is_test = is_test
@@ -95,6 +95,9 @@ class OpContext:
         # ops use these to locate and interpret their sub-blocks.
         self.program = program
         self.amp_lists = amp_lists
+        # op_index → pre-gathered embedding rows for the SelectedRows-style
+        # sparse grad path (core/executor.py, ops/sparse.py lookup_table)
+        self.sparse_rows = sparse_rows
 
     def rng(self):
         """A PRNG key unique to this op within the step."""

@@ -1,0 +1,86 @@
+"""SparseGrad: the TPU-native SelectedRows gradient.
+
+TPU-native analog of the reference's SelectedRows sparse tensor
+(reference: paddle/fluid/framework/selected_rows.h:32 — a rows-index +
+value-tensor pair produced by embedding backward and consumed by the
+optimizers' sparse update kernels, math/selected_rows_functor.h).
+
+A `lookup_table` op with is_sparse=True makes the Executor differentiate
+w.r.t. the *gathered rows* instead of the whole table (core/executor.py),
+so the table gradient materializes as (ids, rows) — O(touched rows), not
+O(vocab).  Optimizer ops with sparse support (sgd/momentum/adam/adagrad,
+ops/optim.py) apply scatter updates to the touched rows only, with
+duplicate ids merged by segment-sum exactly like the reference's
+MergeAdd functor (math/selected_rows_functor.h MergeAdd).  Any op without
+sparse support receives the densified gradient transparently
+(run_ops densifies on input).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseGrad:
+    """Gradient of an embedding table as touched rows.
+
+    rows: (N, D) float — gradient rows, one per lookup position (ids may
+          repeat; scatter-add semantics make that equivalent to the
+          summed gradient).
+    ids:  (N,) int32 — row indices into the table.
+    dense_shape: static (vocab, D) of the full table.
+    """
+
+    def __init__(self, ids, rows, dense_shape):
+        self.ids = ids
+        self.rows = rows
+        self.dense_shape = tuple(dense_shape)
+
+    def tree_flatten(self):
+        return (self.ids, self.rows), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        ids, rows = children
+        return cls(ids, rows, aux)
+
+    def to_dense(self):
+        """Scatter-add into a zeros table (what the dense VJP would have
+        produced)."""
+        table = jnp.zeros(self.dense_shape, dtype=self.rows.dtype)
+        return table.at[self.ids].add(self.rows)
+
+    def merged(self):
+        """(valid, ids, rows) with duplicate ids summed (reference
+        MergeAdd): sorted unique ids; `valid` masks real entries.  Invalid
+        slots carry id 0 and zero rows, so add-form scatters are no-ops."""
+        order = jnp.argsort(self.ids)
+        sid = self.ids[order]
+        srows = self.rows[order]
+        head = jnp.concatenate(
+            [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+        seg = jnp.cumsum(head) - 1
+        n = self.ids.shape[0]
+        merged_rows = jax.ops.segment_sum(srows, seg, num_segments=n)
+        # position of each segment's head in the sorted order
+        first_pos = jax.ops.segment_min(jnp.arange(n), seg, num_segments=n)
+        valid = jnp.arange(n) < seg[-1] + 1
+        merged_ids = jnp.where(valid, sid[jnp.clip(first_pos, 0, n - 1)], 0)
+        merged_rows = jnp.where(valid[:, None], merged_rows, 0.0)
+        return valid, merged_ids.astype(jnp.int32), merged_rows
+
+    def __repr__(self):
+        return (f"SparseGrad(ids={getattr(self.ids, 'shape', None)}, "
+                f"rows={getattr(self.rows, 'shape', None)}, "
+                f"dense_shape={self.dense_shape})")
+
+
+def densify(value):
+    """Pass arrays through; densify SparseGrads (used by run_ops for ops
+    without a sparse kernel — mirrors the reference's
+    get_tensor_from_selected_rows op)."""
+    if isinstance(value, SparseGrad):
+        return value.to_dense()
+    return value

@@ -27,8 +27,12 @@ def build_model(num_fields=26, num_dense=13, vocab_size=1000001,
                              dtype="float32")
     label = layers.data(name="label", shape=[1], dtype="int64")
 
-    # first-order: per-id scalar weight
-    w1 = layers.embedding(sparse_ids, size=[vocab_size, 1],
+    # first-order: per-id scalar weight.  is_sparse=True engages the
+    # SelectedRows-style grad path (core/executor.py): table grads are
+    # (ids, rows) and Adam updates only the touched rows — the capability
+    # the reference served with the distributed lookup table + sparse
+    # pserver updates.
+    w1 = layers.embedding(sparse_ids, size=[vocab_size, 1], is_sparse=True,
                           param_attr=ParamAttr(name="fm_w1",
                                                initializer=Normal(0, 1e-3)))
     first_order = layers.reduce_sum(layers.squeeze(w1, axes=[2]), dim=1,
@@ -38,7 +42,7 @@ def build_model(num_fields=26, num_dense=13, vocab_size=1000001,
 
     # second-order FM: 0.5 * ((sum v)^2 - sum v^2)
     emb = layers.embedding(
-        sparse_ids, size=[vocab_size, embedding_dim],
+        sparse_ids, size=[vocab_size, embedding_dim], is_sparse=True,
         param_attr=ParamAttr(
             name="fm_emb",
             initializer=Uniform(-1.0 / embedding_dim ** 0.5,

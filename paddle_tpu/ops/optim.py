@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SparseGrad
 from .common import first, opt_in, out
 
 
@@ -24,6 +25,11 @@ def _lr(ins):
 @register_op("sgd")
 def sgd(ctx, ins, attrs):
     p, g = first(ins, "Param"), first(ins, "Grad")
+    if isinstance(g, SparseGrad):
+        # SelectedRows path (reference: optimizers/sgd_op.h SelectedRows
+        # kernel): scatter-add only the touched rows; duplicate ids sum
+        # naturally.
+        return {"ParamOut": [p.at[g.ids].add(-_lr(ins) * g.rows)]}
     return {"ParamOut": [p - _lr(ins) * g]}
 
 
@@ -32,6 +38,19 @@ def momentum(ctx, ins, attrs):
     p, g, v = first(ins, "Param"), first(ins, "Grad"), first(ins, "Velocity")
     mu = attrs["mu"]
     lr = _lr(ins)
+    if isinstance(g, SparseGrad):
+        # lazy rows-only update with merged duplicates (reference:
+        # optimizers/momentum_op.h SparseMomentumFunctor)
+        valid, ids, rows = g.merged()
+        v_rows = mu * v[ids] + rows
+        if attrs.get("use_nesterov", False):
+            p_delta = -(rows + mu * v_rows) * lr
+        else:
+            p_delta = -lr * v_rows
+        keep = valid[:, None]
+        v_new = v.at[ids].add(jnp.where(keep, v_rows - v[ids], 0.0))
+        p_new = p.at[ids].add(jnp.where(keep, p_delta, 0.0))
+        return {"ParamOut": [p_new], "VelocityOut": [v_new]}
     v_new = mu * v + g
     if attrs.get("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -65,13 +84,28 @@ def adam(ctx, ins, attrs):
     beta2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    beta_pows = {"Beta1PowOut": [(b1p * beta1).reshape((1,))],
+                 "Beta2PowOut": [(b2p * beta2).reshape((1,))]}
+    if isinstance(g, SparseGrad):
+        # lazy sparse Adam with merged duplicate rows (reference:
+        # optimizers/adam_op.h SparseAdamFunctor over merged SelectedRows
+        # grad): moments and param update touch only the gradient's rows.
+        valid, ids, rows = g.merged()
+        m1r = beta1 * m1[ids] + (1 - beta1) * rows
+        m2r = beta2 * m2[ids] + (1 - beta2) * jnp.square(rows)
+        p_delta = -lr * m1r / (jnp.sqrt(m2r) + eps)
+        keep = valid[:, None]
+        m1n = m1.at[ids].add(jnp.where(keep, m1r - m1[ids], 0.0))
+        m2n = m2.at[ids].add(jnp.where(keep, m2r - m2[ids], 0.0))
+        p_new = p.at[ids].add(jnp.where(keep, p_delta, 0.0))
+        return {"ParamOut": [p_new], "Moment1Out": [m1n],
+                "Moment2Out": [m2n], **beta_pows}
     m1n = beta1 * m1 + (1 - beta1) * g
     m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
     p_new = p - lr * m1n / (jnp.sqrt(m2n) + eps)
     return {
         "ParamOut": [p_new], "Moment1Out": [m1n], "Moment2Out": [m2n],
-        "Beta1PowOut": [(b1p * beta1).reshape((1,))],
-        "Beta2PowOut": [(b2p * beta2).reshape((1,))],
+        **beta_pows,
     }
 
 
@@ -95,6 +129,16 @@ def adamax(ctx, ins, attrs):
 def adagrad(ctx, ins, attrs):
     p, g, m = first(ins, "Param"), first(ins, "Grad"), first(ins, "Moment")
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SparseGrad):
+        # reference: optimizers/adagrad_op.h SparseAdagradFunctor (merged
+        # duplicate rows, lazy row updates)
+        valid, ids, rows = g.merged()
+        m_rows = m[ids] + jnp.square(rows)
+        p_delta = -_lr(ins) * rows / (jnp.sqrt(m_rows) + eps)
+        keep = valid[:, None]
+        m_new = m.at[ids].add(jnp.where(keep, jnp.square(rows), 0.0))
+        p_new = p.at[ids].add(jnp.where(keep, p_delta, 0.0))
+        return {"ParamOut": [p_new], "MomentOut": [m_new]}
     m_new = m + jnp.square(g)
     p_new = p - _lr(ins) * g / (jnp.sqrt(m_new) + eps)
     return {"ParamOut": [p_new], "MomentOut": [m_new]}

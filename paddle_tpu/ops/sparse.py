@@ -14,17 +14,39 @@ from ..core.registry import register_op
 from .common import first, out
 
 
-@register_op("lookup_table")
-def lookup_table(ctx, ins, attrs):
-    ids, w = first(ins, "Ids"), first(ins, "W")
+def gather_rows(w, ids, padding_idx=-1):
+    """The lookup gather, shared by the op impl and the Executor's sparse
+    (SelectedRows) grad path, which differentiates w.r.t. these rows."""
     squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
     flat_ids = ids.reshape(ids.shape[:-1]) if squeeze_last else ids
     o = jnp.take(w, flat_ids.astype(jnp.int32), axis=0)
-    padding_idx = attrs.get("padding_idx", -1)
     if padding_idx is not None and padding_idx >= 0:
         mask = (flat_ids != padding_idx)[..., None]
         o = jnp.where(mask, o, 0.0)
-    return out(Out=o)
+    return o
+
+
+@register_op("lookup_table")
+def lookup_table(ctx, ins, attrs):
+    # Under the sparse-grad path the Executor pre-gathered this op's rows
+    # and differentiates w.r.t. them (core/executor.py); use them so the
+    # jaxpr depends on the rows leaf, not the full table.  The padding
+    # mask is re-applied HERE (not only at gather time) so AD zeroes the
+    # cotangent at padding positions — otherwise the padding row would
+    # accumulate gradient that the dense path correctly freezes out.
+    rows = None
+    if getattr(ctx, "sparse_rows", None) is not None:
+        rows = ctx.sparse_rows.get(ctx.op_index)
+    ids = first(ins, "Ids")
+    padding_idx = attrs.get("padding_idx", -1)
+    if rows is not None:
+        if padding_idx is not None and padding_idx >= 0:
+            flat_ids = (ids.reshape(ids.shape[:-1])
+                        if ids.ndim > 1 and ids.shape[-1] == 1 else ids)
+            rows = jnp.where((flat_ids != padding_idx)[..., None], rows, 0.0)
+        return out(Out=rows)
+    w = first(ins, "W")
+    return out(Out=gather_rows(w, ids, padding_idx))
 
 
 @register_op("shard_index")
