@@ -873,3 +873,191 @@ def _triple(v):
     if isinstance(v, (list, tuple)):
         return list(v)
     return [v, v, v]
+
+
+# ---------------------------------------------------------------------------
+# Structured-prediction / sampling losses (ops/structured.py)
+# reference: layers/nn.py nce:4023, hsigmoid:4171, warpctc:3646,
+# edit_distance:3566, sampling_id:7712; layers.linear_chain_crf /
+# crf_decoding live in fluid layers/nn.py:1453,1510.
+# ---------------------------------------------------------------------------
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """is_sparse is accepted for parity: on TPU the NCE weight grad stays
+    dense (only the sampled rows receive nonzero gradient anyway, and the
+    class count is the sampled-softmax small regime)."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(),
+            shape=[num_total_classes], dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    sampler_id = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler]
+    if custom_dist is not None:
+        import numpy as _np
+
+        from .tensor import assign as _assign
+
+        dist = _assign(_np.asarray(custom_dist, dtype="float32"))
+        inputs["CustomDistProbs"] = [dist]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(input.dtype)
+    sample_labels = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                 "SampleLabels": [sample_labels]},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": int(num_neg_samples or 10),
+               "sampler": sampler_id, "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(),
+            shape=[num_classes - 1], dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [cost], "PreOut": [pre_out]},
+                     attrs={"num_classes": int(num_classes)})
+    return cost
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """input: padded emissions (B, T, N) with a `.seq_len` companion."""
+    from .sequence import seq_len_var
+
+    helper = LayerHelper("linear_chain_crf")
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    sl = seq_len_var(input)
+    if sl is None:
+        raise ValueError("linear_chain_crf input needs a .seq_len "
+                         "companion (declare data with lod_level=1)")
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label], "SeqLen": [sl]},
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None):
+    from .sequence import _propagate_seq_len, seq_len_var
+
+    helper = LayerHelper("crf_decoding")
+    if isinstance(param_attr, Variable):
+        transition = param_attr
+    else:
+        attr = ParamAttr._to_attr(param_attr)
+        block = helper.main_program.global_block()
+        if attr.name and block.has_var(attr.name):
+            transition = block.var(attr.name)
+        else:
+            # decode-only program: declare the (trained) transition param
+            # so the scope value binds by name, as fluid does when the
+            # decode net is built separately from the train net
+            num_tags = input.shape[-1]
+            transition = helper.create_parameter(
+                attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    sl = seq_len_var(input)
+    if sl is None:
+        raise ValueError("crf_decoding input needs a .seq_len companion")
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition], "SeqLen": [sl]}
+    if label is not None:
+        ins["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path]})
+    _propagate_seq_len(input, path)
+    return path
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  name=None):
+    """input/label: padded id sequences (B, T) with .seq_len companions."""
+    from .sequence import seq_len_var
+
+    helper = LayerHelper("edit_distance", name=name)
+    hl, rl = seq_len_var(input), seq_len_var(label)
+    if hl is None or rl is None:
+        raise ValueError("edit_distance needs .seq_len companions on both "
+                         "input and label")
+    dist = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label], "HypsLen": [hl],
+                "RefsLen": [rl]},
+        outputs={"Out": [dist], "SequenceNum": [seq_num]},
+        attrs={"normalized": bool(normalized)})
+    return dist, seq_num
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """input: padded logits (B, T, C) w/ .seq_len; label: padded ids
+    (B, U) w/ .seq_len."""
+    from .sequence import seq_len_var
+
+    helper = LayerHelper("warpctc")
+    ll = seq_len_var(input)
+    ul = seq_len_var(label)
+    if ll is None or ul is None:
+        raise ValueError("warpctc needs .seq_len companions on logits "
+                         "and label")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label], "LogitsLen": [ll],
+                "LabelLen": [ul]},
+        outputs={"Loss": [loss]},
+        attrs={"blank": int(blank), "norm_by_times": bool(norm_by_times)})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax over classes then ctc_align (reference layers/nn.py
+    ctc_greedy_decoder:3704). input: (B, T, C) probs w/ .seq_len."""
+    from .sequence import _propagate_seq_len, seq_len_var
+
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = tensor_layers.argmax(input, axis=-1)
+    sl = seq_len_var(input)
+    decoded = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int32")
+    ins = {"Input": [ids]}
+    if sl is not None:
+        ins["SeqLen"] = [sl]
+    helper.append_op(type="ctc_align", inputs=ins,
+                     outputs={"Output": [decoded], "OutLen": [out_len]},
+                     attrs={"blank": int(blank), "merge_repeated": True})
+    return decoded, out_len
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"seed": seed})
+    return out

@@ -14,6 +14,7 @@ from . import optim  # noqa: F401
 from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import sparse  # noqa: F401
+from . import structured  # noqa: F401
 
 
 @register_op("backward_marker")

@@ -1,0 +1,434 @@
+"""Structured-loss op tests vs numpy references + a CRF tagging model
+convergence test (reference pattern: test_nce.py, test_hsigmoid_op.py,
+test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_edit_distance_op.py, test_warpctc_op.py; book model
+label_semantic_roles)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from tests.op_test import check_grad, run_op
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf
+# ---------------------------------------------------------------------------
+
+def _crf_nll_ref(emission, transition, label, seq_len):
+    """Brute-force: enumerate all tag paths (tiny N, T)."""
+    import itertools
+
+    B, T, N = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    out = np.zeros((B,))
+    for b in range(B):
+        L = seq_len[b]
+        scores = []
+        for path in itertools.product(range(N), repeat=L):
+            s = start[path[0]] + stop[path[-1]]
+            s += sum(emission[b, t, path[t]] for t in range(L))
+            s += sum(trans[path[t - 1], path[t]] for t in range(1, L))
+            scores.append(s)
+        logZ = np.log(np.sum(np.exp(np.asarray(scores))))
+        gold = label[b, :L]
+        g = start[gold[0]] + stop[gold[-1]]
+        g += sum(emission[b, t, gold[t]] for t in range(L))
+        g += sum(trans[gold[t - 1], gold[t]] for t in range(1, L))
+        out[b] = logZ - g
+    return out
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 4, 3
+    emission = rng.randn(B, T, N).astype(np.float32)
+    transition = rng.randn(N + 2, N).astype(np.float32) * 0.5
+    label = rng.randint(0, N, (B, T)).astype(np.int64)
+    seq_len = np.array([4, 2, 3], np.int32)
+    got = run_op("linear_chain_crf",
+                 {"Emission": emission, "Transition": transition,
+                  "Label": label, "SeqLen": seq_len},
+                 out_slot="LogLikelihood")
+    ref = _crf_nll_ref(emission, transition, label, seq_len)
+    np.testing.assert_allclose(got[:, 0], ref, rtol=1e-4)
+
+
+def test_linear_chain_crf_grad():
+    rng = np.random.RandomState(1)
+    B, T, N = 2, 3, 3
+    ins = {"Emission": rng.randn(B, T, N).astype(np.float32),
+           "Transition": (rng.randn(N + 2, N) * 0.5).astype(np.float32),
+           "Label": rng.randint(0, N, (B, T)).astype(np.int64),
+           "SeqLen": np.array([3, 2], np.int32)}
+    check_grad("linear_chain_crf", ins, "Emission",
+               out_slot="LogLikelihood")
+    check_grad("linear_chain_crf", ins, "Transition",
+               out_slot="LogLikelihood")
+
+
+def test_crf_decoding_matches_bruteforce():
+    import itertools
+
+    rng = np.random.RandomState(2)
+    B, T, N = 3, 4, 3
+    emission = rng.randn(B, T, N).astype(np.float32)
+    transition = (rng.randn(N + 2, N) * 0.5).astype(np.float32)
+    seq_len = np.array([4, 3, 2], np.int32)
+    got = run_op("crf_decoding",
+                 {"Emission": emission, "Transition": transition,
+                  "SeqLen": seq_len},
+                 out_slot="ViterbiPath")
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    for b in range(B):
+        L = seq_len[b]
+        best, best_s = None, -1e30
+        for path in itertools.product(range(N), repeat=L):
+            s = start[path[0]] + stop[path[-1]]
+            s += sum(emission[b, t, path[t]] for t in range(L))
+            s += sum(trans[path[t - 1], path[t]] for t in range(1, L))
+            if s > best_s:
+                best, best_s = path, s
+        np.testing.assert_array_equal(got[b, :L], best)
+        np.testing.assert_array_equal(got[b, L:], 0)
+
+
+def test_crf_tagging_model_converges():
+    """A tiny sequence-tagging model: emissions from an fc over one-hot
+    words trained with linear_chain_crf; decoded accuracy on the training
+    set must become perfect (reference book: label_semantic_roles)."""
+    B, T, V, N = 8, 6, 20, 4
+    rng = np.random.RandomState(3)
+    words = rng.randint(0, V, (B, T)).astype(np.int64)
+    tags = (words % N).astype(np.int64)  # learnable deterministic mapping
+    seq_len = rng.randint(3, T + 1, B).astype(np.int32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        w = layers.data("w", shape=[B, T], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        t = layers.data("t", shape=[B, T], dtype="int64",
+                        append_batch_size=False)
+        emb = layers.embedding(w, size=[V, 16],
+                               param_attr=fluid.ParamAttr(name="tag_emb"))
+        emission = layers.fc(emb, size=N, num_flatten_dims=2,
+                             param_attr=fluid.ParamAttr(name="tag_fc.w"),
+                             bias_attr=fluid.ParamAttr(name="tag_fc.b"))
+        nll = layers.linear_chain_crf(
+            emission, t, param_attr=fluid.ParamAttr(name="crf_w"))
+        loss = layers.reduce_mean(nll)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"w": words, "w.seq_len": seq_len, "t": tags}
+        losses = [float(exe.run(main, feed=feed,
+                                fetch_list=[loss])[0].reshape(()))
+                  for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+    # decode program built fresh, sharing params by name
+    infer_prog, infer_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer_prog, infer_startup), \
+            fluid.scope_guard(scope):
+        w = layers.data("w", shape=[B, T], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        emb = layers.embedding(w, size=[V, 16],
+                               param_attr=fluid.ParamAttr(name="tag_emb"))
+        emission = layers.fc(emb, size=N, num_flatten_dims=2,
+                             param_attr=fluid.ParamAttr(name="tag_fc.w"),
+                             bias_attr=fluid.ParamAttr(name="tag_fc.b"))
+        path = layers.crf_decoding(
+            emission, fluid.ParamAttr(name="crf_w"))
+        exe = fluid.Executor()
+        (decoded,) = exe.run(infer_prog,
+                             feed={"w": words, "w.seq_len": seq_len},
+                             fetch_list=[path])
+    correct = total = 0
+    for b in range(B):
+        L = seq_len[b]
+        correct += int((decoded[b, :L] == tags[b, :L]).sum())
+        total += int(L)
+    assert correct / total > 0.95, f"decode acc {correct/total:.2f}"
+
+
+# ---------------------------------------------------------------------------
+# hierarchical_sigmoid
+# ---------------------------------------------------------------------------
+
+def _hsigmoid_ref(x, label, w, b, num_classes):
+    B = x.shape[0]
+    out = np.zeros((B,))
+    for i in range(B):
+        code = int(label[i]) + num_classes
+        while code > 1:
+            bit = code & 1
+            node = (code >> 1) - 1
+            z = float(x[i] @ w[node] + b[node])
+            # BCE with target=bit on logit z
+            out[i] += np.log1p(np.exp(z)) - bit * z
+            code >>= 1
+    return out
+
+
+def test_hsigmoid_matches_reference():
+    rng = np.random.RandomState(4)
+    B, D, C = 5, 8, 7
+    x = rng.randn(B, D).astype(np.float32)
+    label = rng.randint(0, C, (B,)).astype(np.int64)
+    w = (rng.randn(C - 1, D) * 0.5).astype(np.float32)
+    b = rng.randn(C - 1).astype(np.float32)
+    got = run_op("hierarchical_sigmoid",
+                 {"X": x, "Label": label, "W": w, "Bias": b},
+                 attrs={"num_classes": C})
+    ref = _hsigmoid_ref(x, label, w, b, C)
+    np.testing.assert_allclose(got[:, 0], ref, rtol=1e-4)
+
+
+def test_hsigmoid_grad():
+    rng = np.random.RandomState(5)
+    B, D, C = 3, 4, 6
+    ins = {"X": rng.randn(B, D).astype(np.float32),
+           "Label": rng.randint(0, C, (B,)).astype(np.int64),
+           "W": (rng.randn(C - 1, D) * 0.5).astype(np.float32),
+           "Bias": rng.randn(C - 1).astype(np.float32)}
+    check_grad("hierarchical_sigmoid", ins, "X",
+               attrs={"num_classes": C})
+    check_grad("hierarchical_sigmoid", ins, "W",
+               attrs={"num_classes": C})
+
+
+# ---------------------------------------------------------------------------
+# NCE
+# ---------------------------------------------------------------------------
+
+def test_nce_runs_and_trains():
+    """NCE is stochastic (sampled negatives) — check forward sanity and
+    that a word2vec-style model's loss decreases."""
+    B, D, C = 16, 12, 50
+    rng = np.random.RandomState(6)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[B, D], append_batch_size=False)
+        lab = layers.data("lab", shape=[B, 1], dtype="int64",
+                          append_batch_size=False)
+        cost = layers.nce(x, lab, num_total_classes=C, num_neg_samples=8,
+                          sampler="uniform")
+        loss = layers.reduce_mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": rng.randn(B, D).astype(np.float32),
+                "lab": rng.randint(0, C, (B, 1)).astype(np.int64)}
+        losses = [float(exe.run(main, feed=feed,
+                                fetch_list=[loss])[0].reshape(()))
+                  for _ in range(40)]
+    assert np.isfinite(losses).all()
+    # negatives resample every step, so compare window means
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1))
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[m, n]
+
+
+def test_edit_distance_matches_reference():
+    rng = np.random.RandomState(7)
+    B, T1, T2 = 6, 8, 7
+    hyp = rng.randint(0, 5, (B, T1)).astype(np.int64)
+    ref = rng.randint(0, 5, (B, T2)).astype(np.int64)
+    hlen = rng.randint(1, T1 + 1, B).astype(np.int32)
+    rlen = rng.randint(1, T2 + 1, B).astype(np.int32)
+    got, seq_num = run_op(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref, "HypsLen": hlen, "RefsLen": rlen},
+        attrs={"normalized": False}, out_slot="Out", n_outs=1), \
+        run_op("edit_distance",
+               {"Hyps": hyp, "Refs": ref, "HypsLen": hlen,
+                "RefsLen": rlen},
+               attrs={"normalized": False}, out_slot="SequenceNum")
+    got = got[0]
+    for b in range(B):
+        want = _levenshtein(hyp[b, :hlen[b]].tolist(),
+                            ref[b, :rlen[b]].tolist())
+        assert got[b, 0] == want, (b, got[b, 0], want)
+    assert seq_num[0] == B
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def test_warpctc_simple_case():
+    """T=1, one label: loss = -log softmax(logits)[label]."""
+    logits = np.array([[[2.0, 1.0, 0.5]]], np.float32)  # (1, 1, 3)
+    label = np.array([[1]], np.int64)
+    got = run_op("warpctc",
+                 {"Logits": logits, "Label": label,
+                  "LogitsLen": np.array([1], np.int32),
+                  "LabelLen": np.array([1], np.int32)},
+                 attrs={"blank": 0}, out_slot="Loss")
+    p = np.exp(logits[0, 0]) / np.exp(logits[0, 0]).sum()
+    np.testing.assert_allclose(got[0, 0], -np.log(p[1]), rtol=1e-5)
+
+
+def test_warpctc_grad_and_training():
+    rng = np.random.RandomState(8)
+    B, T, C, U = 4, 10, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[B, T, 8], append_batch_size=False,
+                        lod_level=1)
+        lab = layers.data("lab", shape=[B, U], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        logits = layers.fc(x, size=C, num_flatten_dims=2)
+        loss_v = layers.warpctc(logits, lab, blank=0)
+        loss = layers.reduce_mean(loss_v)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": rng.randn(B, T, 8).astype(np.float32),
+                "x.seq_len": np.full(B, T, np.int32),
+                "lab": rng.randint(1, C, (B, U)).astype(np.int64),
+                "lab.seq_len": np.array([3, 2, 3, 1], np.int32)}
+        losses = [float(exe.run(main, feed=feed,
+                                fetch_list=[loss])[0].reshape(()))
+                  for _ in range(25)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses[::5]
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                  [1, 1, 1, 0, 0, 1, 2, 0]], np.int64)
+    seq_len = np.array([8, 6], np.int32)
+    decoded = run_op("ctc_align", {"Input": x, "SeqLen": seq_len},
+                     attrs={"blank": 0, "merge_repeated": True},
+                     out_slot="Output")
+    out_len = run_op("ctc_align", {"Input": x, "SeqLen": seq_len},
+                     attrs={"blank": 0, "merge_repeated": True},
+                     out_slot="OutLen")
+    np.testing.assert_array_equal(decoded[0, :3], [1, 2, 3])
+    np.testing.assert_array_equal(decoded[1, :2], [1, 1])
+    np.testing.assert_array_equal(out_len, [3, 2])
+
+
+# ---------------------------------------------------------------------------
+# sampling_id / precision_recall
+# ---------------------------------------------------------------------------
+
+def test_ctc_greedy_decoder_layer():
+    B, T, C = 2, 6, 4
+    rng = np.random.RandomState(11)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        probs = layers.data("probs", shape=[B, T, C],
+                            append_batch_size=False, lod_level=1)
+        decoded, out_len = layers.ctc_greedy_decoder(probs, blank=0)
+    exe = fluid.Executor()
+    pv = rng.rand(B, T, C).astype(np.float32)
+    d, ol = exe.run(main, feed={"probs": pv,
+                                "probs.seq_len": np.array([6, 4], np.int32)},
+                    fetch_list=[decoded, out_len])
+    # reference: argmax path, merge repeats, drop blanks
+    for b, L in enumerate([6, 4]):
+        path = pv[b, :L].argmax(-1)
+        ref = []
+        prev = -1
+        for tkn in path:
+            if tkn != 0 and tkn != prev:
+                ref.append(tkn)
+            prev = tkn
+        assert ol[b] == len(ref)
+        np.testing.assert_array_equal(d[b, :len(ref)], ref)
+
+
+def test_crf_decoding_label_mask_excludes_padding():
+    rng = np.random.RandomState(12)
+    B, T, N = 2, 5, 3
+    emission = rng.randn(B, T, N).astype(np.float32)
+    transition = (rng.randn(N + 2, N) * 0.5).astype(np.float32)
+    seq_len = np.array([3, 5], np.int32)
+    path = run_op("crf_decoding",
+                  {"Emission": emission, "Transition": transition,
+                   "SeqLen": seq_len}, out_slot="ViterbiPath")
+    # feed the decoded path itself as label, padded with zeros: the mask
+    # must be 1 exactly on real positions, 0 on padding
+    mask = run_op("crf_decoding",
+                  {"Emission": emission, "Transition": transition,
+                   "SeqLen": seq_len, "Label": path},
+                  out_slot="ViterbiPath")
+    for b, L in enumerate(seq_len):
+        np.testing.assert_array_equal(mask[b, :L], 1)
+        np.testing.assert_array_equal(mask[b, L:], 0)
+
+
+def test_sampling_id_distribution():
+    probs = np.tile(np.array([[0.1, 0.0, 0.9]], np.float32), (2000, 1))
+    ids = run_op("sampling_id", {"X": probs})
+    frac2 = (ids == 2).mean()
+    assert 0.8 < frac2 < 0.97, frac2
+    assert not (ids == 1).any()
+
+
+def test_precision_recall_matches_sklearn_style():
+    rng = np.random.RandomState(9)
+    C, B = 4, 200
+    idx = rng.randint(0, C, (B, 1)).astype(np.int64)
+    lab = rng.randint(0, C, (B, 1)).astype(np.int64)
+    batch = run_op("precision_recall",
+                   {"Indices": idx, "Labels": lab},
+                   attrs={"class_number": C}, out_slot="BatchMetrics")
+    # reference macro/micro computation
+    tp = np.zeros(C)
+    fp = np.zeros(C)
+    fn = np.zeros(C)
+    for p, l in zip(idx[:, 0], lab[:, 0]):
+        if p == l:
+            tp[l] += 1
+        else:
+            fp[p] += 1
+            fn[l] += 1
+    prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-12), 0)
+    rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-12), 0)
+    f1 = np.where(prec + rec > 0,
+                  2 * prec * rec / np.maximum(prec + rec, 1e-12), 0)
+    mp = tp.sum() / (tp.sum() + fp.sum())
+    mr = tp.sum() / (tp.sum() + fn.sum())
+    mf = 2 * mp * mr / (mp + mr)
+    want = [prec.mean(), rec.mean(), f1.mean(), mp, mr, mf]
+    np.testing.assert_allclose(batch, want, rtol=1e-5)
+
+
+def test_precision_recall_accumulates():
+    rng = np.random.RandomState(10)
+    C = 3
+    idx1 = rng.randint(0, C, (50, 1)).astype(np.int64)
+    lab1 = rng.randint(0, C, (50, 1)).astype(np.int64)
+    idx2 = rng.randint(0, C, (50, 1)).astype(np.int64)
+    lab2 = rng.randint(0, C, (50, 1)).astype(np.int64)
+    s1 = run_op("precision_recall", {"Indices": idx1, "Labels": lab1},
+                attrs={"class_number": C}, out_slot="AccumStatesInfo")
+    acc = run_op("precision_recall",
+                 {"Indices": idx2, "Labels": lab2, "StatesInfo": s1},
+                 attrs={"class_number": C}, out_slot="AccumMetrics")
+    both = run_op("precision_recall",
+                  {"Indices": np.concatenate([idx1, idx2]),
+                   "Labels": np.concatenate([lab1, lab2])},
+                  attrs={"class_number": C}, out_slot="BatchMetrics")
+    np.testing.assert_allclose(acc, both, rtol=1e-5)
