@@ -197,10 +197,45 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
     }
 
 
+def bench_serving(batch_size: int, iters: int = 50):
+    """ResNet-50 inference latency through the AOT Predictor (reference:
+    inference/tests/api/analyzer_resnet50_tester.cc latency runs)."""
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    rng = np.random.RandomState(0)
+    main_p, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_p, startup), fluid.scope_guard(scope):
+        model = resnet.build_model(dataset="flowers", depth=50,
+                                   class_dim=1000, with_optimizer=False)
+        exe = fluid.Executor()
+        exe.run(startup)
+        with tempfile.TemporaryDirectory() as d:
+            fluid.io.save_inference_model(
+                d, ["data"], [model["predict"]], exe, main_program=main_p)
+            predictor = fluid.Predictor(d)
+            feed = {"data": rng.rand(batch_size, 3, 224,
+                                     224).astype(np.float32)}
+            stats = predictor.benchmark(feed, iters=iters, warmup=5)
+    _, kind = _peak_flops()
+    # compute_ms amortizes the host dispatch (the tunnel RTT here is
+    # ~114ms/call, measured — a real serving frontend pipelines it away)
+    return {"p50_ms": round(stats["p50_ms"], 3),
+            "mean_ms": round(stats["mean_ms"], 3),
+            "compute_ms": round(stats["compute_ms"], 3),
+            "imgs_per_sec": round(batch_size / (stats["compute_ms"] / 1e3),
+                                  1),
+            "batch_size": batch_size, "device": kind}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
-                   choices=["all", "resnet50", "transformer", "deepfm"])
+                   choices=["all", "resnet50", "transformer", "deepfm",
+                            "serving"])
     p.add_argument("--batch", type=int, default=0)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
@@ -220,6 +255,8 @@ def main():
     if args.model in ("all", "deepfm"):
         detail["deepfm"] = bench_deepfm(
             args.batch or 4096, args.steps, args.warmup)
+    if args.model == "serving":
+        detail["serving"] = bench_serving(args.batch or 8)
 
     # headline = min MFU across the MXU-bound headline models; the sparse
     # deepfm config reports throughput in detail only
@@ -231,6 +268,23 @@ def main():
             "value": round(min(mfus), 4),
             "unit": "MFU (fraction of bf16 peak)",
             "vs_baseline": round(min(mfus) / 0.35, 3),  # north-star >=0.35
+            "detail": detail,
+        }
+    elif "serving" in detail:
+        d = detail["serving"]
+        # reference-published ResNet-50 inference: 217.69 img/s bs16
+        # MKL-DNN Xeon (benchmark/IntelOptimizedPaddle.md:83-89).
+        # Methodology note: `value` is device-compute throughput with
+        # host dispatch amortized (this environment's tunnel adds
+        # ~114ms/call RTT — see p50_ms in detail for the e2e number); the
+        # reference number is e2e on hardware without such a tunnel.
+        result = {
+            "metric": "resnet50_serving_compute_imgs_per_sec",
+            "value": d["imgs_per_sec"],
+            "unit": ("imgs/sec (dispatch-amortized compute %.2fms; "
+                     "e2e p50 %.2fms incl. tunnel RTT)"
+                     % (d["compute_ms"], d["p50_ms"])),
+            "vs_baseline": round(d["imgs_per_sec"] / 217.69, 3),
             "detail": detail,
         }
     else:

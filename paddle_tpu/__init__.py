@@ -30,6 +30,11 @@ from .parallel.compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                                 ExecutionStrategy)
 from .parallel.parallel_executor import ParallelExecutor  # noqa: F401
 from . import io  # noqa: F401
+from . import inference  # noqa: F401
+from . import quantize as quantize_module  # noqa: F401
+from .inference import (AnalysisConfig, Predictor,  # noqa: F401
+                        create_paddle_predictor)
+from .quantize import QuantizeTranspiler  # noqa: F401
 from . import data  # noqa: F401
 from . import debugger  # noqa: F401
 from . import profiler  # noqa: F401

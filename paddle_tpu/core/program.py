@@ -515,6 +515,9 @@ class Program:
 _TEST_MODE_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
+    # QAT moving-average scale op freezes (reads, not updates) its scale
+    # state in test mode (paddle_tpu/quantize.py)
+    "fake_quantize_dequantize_moving_average_abs_max": ("is_test",),
 }
 
 

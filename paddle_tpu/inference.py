@@ -1,0 +1,289 @@
+"""Inference serving: AOT-compiled Predictor + portable export.
+
+TPU-native analog of the reference inference API
+(reference: paddle/fluid/inference/api/analysis_predictor.cc:56
+AnalysisPredictor — load model, run analysis/fusion passes, serve with a
+NaiveExecutor and zero-copy tensors; api/paddle_analysis_config.h
+AnalysisConfig; api/paddle_api.h PaddlePredictor ABI).
+
+Mapping:
+- the analysis/fusion pass pipeline → XLA compilation (the whole pruned
+  program is jitted once; fusion is the compiler's job),
+- AnalysisPredictor's warm NaiveExecutor loop → an AOT-compiled
+  executable cached per input signature; params stay device-resident
+  between calls (the zero-copy contract),
+- the `__model__` + params dir → same layout (io.py), plus an optional
+  portable serialized artifact (`__model__.export`, jax.export/StableHLO
+  bytes) that loads WITHOUT re-tracing the program — the saved-engine
+  analog of the reference's TensorRT serialized engines.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import (RNG_STATE_VAR, Scope, interpret_program,
+                            prune_ops)
+from .core.program import Program
+from .io import load_inference_model
+
+EXPORT_FILENAME = "__model__.export"
+
+
+class AnalysisConfig:
+    """reference: api/paddle_analysis_config.h (knobs that map to XLA are
+    kept; GPU/MKLDNN/TensorRT switches are parity no-ops on TPU)."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self.model_dir = model_dir
+        self.use_serialized_artifact = True
+        self._params_file = None
+        self._model_file = None
+
+    # -- fluid-style setters (parity) -----------------------------------
+    def set_model(self, model_dir: str):
+        self.model_dir = model_dir
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, _on=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self):
+        pass  # XLA buffer liveness
+
+
+class Predictor:
+    """AOT inference engine (reference AnalysisPredictor::Run,
+    analysis_predictor.cc:170, ZeroCopyRun :444).
+
+    run(feed) compiles on first use per input signature
+    (`.lower().compile()`, no retracing afterwards) and keeps parameters
+    device-resident.  When the export dir carries a serialized artifact
+    and the input signature matches, the artifact is used directly — no
+    tracing at all (cold-start path).
+    """
+
+    def __init__(self, config: AnalysisConfig | str):
+        if isinstance(config, str):
+            config = AnalysisConfig(config)
+        self.config = config
+        from .core.executor import Executor
+
+        self._scope = Scope()
+        from .core.executor import scope_guard
+
+        exe = Executor()
+        with scope_guard(self._scope):
+            self._program, self._feed_names, fetch_vars = \
+                load_inference_model(config.model_dir, exe)
+        self._fetch_names = [v.name for v in fetch_vars]
+        import jax
+
+        # params to device once (zero-copy across run() calls)
+        self._params = {
+            n: jax.device_put(v) for n, v in self._scope.vars.items()
+            if v is not None and n != RNG_STATE_VAR
+        }
+        self._compiled: Dict[tuple, object] = {}
+        self._exported = None
+        self._export_sig = None
+        path = os.path.join(config.model_dir, EXPORT_FILENAME)
+        if config.use_serialized_artifact and os.path.exists(path):
+            import json
+
+            from jax import export as jax_export
+
+            with open(path, "rb") as f:
+                self._exported = jax_export.deserialize(f.read())
+            sig_path = path + ".json"
+            if os.path.exists(sig_path):
+                with open(sig_path) as f:
+                    self._export_sig = tuple(
+                        (n, tuple(s), d) for n, s, d in json.load(f))
+
+    # -- introspection (PaddlePredictor parity) -------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    # -- execution ------------------------------------------------------
+    def _signature(self, feeds):
+        # feeds are jnp arrays by the time this is called: .shape/.dtype
+        # are metadata reads, no device→host transfer
+        return tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                            for n, v in feeds.items()))
+
+    def _exported_matches(self, feeds) -> bool:
+        """The artifact serves a request only when the per-input
+        (name, shape, dtype) signature recorded at export time matches
+        exactly; anything else falls back to the traced path."""
+        if self._exported is None or self._export_sig is None:
+            return False
+        return self._signature(feeds) == self._export_sig
+
+    def run(self, feed: Dict[str, np.ndarray] | Sequence[np.ndarray]):
+        """Returns fetch arrays (list, fetch order from export)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not isinstance(feed, dict):
+            if len(feed) != len(self._feed_names):
+                raise ValueError(
+                    f"expected {len(self._feed_names)} inputs "
+                    f"({self._feed_names}), got {len(feed)}")
+            feed = dict(zip(self._feed_names, feed))
+        feeds = {n: jnp.asarray(v) for n, v in feed.items()}
+
+        if self._exported_matches(feeds):
+            outs = self._exported.call(
+                {n: self._params[n] for n in sorted(self._params)},
+                {n: feeds[n] for n in sorted(feeds)})
+            return [np.asarray(o) for o in outs]
+
+        sig = self._signature(feeds)
+        entry = self._compiled.get(sig)
+        if entry is None:
+            program = self._program
+            fetch_names = self._fetch_names
+
+            def infer(params, feeds):
+                env = dict(params)
+                env.update(feeds)
+                env = interpret_program(program, env, None,
+                                        fetch_names=tuple(fetch_names))
+                return [env[n] for n in fetch_names]
+
+            lowered = jax.jit(infer).lower(self._params, feeds)
+            entry = lowered.compile()
+            self._compiled[sig] = entry
+        return [np.asarray(o) for o in entry(self._params, feeds)]
+
+    def benchmark(self, feed, iters: int = 50, warmup: int = 5,
+                  zero_copy: bool = True):
+        """Serving latency probe: returns {p50_ms, mean_ms}.
+
+        zero_copy=True places the inputs on device once and times the
+        warm executable (the reference's ZeroCopyRun measurement,
+        analysis_predictor.cc:444); zero_copy=False times end-to-end
+        including host→device input transfer."""
+        import jax
+        import jax.numpy as jnp
+
+        if zero_copy and isinstance(feed, dict):
+            feed = {n: jax.device_put(jnp.asarray(v))
+                    for n, v in feed.items()}
+            for v in feed.values():
+                v.block_until_ready()
+        for _ in range(warmup):
+            self.run(feed)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            self.run(feed)
+            times.append((time.perf_counter() - t0) * 1e3)
+        times.sort()
+        result = {"p50_ms": times[len(times) // 2],
+                  "mean_ms": sum(times) / len(times)}
+        result["compute_ms"] = self._chained_latency_ms(feed)
+        return result
+
+    def _chained_latency_ms(self, feed, k: int = 20):
+        """Per-inference device latency with host dispatch amortized over
+        k chained requests (a lax.scan over k stacked copies of the
+        input, so the body can't be loop-hoisted).  This is the number
+        that matters when a real serving frontend keeps the device queue
+        full; p50_ms above includes the host↔device round-trip, which in
+        this environment is dominated by the tunnel."""
+        import jax
+        import jax.numpy as jnp
+
+        feeds = {n: jnp.asarray(v) for n, v in feed.items()}
+        program = self._program
+        fetch_names = self._fetch_names
+
+        def one(params, f):
+            env = dict(params)
+            env.update(f)
+            env = interpret_program(program, env, None,
+                                    fetch_names=tuple(fetch_names))
+            return [env[n] for n in fetch_names]
+
+        stacked = {n: jnp.stack([v] * k) for n, v in feeds.items()}
+
+        def chained(params, xs):
+            def body(_, f):
+                return None, one(params, f)
+
+            _, outs = jax.lax.scan(body, None, xs)
+            return [o[-1] for o in outs]
+
+        fn = jax.jit(chained).lower(self._params, stacked).compile()
+        [o.block_until_ready() for o in fn(self._params, stacked)]
+        t0 = time.perf_counter()
+        [o.block_until_ready() for o in fn(self._params, stacked)]
+        return (time.perf_counter() - t0) * 1e3 / k
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> Predictor:
+    """reference: CreatePaddlePredictor<AnalysisConfig>
+    (analysis_predictor.cc:359)."""
+    return Predictor(config)
+
+
+def export_serialized_model(dirname: str, example_feed: Dict[str, np.ndarray],
+                            executor=None):
+    """AOT-export the saved inference model as a portable artifact
+    (jax.export / StableHLO bytes) for the shapes of `example_feed`.
+    Written next to `__model__` as `__model__.export`; Predictor uses it
+    when input shapes match, skipping program re-tracing entirely.
+    Replaces the reference's serialized-engine path
+    (analysis_predictor.cc + tensorrt engine serialization)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from .core.executor import Executor, scope_guard
+
+    scope = Scope()
+    exe = executor or Executor()
+    with scope_guard(scope):
+        program, feed_names, fetch_vars = load_inference_model(dirname, exe)
+    fetch_names = [v.name for v in fetch_vars]
+    params = {n: v for n, v in scope.vars.items()
+              if v is not None and n != RNG_STATE_VAR}
+    missing = set(feed_names) - set(example_feed)
+    if missing:
+        raise ValueError(f"example_feed missing inputs: {sorted(missing)}")
+
+    def infer(params, feeds):
+        env = dict(params)
+        env.update(feeds)
+        env = interpret_program(program, env, None,
+                                fetch_names=tuple(fetch_names))
+        return [env[n] for n in fetch_names]
+
+    params_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
+                                           np.asarray(v).dtype)
+                   for n, v in sorted(params.items())}
+    feed_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
+                                         jnp.asarray(v).dtype)
+                 for n, v in sorted(example_feed.items())}
+    exported = jax_export.export(jax.jit(infer))(params_spec, feed_spec)
+    path = os.path.join(dirname, EXPORT_FILENAME)
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+    import json
+
+    sig = sorted((n, list(s.shape), str(np.dtype(s.dtype)))
+                 for n, s in feed_spec.items())
+    with open(path + ".json", "w") as f:
+        json.dump(sig, f)
+    return path

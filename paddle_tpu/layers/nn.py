@@ -1011,7 +1011,8 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
         inputs={"Hyps": [input], "Refs": [label], "HypsLen": [hl],
                 "RefsLen": [rl]},
         outputs={"Out": [dist], "SequenceNum": [seq_num]},
-        attrs={"normalized": bool(normalized)})
+        attrs={"normalized": bool(normalized),
+               "ignored_tokens": list(ignored_tokens or [])})
     return dist, seq_num
 
 

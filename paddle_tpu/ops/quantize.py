@@ -1,0 +1,83 @@
+"""Fake-quantization operators (QAT simulation).
+
+TPU-native analog of the reference's quantization op family
+(reference: paddle/fluid/operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_quantize_moving_average_abs_max, fake_dequantize_max_abs).
+
+The quantize+dequantize simulation runs in float (int8 grids on the MXU
+come from XLA int8 matmul lowering at serving time); training gradients
+use the straight-through estimator, expressed as
+`x + stop_gradient(qdq(x) - x)` so jax AD sees identity — replacing the
+reference's hand-written identity grad kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import first, opt_in, out
+
+
+def _qdq(x, scale, bits: int):
+    """Quantize to the signed (2^(bits-1)-1) grid at `scale`, dequantize,
+    with STE gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    dq = q * s / qmax
+    return x + lax.stop_gradient(dq - x)
+
+
+@register_op("fake_quantize_abs_max")
+def fake_quantize_abs_max(ctx, ins, attrs):
+    """Out = quantized values on the dynamic abs-max grid; OutScale the
+    scale used (reference fake_quantize_op.cc FakeQuantizeAbsMaxOp)."""
+    x = first(ins, "X")
+    bits = int(attrs.get("bit_length", 8))
+    scale = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return out(Out=q, OutScale=s.reshape((1,)))
+
+
+@register_op("fake_dequantize_max_abs")
+def fake_dequantize_max_abs(ctx, ins, attrs):
+    """Out = X * Scale / max_range (reference FakeDequantizeMaxAbsOp)."""
+    x = first(ins, "X")
+    scale = first(ins, "Scale").reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return out(Out=x * scale / max_range)
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_quantize_dequantize_abs_max(ctx, ins, attrs):
+    """One-shot QAT simulation with dynamic per-tensor scale + STE grad
+    (the op the QuantizeTranspiler inserts)."""
+    x = first(ins, "X")
+    bits = int(attrs.get("bit_length", 8))
+    scale = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    return out(Out=_qdq(x, scale, bits), OutScale=scale.reshape((1,)))
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def fake_qdq_moving_average(ctx, ins, attrs):
+    """QAT simulation with a moving-average scale held in persistable
+    state (reference FakeQuantizeMovingAverageAbsMaxOp): training updates
+    scale = rate*scale + (1-rate)*absmax; is_test uses the stored scale."""
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale").reshape(())
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    if attrs.get("is_test", False):
+        scale = in_scale
+    else:
+        cur = lax.stop_gradient(jnp.max(jnp.abs(x)))
+        # first step (scale==0 sentinel) adopts the batch scale directly
+        scale = jnp.where(in_scale > 0,
+                          rate * in_scale + (1 - rate) * cur, cur)
+    return out(Out=_qdq(x, scale, bits), OutScale=scale.reshape((1,)))

@@ -308,6 +308,10 @@ def edit_distance(ctx, ins, attrs):
     ref = first(ins, "Refs").astype(jnp.int32)
     hlen = first(ins, "HypsLen").astype(jnp.int32)
     rlen = first(ins, "RefsLen").astype(jnp.int32)
+    ignored = attrs.get("ignored_tokens") or []
+    if ignored:
+        hyp, hlen = _compact_remove(hyp, hlen, ignored)
+        ref, rlen = _compact_remove(ref, rlen, ignored)
     B, T1 = hyp.shape
     T2 = ref.shape[1]
 
@@ -341,6 +345,23 @@ def edit_distance(ctx, ins, attrs):
         dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
     return out(Out=dist[:, None],
                SequenceNum=jnp.asarray([B], jnp.int64))
+
+
+def _compact_remove(x, lengths, tokens):
+    """Remove every occurrence of `tokens` from padded rows, shifting the
+    survivors left and shrinking lengths (used by edit_distance's
+    ignored_tokens, matching the reference's pre-filter)."""
+    B, T = x.shape
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+    keep = valid
+    for t in tokens:
+        keep = keep & (x != int(t))
+    pos = jnp.cumsum(keep, axis=1) - 1
+    new_len = jnp.max(jnp.where(keep, pos + 1, 0), axis=1)
+    scatter_pos = jnp.where(keep, pos, T)
+    res = jnp.zeros((B, T + 1), x.dtype)
+    res = jax.vmap(lambda r, p, v: r.at[p].set(v))(res, scatter_pos, x)
+    return res[:, :T], new_len.astype(lengths.dtype)
 
 
 # ---------------------------------------------------------------------------

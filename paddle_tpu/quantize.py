@@ -1,0 +1,134 @@
+"""QuantizeTranspiler: QAT program rewrite.
+
+TPU-native analog of the reference QAT transpiler
+(reference: python/paddle/fluid/contrib/quantize/quantize_transpiler.py:1
+— rewrites the program to insert fake_quantize ops on the inputs of
+quantizable ops (conv2d, depthwise_conv2d, mul) and fake_dequantize after
+them, with per-var dedup and scale state).
+
+Here the rewrite inserts the combined quantize-dequantize simulation op
+in front of each quantizable input (weights use dynamic abs-max,
+activations use a moving-average scale held in persistable state), and
+rewires the consumer to the simulated tensor.  Gradients flow by the
+straight-through estimator inside the op impl (ops/quantize.py), so no
+grad-op surgery is needed — jax AD differentiates the rewritten program
+as-is.  Run it BEFORE append_backward/minimize, like the reference's
+training_transpile is run on the un-differentiated program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .core import unique_name
+from .core.desc import OpDesc
+from .core.program import Operator, Program, default_main_program
+from .initializer import Constant
+
+QUANTIZABLE_OPS = {"conv2d", "depthwise_conv2d", "mul", "matmul"}
+# slot holding the weight operand per op type (quantized with abs_max)
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y"}
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 moving_rate: float = 0.9):
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError(
+                f"unsupported activation_quantize_type "
+                f"{activation_quantize_type!r}")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    # -- public API (reference quantize_transpiler.py API) ---------------
+    def training_transpile(self, program: Optional[Program] = None,
+                           startup_program: Optional[Program] = None):
+        program = program or default_main_program()
+        if program._backward_info is not None:
+            raise RuntimeError(
+                "QuantizeTranspiler must run before append_backward/"
+                "minimize (the reference transpiles the forward program)")
+        self._rewrite(program, startup_program, is_test=False)
+        return program
+
+    def inference_transpile(self, program: Optional[Program] = None):
+        """Rewrite a test/inference program: same graph, is_test scales
+        (moving-average state is read, not updated)."""
+        program = program or default_main_program()
+        self._rewrite(program, None, is_test=True)
+        return program
+
+    # -- rewrite ---------------------------------------------------------
+    def _rewrite(self, program: Program, startup_program, is_test: bool):
+        block = program.global_block()
+        # (src var name, is_weight) -> simulated var name
+        quantized: Dict[tuple, str] = {}
+        new_ops = []
+        for op in block.ops:
+            if op.desc.type in QUANTIZABLE_OPS:
+                weight_slot = _WEIGHT_SLOTS[op.desc.type]
+                for slot, names in op.desc.inputs.items():
+                    rewired = []
+                    for name in names:
+                        var = block.var(name)
+                        is_weight = (slot == weight_slot
+                                     or getattr(var, "trainable", False))
+                        key = (name, is_weight)
+                        if key not in quantized:
+                            qname, q_ops = self._make_qdq(
+                                block, program, startup_program, name,
+                                is_weight, is_test)
+                            new_ops.extend(q_ops)
+                            quantized[key] = qname
+                        rewired.append(quantized[key])
+                    op.desc.inputs[slot] = rewired
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+
+    def _make_qdq(self, block, program, startup_program, name: str,
+                  is_weight: bool, is_test: bool):
+        src = block.var(name)
+        qvar = block.create_var(
+            name=unique_name.generate(f"{name}.quantized"),
+            shape=src.shape, dtype=src.dtype)
+        bits = self.weight_bits if is_weight else self.activation_bits
+        use_moving = (not is_weight
+                      and self.act_type == "moving_average_abs_max")
+        if use_moving:
+            state_name = f"{name}.quant_scale_state"
+            if not block.has_var(state_name):
+                block.create_var(name=state_name, shape=(1,),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+                if startup_program is not None:
+                    sb = startup_program.global_block()
+                    if not sb.has_var(state_name):
+                        sp = sb.create_var(name=state_name, shape=(1,),
+                                           dtype="float32",
+                                           persistable=True,
+                                           stop_gradient=True)
+                        Constant(0.0)(sp, sb)
+            desc = OpDesc(
+                type="fake_quantize_dequantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [state_name]},
+                outputs={"Out": [qvar.name], "OutScale": [state_name]},
+                attrs={"bit_length": bits, "moving_rate": self.moving_rate,
+                       "is_test": is_test})
+        else:
+            scale_out = block.create_var(
+                name=unique_name.generate(f"{name}.scale"),
+                shape=(1,), dtype="float32", stop_gradient=True)
+            desc = OpDesc(
+                type="fake_quantize_dequantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qvar.name], "OutScale": [scale_out.name]},
+                attrs={"bit_length": bits})
+        return qvar.name, [Operator(block, desc)]

@@ -273,6 +273,18 @@ def test_edit_distance_matches_reference():
 # CTC
 # ---------------------------------------------------------------------------
 
+def test_edit_distance_ignored_tokens():
+    hyp = np.array([[0, 3, 0, 4, 0]], np.int64)   # ignoring 0 → [3, 4]
+    ref = np.array([[3, 5, 0, 0, 0]], np.int64)   # ignoring 0 → [3, 5]
+    got = run_op("edit_distance",
+                 {"Hyps": hyp, "Refs": ref,
+                  "HypsLen": np.array([5], np.int32),
+                  "RefsLen": np.array([2], np.int32)},
+                 attrs={"normalized": False, "ignored_tokens": [0]},
+                 out_slot="Out")
+    assert got[0, 0] == 1.0  # substitute 4→5
+
+
 def test_warpctc_simple_case():
     """T=1, one label: loss = -log softmax(logits)[label]."""
     logits = np.array([[[2.0, 1.0, 0.5]]], np.float32)  # (1, 1, 3)
