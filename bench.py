@@ -72,7 +72,17 @@ def _timed_loop(exe, program, feed_dev, loss, steps, warmup):
 
 
 def bench_resnet50(batch_size: int, steps: int, warmup: int,
-                   use_amp: bool = True):
+                   use_amp: bool = True, data_mode: str = "frozen"):
+    """data_mode:
+    - "frozen":    one device-resident batch reused every step (reference
+                   --use_fake_data upper bound)
+    - "synthetic": FRESH random batch generated on device every step
+                   (random ops prepended to the program) — per-step fresh
+                   data at full speed, no frozen-feed caveat
+    - "host":      fresh numpy batches through the double-buffered
+                   DeviceFeeder prefetch pipeline (data/pipeline.py);
+                   includes real host→device transfer per step
+    """
     import jax
     import jax.numpy as jnp
 
@@ -87,17 +97,66 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                                    class_dim=1000, learning_rate=0.1,
                                    use_amp=use_amp)
         exe = fluid.Executor()
+
+        if data_mode == "synthetic":
+            # fill the feed vars with device-generated randomness each
+            # step; the per-step RNG advance makes every iteration's
+            # batch distinct, including inside chained iterations
+            block = main.global_block()
+            block.prepend_op(
+                "randint", outputs={"Out": ["label"]},
+                attrs={"shape": [batch_size, 1], "low": 0, "high": 1000,
+                       "dtype": "int32"})
+            block.prepend_op(
+                "uniform_random", outputs={"Out": ["data"]},
+                attrs={"shape": [batch_size, 3, 224, 224], "min": 0.0,
+                       "max": 1.0, "dtype": "float32"})
         exe.run(startup)
-        feed = {
-            "data": jax.device_put(
-                rng.rand(batch_size, 3, 224, 224).astype(np.float32)),
-            "label": jnp.asarray(rng.randint(0, 1000, (batch_size, 1)),
-                                 dtype=jnp.int32),
-        }
-        cost = exe.cost_analysis(main, feed=feed,
-                                 fetch_list=[model["loss"]])
-        elapsed, last_loss = _timed_loop(exe, main, feed, model["loss"],
-                                         steps, warmup)
+
+        if data_mode == "synthetic":
+            feed = {}
+        elif data_mode != "host":
+            feed = {
+                "data": jax.device_put(
+                    rng.rand(batch_size, 3, 224, 224).astype(np.float32)),
+                "label": jnp.asarray(rng.randint(0, 1000, (batch_size, 1)),
+                                     dtype=jnp.int32),
+            }
+        if data_mode == "host":
+            from paddle_tpu.data.pipeline import DeviceFeeder
+
+            def reader():
+                r = np.random.RandomState(1)
+                while True:
+                    yield {
+                        "data": r.rand(batch_size, 3, 224,
+                                       224).astype(np.float32),
+                        "label": r.randint(
+                            0, 1000, (batch_size, 1)).astype(np.int32),
+                    }
+
+            dev_feeder = DeviceFeeder(reader, capacity=3).start()
+            try:
+                feeder = iter(dev_feeder)
+                for _ in range(warmup):
+                    exe.run(main, feed=next(feeder),
+                            fetch_list=[model["loss"]])
+                t0 = time.perf_counter()
+                lv = None
+                for _ in range(steps):
+                    (lv,) = exe.run(main, feed=next(feeder),
+                                    fetch_list=[model["loss"]])
+                elapsed = time.perf_counter() - t0
+                last_loss = float(np.asarray(lv).reshape(-1)[0])
+                cost = exe.cost_analysis(main, feed=next(feeder),
+                                         fetch_list=[model["loss"]])
+            finally:
+                dev_feeder.reset()
+        else:
+            cost = exe.cost_analysis(main, feed=feed,
+                                     fetch_list=[model["loss"]])
+            elapsed, last_loss = _timed_loop(exe, main, feed,
+                                             model["loss"], steps, warmup)
     imgs_per_sec = batch_size * steps / elapsed
     step_flops = float(cost.get("flops", 0.0))
     if step_flops <= 0:
@@ -114,6 +173,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
         "batch_size": batch_size,
         "steps": steps,
         "amp": use_amp,
+        "data_mode": data_mode,
         "last_loss": last_loss,
         "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3),
     }
@@ -241,13 +301,19 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--no-amp", action="store_true")
     p.add_argument("--no-flash", action="store_true")
+    p.add_argument("--data", default="frozen",
+                   choices=["frozen", "synthetic", "host"],
+                   help="resnet50 input mode: frozen device batch, "
+                        "fresh on-device synthetic per step, or host "
+                        "batches via the prefetch pipeline")
     args = p.parse_args()
     amp = not args.no_amp
 
     detail = {}
     if args.model in ("all", "resnet50"):
         detail["resnet50"] = bench_resnet50(
-            args.batch or 128, args.steps, args.warmup, use_amp=amp)
+            args.batch or 128, args.steps, args.warmup, use_amp=amp,
+            data_mode=args.data)
     if args.model in ("all", "transformer"):
         detail["transformer"] = bench_transformer(
             args.batch or 64, args.steps, args.warmup, use_amp=amp,

@@ -37,6 +37,8 @@ from .inference import (AnalysisConfig, Predictor,  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
 from . import data  # noqa: F401
 from . import debugger  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from .data.data_feeder import DataFeeder  # noqa: F401
 from .flags import FLAGS  # noqa: F401

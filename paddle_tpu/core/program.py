@@ -318,6 +318,10 @@ class Block:
         )
         op = Operator(self, desc)
         self.ops.insert(0, op)
+        # keep the forward/backward boundary aligned (prepending shifts
+        # every op index by one)
+        if self.idx == 0 and self.program._backward_info is not None:
+            self.program._backward_info["index"] += 1
         self.program._bump()
         return op
 

@@ -1,0 +1,186 @@
+"""Device-fed input pipeline: double-buffered host→device prefetch.
+
+TPU-native analog of the reference's device-side reader chain
+(reference: paddle/fluid/operators/reader/buffered_reader.cc:1 — pinned-
+memory double buffering; reader/create_py_reader_op.cc +
+lod_tensor_blocking_queue.h — a Python thread feeding a blocking queue
+the graph's read op pops; python/paddle/fluid/layers/io.py py_reader:633,
+double_buffer:1002).
+
+Design: a daemon thread pulls host batches from the user's reader,
+starts their host→device transfers immediately (`jax.device_put` is
+asynchronous — the copy overlaps the current training step), and parks
+the in-flight device arrays in a bounded queue.  The training loop pops
+ready feed dicts, so steady-state step time is max(compute, transfer)
+instead of compute + transfer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_STOP = object()
+
+
+class DeviceFeeder:
+    """Iterator of device-resident feed dicts with background prefetch.
+
+    reader: callable returning an iterable of feed dicts
+            ({name: np.ndarray}) — one dict per step.
+    capacity: max in-flight prefetched batches (2 = classic double
+              buffering; raise it to ride out producer jitter).
+    """
+
+    def __init__(self, reader: Callable[[], Iterable[Dict[str, np.ndarray]]],
+                 capacity: int = 2, device=None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._reader = reader
+        self._capacity = capacity
+        self._device = device
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle (py_reader start/reset parity) -----------------------
+    def start(self):
+        """Begin prefetching a fresh pass over the reader."""
+        self.reset()
+        self._queue = queue.Queue(maxsize=self._capacity)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue,), daemon=True)
+        self._thread.start()
+        return self
+
+    def reset(self):
+        """Stop the current pass (reference py_reader.reset).  The
+        producer owns its queue reference, so a slow reader that outlives
+        the join timeout dies quietly on the stop flag instead of
+        crashing on a nulled queue."""
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+        self._thread = None
+        self._queue = None
+
+    # -- producer -------------------------------------------------------
+    def _put(self, q: queue.Queue, item) -> bool:
+        """Blocking put that aborts when reset() raises the stop flag."""
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self, q: queue.Queue):
+        import jax
+
+        try:
+            for batch in self._reader():
+                if self._stop.is_set():
+                    return
+                # device_put is async: the transfer starts now and
+                # overlaps the consumer's current step
+                # (buffered_reader.cc's pinned-mem copy)
+                placed = {n: jax.device_put(v, self._device)
+                          for n, v in batch.items()}
+                if not self._put(q, placed):
+                    return
+            self._put(q, _STOP)
+        except Exception as e:  # surfaced on the consumer side
+            self._put(q, _ReaderFailure(e))
+
+    # -- consumer -------------------------------------------------------
+    def __iter__(self):
+        if self._queue is None:
+            self.start()
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if self._queue is None:
+            raise StopIteration
+        item = self._queue.get()
+        if item is _STOP:
+            self._queue = None
+            self._thread = None
+            raise StopIteration
+        if isinstance(item, _ReaderFailure):
+            self._queue = None
+            raise item.error
+        return item
+
+
+class _ReaderFailure:
+    def __init__(self, error: Exception):
+        self.error = error
+
+
+class PyReader:
+    """fluid-style py_reader facade (reference layers/io.py:633): declare
+    feed vars once, decorate with a sample/batch reader, iterate
+    device-resident batches.
+
+        reader = PyReader(feed_list=[img, label], capacity=4)
+        reader.decorate_batch_generator(my_batches)
+        for feed in reader:
+            exe.run(main, feed=feed, fetch_list=[loss])
+    """
+
+    def __init__(self, feed_list: Sequence, capacity: int = 2):
+        self._names: List[str] = [
+            v if isinstance(v, str) else v.name for v in feed_list
+        ]
+        # sequence inputs carry their .seq_len companions automatically
+        self._capacity = capacity
+        self._feeder: Optional[DeviceFeeder] = None
+        self._gen = None
+
+    def decorate_batch_generator(self, generator):
+        """generator: callable -> iterable of tuples/lists/dicts of numpy
+        batches aligned with feed_list."""
+        names = self._names
+
+        def reader():
+            for item in generator():
+                if isinstance(item, dict):
+                    yield item
+                else:
+                    if len(item) != len(names):
+                        raise ValueError(
+                            f"batch has {len(item)} arrays for "
+                            f"{len(names)} feed vars {names}")
+                    yield dict(zip(names, item))
+
+        self._gen = reader
+        return self
+
+    decorate_paddle_reader = decorate_batch_generator
+
+    def start(self):
+        if self._gen is None:
+            raise RuntimeError("decorate_batch_generator first")
+        self._feeder = DeviceFeeder(self._gen, capacity=self._capacity)
+        self._feeder.start()
+        return self
+
+    def reset(self):
+        if self._feeder is not None:
+            self._feeder.reset()
+            self._feeder = None
+
+    def __iter__(self):
+        if self._feeder is None:
+            self.start()
+        return iter(self._feeder)

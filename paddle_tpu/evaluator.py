@@ -1,0 +1,14 @@
+"""Evaluator API (reference: python/paddle/fluid/evaluator.py:1).
+
+The reference's evaluator classes were already deprecation-wrappers
+around `fluid.metrics` ("Better to use fluid.metrics", evaluator.py
+docstrings); here they alias the metrics accumulators directly — the
+graph-side accumulator state the old Evaluator managed is covered by the
+metric ops' state inputs (auc's stat buffers, precision_recall's
+StatesInfo, chunk_eval's chunk counts).
+"""
+
+from .metrics import (Accuracy, Auc, ChunkEvaluator,  # noqa: F401
+                      DetectionMAP, EditDistance)
+
+Evaluator = ChunkEvaluator  # historical base-class name

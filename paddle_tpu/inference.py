@@ -104,8 +104,15 @@ class Predictor:
             sig_path = path + ".json"
             if os.path.exists(sig_path):
                 with open(sig_path) as f:
+                    meta = json.load(f)
+                # the artifact is tied to the exact __model__ it was
+                # exported from; a re-saved model invalidates it rather
+                # than silently serving the old graph
+                if meta.get("model_hash") == _model_hash(config.model_dir):
                     self._export_sig = tuple(
-                        (n, tuple(s), d) for n, s, d in json.load(f))
+                        (n, tuple(s), d) for n, s, d in meta["signature"])
+                else:
+                    self._exported = None
 
     # -- introspection (PaddlePredictor parity) -------------------------
     def get_input_names(self) -> List[str]:
@@ -285,5 +292,17 @@ def export_serialized_model(dirname: str, example_feed: Dict[str, np.ndarray],
     sig = sorted((n, list(s.shape), str(np.dtype(s.dtype)))
                  for n, s in feed_spec.items())
     with open(path + ".json", "w") as f:
-        json.dump(sig, f)
+        json.dump({"signature": sig,
+                   "model_hash": _model_hash(dirname)}, f)
     return path
+
+
+def _model_hash(dirname: str) -> str:
+    import hashlib
+
+    from .io import MODEL_FILENAME
+
+    h = hashlib.sha256()
+    with open(os.path.join(dirname, MODEL_FILENAME), "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()

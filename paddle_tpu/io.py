@@ -150,6 +150,12 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
         d["feed_var_names"] = list(feeded_var_names)
         d["fetch_var_names"] = fetch_names
         f.write(dump_program_dict(d))
+    # a re-saved model invalidates any serialized AOT artifact exported
+    # from the previous one (inference.py also hash-checks as a belt)
+    for stale in ("__model__.export", "__model__.export.json"):
+        p = os.path.join(dirname, stale)
+        if os.path.exists(p):
+            os.remove(p)
     params = [v for v in program.list_vars() if v.persistable]
     save_vars(executor, dirname, program, vars=params,
               filename=params_filename)

@@ -1062,3 +1062,38 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
     helper.append_op(type="sampling_id", inputs={"X": [x]},
                      outputs={"Out": [out]}, attrs={"seed": seed})
     return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_len=None):
+    """Chunk-level P/R/F1 for sequence tagging (reference layers/nn.py
+    chunk_eval; IOB scheme).  input/label: (B, T) padded tag ids with a
+    .seq_len companion on `input` (or pass seq_len=)."""
+    from .sequence import seq_len_var
+
+    if chunk_scheme != "IOB":
+        raise NotImplementedError(
+            f"chunk_scheme {chunk_scheme!r}: only IOB is implemented "
+            f"(reference chunk_eval_op.h also supports IOE/IOBES)")
+    helper = LayerHelper("chunk_eval")
+    sl = seq_len if seq_len is not None else seq_len_var(input)
+    if sl is None:
+        raise ValueError("chunk_eval needs a .seq_len companion or "
+                         "seq_len= argument")
+    outs = {}
+    for slot, dtype in [("Precision", "float32"), ("Recall", "float32"),
+                        ("F1-Score", "float32"),
+                        ("NumInferChunks", "int64"),
+                        ("NumLabelChunks", "int64"),
+                        ("NumCorrectChunks", "int64")]:
+        outs[slot] = [helper.create_variable_for_type_inference(dtype)]
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label], "SeqLen": [sl]},
+        outputs=outs,
+        attrs={"num_chunk_types": int(num_chunk_types),
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return (outs["Precision"][0], outs["Recall"][0], outs["F1-Score"][0],
+            outs["NumInferChunks"][0], outs["NumLabelChunks"][0],
+            outs["NumCorrectChunks"][0])

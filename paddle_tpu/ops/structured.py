@@ -436,6 +436,97 @@ def ctc_align(ctx, ins, attrs):
 
 
 # ---------------------------------------------------------------------------
+# chunk_eval (IOB tagging chunks)
+# ---------------------------------------------------------------------------
+
+def _iob_begin_end(tags, seq_len, num_chunk_types):
+    """begin/end/type markers for IOB-encoded tags: tag = 2*type + {B:0,
+    I:1}; O = 2*num_chunk_types (reference chunk_eval_op.h IOB scheme)."""
+    B_, T = tags.shape
+    t_ix = jnp.arange(T)[None, :]
+    valid = t_ix < seq_len[:, None]
+    is_o = tags >= 2 * num_chunk_types
+    ctype = jnp.where(is_o, -1, tags // 2)
+    is_b = (~is_o) & (tags % 2 == 0)
+    prev_ctype = jnp.concatenate(
+        [jnp.full((B_, 1), -2, ctype.dtype), ctype[:, :-1]], axis=1)
+    prev_in = jnp.concatenate(
+        [jnp.zeros((B_, 1), bool), (~is_o)[:, :-1]], axis=1)
+    # chunk starts at B, or at I not continuing a same-type chunk
+    begin = (~is_o) & (is_b | ~(prev_in & (prev_ctype == ctype))) & valid
+    next_ctype = jnp.concatenate(
+        [ctype[:, 1:], jnp.full((B_, 1), -2, ctype.dtype)], axis=1)
+    next_begin = jnp.concatenate(
+        [begin[:, 1:], jnp.zeros((B_, 1), bool)], axis=1)
+    next_valid = jnp.concatenate(
+        [valid[:, 1:], jnp.zeros((B_, 1), bool)], axis=1)
+    cont = next_valid & (next_ctype == ctype) & ~next_begin
+    end = (~is_o) & valid & ~cont
+    return begin, end, ctype, valid
+
+
+@register_op("chunk_eval")
+def chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 for IOB sequence tagging
+    (reference: paddle/fluid/operators/chunk_eval_op.cc, metrics
+    consumed by ChunkEvaluator).  inputs: Inference (B, T), Label (B, T),
+    SeqLen (B,).  outputs: Precision, Recall, F1-Score (scalars) +
+    NumInferChunks/NumLabelChunks/NumCorrectChunks (int64)."""
+    inf = first(ins, "Inference").astype(jnp.int32)
+    lab = first(ins, "Label").astype(jnp.int32)
+    seq_len = first(ins, "SeqLen").astype(jnp.int32)
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    nct = int(attrs["num_chunk_types"])
+    excluded = list(attrs.get("excluded_chunk_types") or [])
+    if excluded:
+        # excluded chunk types count as outside (O) on both sides
+        # (reference chunk_eval_op.h isExcludedChunkType)
+        o_tag = 2 * nct
+        for t in excluded:
+            inf = jnp.where(inf // 2 == int(t), o_tag, inf)
+            lab = jnp.where(lab // 2 == int(t), o_tag, lab)
+    ib, ie, it, valid = _iob_begin_end(inf, seq_len, nct)
+    lb, le, lt, _ = _iob_begin_end(lab, seq_len, nct)
+
+    num_inf = jnp.sum(ib)
+    num_lab = jnp.sum(lb)
+    # A chunk (i, j, τ) is correct iff both sequences start a τ-chunk at
+    # i, both stay inside it (same type, no internal begin on either
+    # side), and both end at j.  Tags need NOT be equal: a broken-I start
+    # on one side matches a B start on the other (both are chunk begins).
+    both_begin = ib & lb & (it == lt)
+    in_inf = it >= 0
+    in_lab = lt >= 0
+    T = inf.shape[1]
+
+    def step(carry, t):
+        continuing = (carry & ~ib[:, t] & ~lb[:, t]
+                      & in_inf[:, t] & in_lab[:, t]
+                      & (it[:, t] == lt[:, t]))
+        matching = both_begin[:, t] | continuing
+        done = matching & le[:, t] & ie[:, t]
+        nxt = matching & ~le[:, t] & ~ie[:, t]
+        return nxt, done
+
+    _, dones = lax.scan(step, jnp.zeros(inf.shape[0], bool),
+                        jnp.arange(T))
+    num_correct = jnp.sum(dones)
+
+    prec = jnp.where(num_inf > 0, num_correct / num_inf, 0.0)
+    rec = jnp.where(num_lab > 0, num_correct / num_lab, 0.0)
+    f1 = jnp.where(num_correct > 0, 2 * prec * rec / (prec + rec), 0.0)
+    return out(**{"Precision": prec.reshape((1,)).astype(jnp.float32),
+                  "Recall": rec.reshape((1,)).astype(jnp.float32),
+                  "F1-Score": f1.reshape((1,)).astype(jnp.float32),
+                  "NumInferChunks": num_inf.reshape((1,)),
+                  "NumLabelChunks": num_lab.reshape((1,)),
+                  "NumCorrectChunks": num_correct.reshape((1,))})
+
+
+# ---------------------------------------------------------------------------
 # sampling_id
 # ---------------------------------------------------------------------------
 

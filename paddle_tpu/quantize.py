@@ -50,7 +50,14 @@ class QuantizeTranspiler:
     # -- public API (reference quantize_transpiler.py API) ---------------
     def training_transpile(self, program: Optional[Program] = None,
                            startup_program: Optional[Program] = None):
+        from .core.program import default_startup_program
+
         program = program or default_main_program()
+        if startup_program is None:
+            # moving-average scale state must get its init op somewhere —
+            # the reference-compatible no-arg call uses the default
+            # startup program
+            startup_program = default_startup_program()
         if program._backward_info is not None:
             raise RuntimeError(
                 "QuantizeTranspiler must run before append_backward/"

@@ -1,0 +1,167 @@
+"""Python metric accumulators + chunk_eval op tests
+(reference: python/paddle/fluid/metrics.py:1, evaluator.py:1,
+operators/chunk_eval_op.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics
+from tests.op_test import run_op
+
+
+def _auc_reference(scores, labels):
+    """Exact pairwise (Mann-Whitney) ROC AUC."""
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    gt = (pos[:, None] > neg[None, :]).sum()
+    eq = (pos[:, None] == neg[None, :]).sum()
+    return (gt + 0.5 * eq) / (len(pos) * len(neg))
+
+
+def test_auc_accumulator_matches_exact():
+    rng = np.random.RandomState(0)
+    auc = metrics.Auc(num_thresholds=4095)
+    all_scores, all_labels = [], []
+    for _ in range(5):  # batch accumulation
+        scores = rng.rand(200).astype(np.float32)
+        labels = rng.randint(0, 2, 200)
+        auc.update(np.stack([1 - scores, scores], 1), labels)
+        all_scores.append(scores)
+        all_labels.append(labels)
+    got = auc.eval()
+    want = _auc_reference(np.concatenate(all_scores),
+                          np.concatenate(all_labels))
+    assert abs(got - want) < 5e-3, (got, want)
+
+
+def test_precision_recall_accumulators():
+    p = metrics.Precision()
+    r = metrics.Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6, 0.1])
+    labels = np.array([1, 0, 1, 1, 0])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # thresholded at 0.5: predictions [1,1,0,1,0]; tp=2 fp=1 fn=1
+    assert p.eval() == pytest.approx(2 / 3)
+    assert r.eval() == pytest.approx(2 / 3)
+    # accumulate a second batch
+    p.update(np.array([0.7]), np.array([1]))
+    assert p.eval() == pytest.approx(3 / 4)
+
+
+def test_accuracy_weighted_mean():
+    acc = metrics.Accuracy()
+    acc.update(value=0.5, weight=10)
+    acc.update(value=1.0, weight=30)
+    assert acc.eval() == pytest.approx(0.875)
+    acc.reset()
+    with pytest.raises(ValueError):
+        acc.eval()
+
+
+def test_edit_distance_metric():
+    m = metrics.EditDistance()
+    m.update(np.array([[0.0], [2.0], [1.0]]), 3)
+    m.update(np.array([[0.0]]), 1)
+    avg, err = m.eval()
+    assert avg == pytest.approx(3.0 / 4)
+    assert err == pytest.approx(2.0 / 4)
+
+
+def test_composite_metric():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    c.update(np.array([0.9, 0.1]), np.array([1, 1]))
+    res = c.eval()
+    assert res[0] == pytest.approx(1.0)   # precision
+    assert res[1] == pytest.approx(0.5)   # recall
+
+
+def _iob_chunks(tags, L, num_types):
+    """Reference chunk extraction (IOB: tag = 2*type + {B:0, I:1})."""
+    chunks = []
+    start = None
+    ctype = None
+    for t in range(L):
+        tag = tags[t]
+        if tag >= 2 * num_types:  # O
+            if start is not None:
+                chunks.append((start, t - 1, ctype))
+                start = None
+            continue
+        typ, pos = tag // 2, tag % 2
+        if pos == 0 or start is None or typ != ctype:  # B or broken I
+            if start is not None:
+                chunks.append((start, t - 1, ctype))
+            start, ctype = t, typ
+    if start is not None:
+        chunks.append((start, L - 1, ctype))
+    return set(chunks)
+
+
+def test_chunk_eval_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    B, T, NT = 6, 12, 3
+    o_tag = 2 * NT
+    inf = rng.randint(0, o_tag + 1, (B, T)).astype(np.int64)
+    lab = rng.randint(0, o_tag + 1, (B, T)).astype(np.int64)
+    seq_len = rng.randint(4, T + 1, B).astype(np.int32)
+    n_inf = run_op("chunk_eval",
+                   {"Inference": inf, "Label": lab, "SeqLen": seq_len},
+                   attrs={"num_chunk_types": NT},
+                   out_slot="NumInferChunks")
+    n_lab = run_op("chunk_eval",
+                   {"Inference": inf, "Label": lab, "SeqLen": seq_len},
+                   attrs={"num_chunk_types": NT},
+                   out_slot="NumLabelChunks")
+    n_cor = run_op("chunk_eval",
+                   {"Inference": inf, "Label": lab, "SeqLen": seq_len},
+                   attrs={"num_chunk_types": NT},
+                   out_slot="NumCorrectChunks")
+    wi = wl = wc = 0
+    for b in range(B):
+        ci = _iob_chunks(inf[b], seq_len[b], NT)
+        cl = _iob_chunks(lab[b], seq_len[b], NT)
+        wi += len(ci)
+        wl += len(cl)
+        wc += len(ci & cl)
+    assert n_inf[0] == wi, (n_inf, wi)
+    assert n_lab[0] == wl, (n_lab, wl)
+    assert n_cor[0] == wc, (n_cor, wc)
+
+
+def test_chunk_evaluator_accumulates():
+    ev = metrics.ChunkEvaluator()
+    ev.update(10, 8, 6)
+    ev.update(5, 7, 4)
+    p, r, f1 = ev.eval()
+    assert p == pytest.approx(10 / 15)
+    assert r == pytest.approx(10 / 15)
+    assert f1 == pytest.approx(10 / 15)
+
+
+def test_chunk_eval_layer_in_program():
+    B, T, NT = 3, 6, 2
+    rng = np.random.RandomState(2)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        from paddle_tpu import layers
+
+        inf = layers.data("inf", shape=[B, T], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        lab = layers.data("lab", shape=[B, T], dtype="int64",
+                          append_batch_size=False)
+        (prec, rec, f1, ni, nl, nc) = layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=NT)
+    exe = fluid.Executor()
+    tags = rng.randint(0, 2 * NT + 1, (B, T)).astype(np.int64)
+    res = exe.run(main,
+                  feed={"inf": tags, "inf.seq_len": np.full(B, T, np.int32),
+                        "lab": tags},
+                  fetch_list=[prec, rec, f1, ni, nl, nc])
+    # identical sequences → perfect P/R/F1
+    assert res[0][0] == pytest.approx(1.0)
+    assert res[1][0] == pytest.approx(1.0)
+    assert res[3][0] == res[4][0] == res[5][0]

@@ -1,0 +1,133 @@
+"""Device-fed input pipeline tests (reference pattern: reader decorator
+tests + buffered_reader semantics)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.data.pipeline import DeviceFeeder, PyReader
+
+
+def test_device_feeder_order_and_completeness():
+    def reader():
+        for i in range(10):
+            yield {"x": np.full((2, 2), i, np.float32)}
+
+    feeder = DeviceFeeder(reader, capacity=2)
+    seen = [int(np.asarray(b["x"])[0, 0]) for b in feeder]
+    assert seen == list(range(10))
+
+
+def test_device_feeder_prefetches_ahead():
+    produced = []
+    gate = threading.Event()
+
+    def reader():
+        for i in range(6):
+            produced.append(i)
+            yield {"x": np.zeros((1,), np.float32)}
+
+    feeder = iter(DeviceFeeder(reader, capacity=3).start())
+    next(feeder)  # consume one
+    deadline = time.time() + 5
+    # producer should run ahead: 1 consumed + 3 queued + 1 blocked-in-put
+    while len(produced) < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(produced) >= 4, f"no prefetch overlap: produced={produced}"
+    del gate
+    # drain cleanly
+    rest = list(feeder)
+    assert len(rest) == 5
+
+
+def test_device_feeder_propagates_reader_error():
+    def reader():
+        yield {"x": np.zeros((1,), np.float32)}
+        raise ValueError("boom in reader")
+
+    feeder = iter(DeviceFeeder(reader, capacity=2).start())
+    next(feeder)
+    with pytest.raises(ValueError, match="boom in reader"):
+        next(feeder)
+
+
+def test_device_feeder_restartable():
+    def reader():
+        for i in range(3):
+            yield {"x": np.full((1,), i, np.float32)}
+
+    feeder = DeviceFeeder(reader, capacity=2)
+    assert len(list(feeder)) == 3
+    assert len(list(feeder)) == 3  # fresh pass after exhaustion
+
+
+def test_pyreader_trains_model():
+    B = 4
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[B, 8], append_batch_size=False)
+        y = layers.data("y", shape=[B, 1], append_batch_size=False)
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        rng = np.random.RandomState(0)
+        w = rng.rand(8, 1).astype(np.float32)
+
+        def batches():
+            r = np.random.RandomState(1)
+            for _ in range(20):
+                xv = r.rand(B, 8).astype(np.float32)
+                yield xv, xv @ w
+
+        reader = PyReader(feed_list=[x, y], capacity=3)
+        reader.decorate_batch_generator(batches)
+        losses = []
+        for feed in reader:
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(lv.reshape(())))
+    assert len(losses) == 20
+    assert losses[-1] < losses[0]
+
+
+def test_pyreader_validates_arity():
+    reader = PyReader(feed_list=["a", "b"], capacity=1)
+    reader.decorate_batch_generator(
+        lambda: iter([(np.zeros(1),)]))  # 1 array for 2 vars
+    it = iter(reader)
+    with pytest.raises(ValueError, match="feed vars"):
+        next(it)
+
+
+def test_bench_synthetic_mode_runs():
+    """The fresh-on-device data mode must produce distinct batches per
+    step (loss varies) — guards the frozen-feed caveat from round 1."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    B = 4
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[B, 8], append_batch_size=False)
+        pred = layers.fc(x, size=1,
+                         param_attr=fluid.ParamAttr(
+                             name="w",
+                             initializer=fluid.initializer.Constant(1.0)))
+        loss = layers.reduce_mean(pred)
+        main.global_block().prepend_op(
+            "uniform_random", outputs={"Out": ["x"]},
+            attrs={"shape": [B, 8], "min": 0.0, "max": 1.0,
+                   "dtype": "float32"})
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = [float(exe.run(main, feed={}, fetch_list=[loss])[0][0])
+                for _ in range(3)]
+    assert len(set(vals)) == 3, f"batches not fresh: {vals}"
