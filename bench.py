@@ -89,6 +89,8 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
+    if data_mode not in ("frozen", "synthetic", "host"):
+        raise ValueError(f"unknown data_mode {data_mode!r}")
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     rng = np.random.RandomState(0)

@@ -23,6 +23,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from .decorator import _ReaderError
+
 _STOP = object()
 
 
@@ -99,8 +101,8 @@ class DeviceFeeder:
                 if not self._put(q, placed):
                     return
             self._put(q, _STOP)
-        except Exception as e:  # surfaced on the consumer side
-            self._put(q, _ReaderFailure(e))
+        except BaseException as e:  # surfaced on the consumer side
+            self._put(q, _ReaderError(e))
 
     # -- consumer -------------------------------------------------------
     def __iter__(self):
@@ -116,15 +118,10 @@ class DeviceFeeder:
             self._queue = None
             self._thread = None
             raise StopIteration
-        if isinstance(item, _ReaderFailure):
+        if isinstance(item, _ReaderError):
             self._queue = None
             raise item.error
         return item
-
-
-class _ReaderFailure:
-    def __init__(self, error: Exception):
-        self.error = error
 
 
 class PyReader:
@@ -139,10 +136,16 @@ class PyReader:
     """
 
     def __init__(self, feed_list: Sequence, capacity: int = 2):
-        self._names: List[str] = [
-            v if isinstance(v, str) else v.name for v in feed_list
-        ]
-        # sequence inputs carry their .seq_len companions automatically
+        self._names: List[str] = []
+        for v in feed_list:
+            name = v if isinstance(v, str) else v.name
+            self._names.append(name)
+            # sequence inputs (lod_level > 0) need their .seq_len
+            # companion fed too: expect it as the next tuple slot
+            # (mirrors DataFeeder, data/data_feeder.py)
+            if (not isinstance(v, str)
+                    and getattr(v.desc, "lod_level", 0) > 0):
+                self._names.append(f"{name}.seq_len")
         self._capacity = capacity
         self._feeder: Optional[DeviceFeeder] = None
         self._gen = None

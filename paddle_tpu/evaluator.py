@@ -9,6 +9,9 @@ StatesInfo, chunk_eval's chunk counts).
 """
 
 from .metrics import (Accuracy, Auc, ChunkEvaluator,  # noqa: F401
-                      DetectionMAP, EditDistance)
+                      DetectionMAP, EditDistance, MetricBase)
 
-Evaluator = ChunkEvaluator  # historical base-class name
+
+class Evaluator(MetricBase):
+    """Historical extension base (reference evaluator.py Evaluator):
+    subclasses implement update()/eval() like any MetricBase."""

@@ -29,9 +29,7 @@ import numpy as np
 from .core.executor import (RNG_STATE_VAR, Scope, interpret_program,
                             prune_ops)
 from .core.program import Program
-from .io import load_inference_model
-
-EXPORT_FILENAME = "__model__.export"
+from .io import EXPORT_FILENAME, load_inference_model
 
 
 class AnalysisConfig:
@@ -106,9 +104,11 @@ class Predictor:
                 with open(sig_path) as f:
                     meta = json.load(f)
                 # the artifact is tied to the exact __model__ it was
-                # exported from; a re-saved model invalidates it rather
-                # than silently serving the old graph
-                if meta.get("model_hash") == _model_hash(config.model_dir):
+                # exported from; a re-saved model (or an unreadable/old-
+                # format sidecar) invalidates it rather than silently
+                # serving the old graph
+                if (isinstance(meta, dict) and meta.get("model_hash")
+                        == _model_hash(config.model_dir)):
                     self._export_sig = tuple(
                         (n, tuple(s), d) for n, s, d in meta["signature"])
                 else:

@@ -25,6 +25,8 @@ from .core.program import Parameter, Program, Variable
 
 MODEL_FILENAME = "__model__"
 MANIFEST = "__manifest__.json"
+# serialized AOT inference artifact (written by inference.py)
+EXPORT_FILENAME = "__model__.export"
 
 
 def _is_parameter(var: Variable) -> bool:
@@ -152,7 +154,7 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
         f.write(dump_program_dict(d))
     # a re-saved model invalidates any serialized AOT artifact exported
     # from the previous one (inference.py also hash-checks as a belt)
-    for stale in ("__model__.export", "__model__.export.json"):
+    for stale in (EXPORT_FILENAME, EXPORT_FILENAME + ".json"):
         p = os.path.join(dirname, stale)
         if os.path.exists(p):
             os.remove(p)

@@ -1,0 +1,177 @@
+"""Detection layers.
+
+reference: python/paddle/fluid/layers/detection.py:1 (1812 LoC) — the
+starter set: prior_box, box_coder, iou_similarity, multiclass_nms,
+yolov3_loss, plus ssd-style helpers.  Ops in ops/detection.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..layer_helper import LayerHelper
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """reference layers/detection.py prior_box."""
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance),
+               "flip": bool(flip), "clip": bool(clip),
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": float(offset)})
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    """reference layers/detection.py box_coder."""
+    helper = LayerHelper("box_coder", name=name)
+    output = helper.create_variable_for_type_inference(target_box.dtype)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(type="box_coder", inputs=ins,
+                     outputs={"OutputBox": [output]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized})
+    return output
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    output = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [output]},
+                     attrs={"box_normalized": box_normalized})
+    return output
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """reference layers/detection.py multiclass_nms.  Static-shape
+    contract: Out is (N, keep_top_k, 6) padded with -1 rows; the second
+    return is the per-image valid count (replaces the LoD)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    output = helper.create_variable_for_type_inference(bboxes.dtype)
+    rois_num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [output], "NmsRoisNum": [rois_num]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k),
+               "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold),
+               "nms_eta": float(nms_eta),
+               "background_label": int(background_label)})
+    return output, rois_num
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    """reference layers/detection.py yolov3_loss."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolov3_loss",
+        inputs={"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]},
+        outputs={"Loss": [loss]},
+        attrs={"anchors": [int(a) for a in anchors],
+               "anchor_mask": [int(m) for m in anchor_mask],
+               "class_num": int(class_num),
+               "ignore_thresh": float(ignore_thresh),
+               "downsample_ratio": int(downsample_ratio)})
+    return loss
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, loc_loss_weight=1.0, conf_loss_weight=1.0,
+             name=None):
+    """Simplified SSD matching loss (reference layers/detection.py
+    ssd_loss — bipartite + per-prediction matching with hard negative
+    mining).  Composed from iou_similarity/box_coder + standard losses at
+    the layer level; per-prior assignment is best-IoU with threshold
+    (the per-prediction half of the reference's strategy)."""
+    from . import nn as nn_layers
+    from . import ops as ops_layers
+    from . import tensor as tensor_layers
+
+    # iou: (num_gt, num_prior); each prior matches its best gt
+    iou = iou_similarity(gt_box, prior_box)
+    best_gt = tensor_layers.argmax(iou, axis=0)          # (P,)
+    best_iou = nn_layers.reduce_max(iou, dim=0)          # (P,)
+    matched = tensor_layers.cast(
+        nn_layers.greater_equal(
+            best_iou, tensor_layers.fill_constant(
+                [1], "float32", overlap_threshold)), "float32")
+
+    # localization targets: encode each prior's matched gt against the
+    # prior (center-size form — the 1:1 case of box_coder, written
+    # elementwise because the op broadcasts all gt×prior pairs)
+    gt_sel = nn_layers.gather(gt_box, best_gt)       # (P, 4)
+
+    def _corners(v):
+        return tuple(
+            nn_layers.reshape(
+                nn_layers.slice(v, axes=[1], starts=[i], ends=[i + 1]),
+                [-1])
+            for i in range(4))
+
+    px1, py1, px2, py2 = _corners(prior_box)
+    gx1, gy1, gx2, gy2 = _corners(gt_sel)
+    pw = nn_layers.elementwise_sub(px2, px1)
+    ph = nn_layers.elementwise_sub(py2, py1)
+    pcx = nn_layers.elementwise_add(px1, nn_layers.scale(pw, 0.5))
+    pcy = nn_layers.elementwise_add(py1, nn_layers.scale(ph, 0.5))
+    gw = nn_layers.elementwise_sub(gx2, gx1)
+    gh = nn_layers.elementwise_sub(gy2, gy1)
+    gcx = nn_layers.elementwise_add(gx1, nn_layers.scale(gw, 0.5))
+    gcy = nn_layers.elementwise_add(gy1, nn_layers.scale(gh, 0.5))
+    ox = nn_layers.elementwise_div(
+        nn_layers.elementwise_sub(gcx, pcx), pw)
+    oy = nn_layers.elementwise_div(
+        nn_layers.elementwise_sub(gcy, pcy), ph)
+    ow = ops_layers.log(nn_layers.elementwise_div(gw, pw))
+    oh = ops_layers.log(nn_layers.elementwise_div(gh, ph))
+    target = tensor_layers.concat(
+        [nn_layers.reshape(v, [-1, 1]) for v in (ox, oy, ow, oh)], axis=1)
+
+    loc_l = nn_layers.reduce_sum(
+        ops_layers.abs(nn_layers.elementwise_sub(location, target)), dim=1)
+    loc_loss = nn_layers.reduce_sum(
+        nn_layers.elementwise_mul(loc_l, matched))
+
+    # confidence: matched priors take their gt's label, rest background
+    lab_sel = tensor_layers.cast(
+        nn_layers.gather(nn_layers.reshape(gt_label, [-1, 1]),
+                             best_gt), "float32")
+    bg = tensor_layers.fill_constant_batch_size_like(
+        matched, [-1], "float32", float(background_label))
+    one = tensor_layers.fill_constant_batch_size_like(
+        matched, [-1], "float32", 1.0)
+    labels = tensor_layers.cast(
+        nn_layers.elementwise_add(
+            nn_layers.elementwise_mul(
+                nn_layers.reshape(lab_sel, [-1]), matched),
+            nn_layers.elementwise_mul(
+                bg, nn_layers.elementwise_sub(one, matched))), "int64")
+    conf_l = nn_layers.softmax_with_cross_entropy(
+        confidence, nn_layers.reshape(labels, [-1, 1]))
+    conf_loss = nn_layers.reduce_sum(conf_l)
+    return nn_layers.elementwise_add(
+        nn_layers.scale(loc_loss, scale=loc_loss_weight),
+        nn_layers.scale(conf_loss, scale=conf_loss_weight))

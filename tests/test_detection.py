@@ -1,0 +1,240 @@
+"""Detection op tests vs numpy references + SSD-head smoke test
+(reference pattern: test_prior_box_op.py, test_box_coder_op.py,
+test_iou_similarity_op.py, test_multiclass_nms_op.py,
+test_yolov3_loss_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from tests.op_test import run_op
+
+
+def _iou_np(a, b):
+    ix1 = max(a[0], b[0])
+    iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2])
+    iy2 = min(a[3], b[3])
+    iw = max(ix2 - ix1, 0.0)
+    ih = max(iy2 - iy1, 0.0)
+    inter = iw * ih
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity_matches_numpy():
+    rng = np.random.RandomState(0)
+    # sorting the (2,2) corner pairs elementwise yields valid
+    # [x1,y1,x2,y2] boxes directly
+    x = np.sort(rng.rand(5, 4).astype(np.float32).reshape(5, 2, 2),
+                axis=1).reshape(5, 4)
+    y = np.sort(rng.rand(7, 4).astype(np.float32).reshape(7, 2, 2),
+                axis=1).reshape(7, 4)
+    got = run_op("iou_similarity", {"X": x, "Y": y})
+    for i in range(5):
+        for j in range(7):
+            assert got[i, j] == pytest.approx(_iou_np(x[i], y[j]),
+                                              abs=1e-5)
+
+
+def test_prior_box_reference():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    boxes = run_op("prior_box", {"Input": feat, "Image": img},
+                   attrs={"min_sizes": [16.0], "max_sizes": [32.0],
+                          "aspect_ratios": [2.0], "flip": True,
+                          "clip": True, "variances": [0.1, 0.1, 0.2, 0.2]},
+                   out_slot="Boxes")
+    # priors per cell: ar 1 + ar 2 + ar 0.5 + max-size box = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    # cell (0,0): center at (0.5*16, 0.5*16) = (8, 8); min_size 16 ar=1
+    # box: [0, 0, 16, 16] / 64
+    np.testing.assert_allclose(boxes[0, 0, 0], [0.0, 0.0, 0.25, 0.25],
+                               atol=1e-6)
+    # max-size box sqrt(16*32) = 22.63
+    s = np.sqrt(16.0 * 32.0) / 2
+    np.testing.assert_allclose(
+        boxes[0, 0, 3],
+        np.clip([(8 - s) / 64, (8 - s) / 64, (8 + s) / 64, (8 + s) / 64],
+                0, 1), atol=1e-5)
+    var = run_op("prior_box", {"Input": feat, "Image": img},
+                 attrs={"min_sizes": [16.0], "variances": [0.1, 0.1,
+                                                           0.2, 0.2]},
+                 out_slot="Variances")
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    M, N = 6, 3
+    prior = np.sort(rng.rand(M, 2, 2),
+                    axis=1).reshape(M, 4).astype(np.float32)
+    pvar = np.full((M, 4), 0.1, np.float32)
+    gt = np.sort(rng.rand(N, 2, 2),
+                 axis=1).reshape(N, 4).astype(np.float32)
+    enc = run_op("box_coder",
+                 {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": gt},
+                 attrs={"code_type": "encode_center_size"},
+                 out_slot="OutputBox")
+    assert enc.shape == (N, M, 4)
+    dec = run_op("box_coder",
+                 {"PriorBox": prior, "PriorBoxVar": pvar,
+                  "TargetBox": enc},
+                 attrs={"code_type": "decode_center_size"},
+                 out_slot="OutputBox")
+    # decoding the encoding recovers each gt against every prior
+    for n in range(N):
+        for m in range(M):
+            np.testing.assert_allclose(dec[n, m], gt[n], rtol=1e-4,
+                                       atol=1e-5)
+
+
+def _nms_np(boxes, scores, score_th, nms_th, top_k):
+    order = np.argsort(-scores)[:top_k]
+    keep = []
+    for i in order:
+        if scores[i] <= score_th:
+            continue
+        ok = True
+        for j in keep:
+            if _iou_np(boxes[i], boxes[j]) > nms_th:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def test_multiclass_nms_matches_numpy():
+    rng = np.random.RandomState(2)
+    N, M, C = 2, 20, 3
+    centers = rng.rand(N, M, 2) * 0.8 + 0.1
+    sizes = rng.rand(N, M, 2) * 0.2 + 0.05
+    bboxes = np.concatenate([centers - sizes / 2, centers + sizes / 2],
+                            axis=2).astype(np.float32)
+    scores = rng.rand(N, C, M).astype(np.float32)
+    attrs = {"background_label": 0, "score_threshold": 0.3,
+             "nms_top_k": 10, "nms_threshold": 0.4, "keep_top_k": 8}
+    got = run_op("multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+                 attrs=attrs)
+    counts = run_op("multiclass_nms",
+                    {"BBoxes": bboxes, "Scores": scores}, attrs=attrs,
+                    out_slot="NmsRoisNum")
+    for n in range(N):
+        expect = []
+        for c in range(1, C):
+            for i in _nms_np(bboxes[n], scores[n, c], 0.3, 0.4, 10):
+                expect.append((c, scores[n, c, i], tuple(bboxes[n, i])))
+        expect.sort(key=lambda e: -e[1])
+        expect = expect[:8]
+        assert counts[n] == len(expect)
+        for k, (c, s, bx) in enumerate(expect):
+            assert int(got[n, k, 0]) == c
+            assert got[n, k, 1] == pytest.approx(s, rel=1e-5)
+            np.testing.assert_allclose(got[n, k, 2:], bx, rtol=1e-5)
+        # padding rows carry -1
+        if len(expect) < 8:
+            assert (got[n, len(expect):, 0] == -1).all()
+
+
+def test_yolov3_loss_basics():
+    rng = np.random.RandomState(3)
+    N, A, K, H, W = 2, 3, 5, 8, 8
+    x = (rng.randn(N, A * (5 + K), H, W) * 0.1).astype(np.float32)
+    gtbox = np.zeros((N, 4, 4), np.float32)
+    gtlabel = np.full((N, 4), -1, np.int64)
+    # one real gt per image, sized so its best anchor (16, 30 px at
+    # 256 px input) belongs to this head's anchor_mask [0, 1, 2]
+    gtbox[:, 0] = [0.5, 0.5, 0.06, 0.1]
+    gtlabel[:, 0] = 2
+    loss = run_op("yolov3_loss",
+                  {"X": x, "GTBox": gtbox, "GTLabel": gtlabel},
+                  attrs={"anchors": [10, 13, 16, 30, 33, 23, 30, 61,
+                                     62, 45, 59, 119],
+                         "anchor_mask": [0, 1, 2], "class_num": K,
+                         "ignore_thresh": 0.7, "downsample_ratio": 32},
+                  out_slot="Loss")
+    assert loss.shape == (N,)
+    assert (loss > 0).all() and np.isfinite(loss).all()
+    # an image with NO gt only pays the no-objectness cost, so its loss
+    # must be strictly smaller
+    gtlabel2 = np.full((N, 4), -1, np.int64)
+    loss2 = run_op("yolov3_loss",
+                   {"X": x, "GTBox": gtbox, "GTLabel": gtlabel2},
+                   attrs={"anchors": [10, 13, 16, 30, 33, 23, 30, 61,
+                                      62, 45, 59, 119],
+                          "anchor_mask": [0, 1, 2], "class_num": K,
+                          "ignore_thresh": 0.7, "downsample_ratio": 32},
+                   out_slot="Loss")
+    assert (loss2 < loss).all()
+
+
+def test_yolov3_trains():
+    """A one-head YOLO toy model must reduce its loss."""
+    N, A, K, H, W = 2, 3, 4, 4, 4
+    rng = np.random.RandomState(4)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        feat = layers.data("feat", shape=[N, 8, H, W],
+                           append_batch_size=False)
+        gtb = layers.data("gtb", shape=[N, 2, 4], append_batch_size=False)
+        gtl = layers.data("gtl", shape=[N, 2], dtype="int64",
+                          append_batch_size=False)
+        head = layers.conv2d(feat, num_filters=A * (5 + K), filter_size=1)
+        loss_v = layers.detection.yolov3_loss(
+            head, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=K, ignore_thresh=0.7,
+            downsample_ratio=32)
+        loss = layers.reduce_mean(loss_v)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {
+            "feat": rng.randn(N, 8, H, W).astype(np.float32),
+            "gtb": np.tile(np.array([[0.4, 0.6, 0.2, 0.3],
+                                     [0.7, 0.3, 0.1, 0.2]],
+                                    np.float32), (N, 1, 1)),
+            "gtl": np.tile(np.array([1, 3], np.int64), (N, 1)),
+        }
+        losses = [float(exe.run(main, feed=feed,
+                                fetch_list=[loss])[0].reshape(()))
+                  for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.8
+    assert np.isfinite(losses).all()
+
+
+def test_ssd_head_smoke():
+    """SSD head: priors from a feature map + ssd_loss trains."""
+    P = 16  # 4x4 cell grid, 1 prior per cell
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        feat = layers.data("feat", shape=[1, 8, 4, 4],
+                           append_batch_size=False)
+        img = layers.data("img", shape=[1, 3, 64, 64],
+                          append_batch_size=False)
+        priors, _pvar = layers.detection.prior_box(
+            feat, img, min_sizes=[24.0], clip=True)
+        priors2d = layers.reshape(priors, [P, 4])
+        loc = layers.data("loc", shape=[P, 4], append_batch_size=False)
+        conf = layers.data("conf", shape=[P, 3], append_batch_size=False)
+        gtb = layers.data("gtb", shape=[2, 4], append_batch_size=False)
+        gtl = layers.data("gtl", shape=[2, 1], dtype="int64",
+                          append_batch_size=False)
+        loss = layers.detection.ssd_loss(loc, conf, gtb, gtl, priors2d)
+    exe = fluid.Executor()
+    feed = {
+        "feat": np.zeros((1, 8, 4, 4), np.float32),
+        "img": np.zeros((1, 3, 64, 64), np.float32),
+        "loc": rng.randn(P, 4).astype(np.float32) * 0.1,
+        "conf": rng.randn(P, 3).astype(np.float32),
+        "gtb": np.array([[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9]], np.float32),
+        "gtl": np.array([[1], [2]], np.int64),
+    }
+    (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(lv).all() and lv.reshape(-1)[0] > 0
