@@ -101,17 +101,23 @@ class Predictor:
                 self._exported = jax_export.deserialize(f.read())
             sig_path = path + ".json"
             if os.path.exists(sig_path):
-                with open(sig_path) as f:
-                    meta = json.load(f)
                 # the artifact is tied to the exact __model__ it was
-                # exported from; a re-saved model (or an unreadable/old-
-                # format sidecar) invalidates it rather than silently
-                # serving the old graph
-                if (isinstance(meta, dict) and meta.get("model_hash")
-                        == _model_hash(config.model_dir)):
-                    self._export_sig = tuple(
-                        (n, tuple(s), d) for n, s, d in meta["signature"])
-                else:
+                # exported from; a re-saved model or a malformed/old-
+                # format sidecar invalidates it rather than crashing or
+                # silently serving the old graph
+                try:
+                    with open(sig_path) as f:
+                        meta = json.load(f)
+                    ok = (isinstance(meta, dict)
+                          and meta.get("model_hash")
+                          == _model_hash(config.model_dir))
+                    if ok:
+                        self._export_sig = tuple(
+                            (n, tuple(s), d)
+                            for n, s, d in meta["signature"])
+                except (ValueError, KeyError, TypeError, OSError):
+                    ok = False
+                if not ok:
                     self._exported = None
 
     # -- introspection (PaddlePredictor parity) -------------------------

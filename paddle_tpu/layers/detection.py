@@ -149,6 +149,10 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     oh = ops_layers.log(nn_layers.elementwise_div(gh, ph))
     target = tensor_layers.concat(
         [nn_layers.reshape(v, [-1, 1]) for v in (ox, oy, ow, oh)], axis=1)
+    if prior_box_var is not None:
+        # encode with the prior variances so box_coder's decode (which
+        # multiplies by them) is the exact inverse at inference
+        target = nn_layers.elementwise_div(target, prior_box_var)
 
     loc_l = nn_layers.reduce_sum(
         ops_layers.abs(nn_layers.elementwise_sub(location, target)), dim=1)
@@ -169,9 +173,30 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                 nn_layers.reshape(lab_sel, [-1]), matched),
             nn_layers.elementwise_mul(
                 bg, nn_layers.elementwise_sub(one, matched))), "int64")
-    conf_l = nn_layers.softmax_with_cross_entropy(
-        confidence, nn_layers.reshape(labels, [-1, 1]))
-    conf_loss = nn_layers.reduce_sum(conf_l)
+    conf_l = nn_layers.reshape(nn_layers.softmax_with_cross_entropy(
+        confidence, nn_layers.reshape(labels, [-1, 1])), [-1])
+    # negative balancing: scale unmatched-prior losses so their expected
+    # total is neg_pos_ratio × the positive count (a soft version of the
+    # reference's hard-negative mining — top-k selection needs a dynamic
+    # k that XLA's static shapes preclude; weighting preserves the same
+    # positive/negative loss balance in expectation)
+    num_pos = nn_layers.reduce_sum(matched)
+    num_neg = nn_layers.elementwise_sub(
+        tensor_layers.fill_constant([1], "float32",
+                                    float(matched.shape[0])), num_pos)
+    neg_w = nn_layers.elementwise_min(
+        tensor_layers.fill_constant([1], "float32", 1.0),
+        nn_layers.elementwise_div(
+            nn_layers.scale(num_pos, scale=float(neg_pos_ratio)),
+            nn_layers.elementwise_max(
+                num_neg, tensor_layers.fill_constant([1], "float32",
+                                                     1.0))))
+    weights = nn_layers.elementwise_add(
+        matched, nn_layers.elementwise_mul(
+            nn_layers.elementwise_sub(one, matched),
+            nn_layers.reshape(neg_w, [1])))
+    conf_loss = nn_layers.reduce_sum(
+        nn_layers.elementwise_mul(conf_l, weights))
     return nn_layers.elementwise_add(
         nn_layers.scale(loc_loss, scale=loc_loss_weight),
         nn_layers.scale(conf_loss, scale=conf_loss_weight))

@@ -344,3 +344,35 @@ def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
                      attrs={"alpha": alpha, "beta": beta})
     _propagate_seq_len(input, out)
     return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference layers/nn.py sequence_scatter — index/updates are
+    per-sequence (padded) with index's .seq_len companion giving true
+    counts."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    sl = seq_len_var(index)
+    if sl is not None:
+        ins["IdsLen"] = [sl]
+    helper.append_op(type="sequence_scatter", inputs=ins,
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """reference layers/nn.py sequence_reshape."""
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    ins = _seq_inputs(input)
+    helper.append_op(type="sequence_reshape", inputs=ins,
+                     outputs={"Out": [out], "OutLen": [out_len]},
+                     attrs={"new_dim": int(new_dim)})
+    block = default_main_program().current_block()
+    alias = block.create_var(name=f"{out.name}.seq_len", shape=(input.shape[0],),
+                             dtype="int32", stop_gradient=True)
+    block.append_op(type="assign", inputs={"X": [out_len]},
+                    outputs={"Out": [alias]})
+    return out

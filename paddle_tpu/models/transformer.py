@@ -24,12 +24,16 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          use_flash=False):
     if keys is None:  # self-attention
         keys, values = queries, queries
+    # layer names drive the Megatron row/col sharding rules
+    # (parallel/strategies.py): attn_qkv_* weights shard column-parallel
+    # (output heads over mp), attn_out_* row-parallel (input heads over
+    # mp) — one all-reduce per attention block instead of three.
     q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False)
+                  bias_attr=False, name="attn_qkv")
     k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False)
+                  bias_attr=False, name="attn_qkv")
     v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
-                  bias_attr=False)
+                  bias_attr=False, name="attn_qkv")
 
     def split_heads(x, d):
         # (N, T, H*d) -> (N, H, T, d)
@@ -56,12 +60,16 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
 
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, n_head * d_value])
-    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2,
+                     bias_attr=False, name="attn_out")
 
 
 def positionwise_feed_forward(x, d_inner, d_model, act="relu"):
-    hidden = layers.fc(x, size=d_inner, num_flatten_dims=2, act=act)
-    return layers.fc(hidden, size=d_model, num_flatten_dims=2)
+    # ffn_in column-parallel, ffn_out row-parallel (classic Megatron MLP)
+    hidden = layers.fc(x, size=d_inner, num_flatten_dims=2, act=act,
+                       name="ffn_in")
+    return layers.fc(hidden, size=d_model, num_flatten_dims=2,
+                     name="ffn_out")
 
 
 def pre_post_process(prev_out, out, process_cmd, dropout_rate=0.0):

@@ -171,23 +171,32 @@ def iou_similarity(ctx, ins, attrs):
 # multiclass_nms
 # ---------------------------------------------------------------------------
 
-def _nms_class(boxes, scores, score_threshold, nms_threshold, top_k):
-    """Single-class NMS over top_k candidates: returns (scores, idx)
-    where suppressed/below-threshold entries carry score -1."""
+def _nms_class(boxes, scores, score_threshold, nms_threshold, top_k,
+               normalized=True, nms_eta=1.0):
+    """Single-class NMS over top_k candidates: returns
+    (scores, keep_mask, idx)."""
     k = min(top_k, scores.shape[0])
     top_scores, order = lax.top_k(scores, k)
     cand = boxes[order]                             # (k, 4)
-    iou = _iou_matrix(cand, cand)                   # (k, k)
+    iou = _iou_matrix(cand, cand, normalized)       # (k, k)
     valid0 = top_scores > score_threshold
 
-    def body(i, keep):
+    def body(i, carry):
+        keep, thr = carry
         # suppress i if any higher-scored kept box overlaps too much
-        mask = (jnp.arange(k) < i) & keep & (iou[i] > nms_threshold)
-        return keep.at[i].set(keep[i] & ~jnp.any(mask))
+        mask = (jnp.arange(k) < i) & keep & (iou[i] > thr)
+        kept_i = keep[i] & ~jnp.any(mask)
+        keep = keep.at[i].set(kept_i)
+        # adaptive NMS (reference nms_eta < 1): shrink the threshold
+        # after each kept candidate while it stays above 0.5
+        if nms_eta < 1.0:
+            thr = jnp.where(kept_i & (thr > 0.5), thr * nms_eta, thr)
+        return keep, thr
 
-    keep = lax.fori_loop(1, k, body, valid0)
+    keep, _ = lax.fori_loop(
+        1, k, body, (valid0, jnp.asarray(nms_threshold, jnp.float32)))
     keep = keep & valid0
-    return jnp.where(keep, top_scores, -1.0), order
+    return top_scores, keep, order
 
 
 @register_op("multiclass_nms")
@@ -205,16 +214,20 @@ def multiclass_nms(ctx, ins, attrs):
     nms_top_k = int(attrs.get("nms_top_k", 100))
     nms_th = float(attrs.get("nms_threshold", 0.3))
     keep_top_k = int(attrs.get("keep_top_k", 100))
+    normalized = bool(attrs.get("normalized", True))
+    nms_eta = float(attrs.get("nms_eta", 1.0))
     N, C, M = scores.shape
+    NEG = jnp.asarray(-1e30, scores.dtype)  # suppression sentinel, below
+    # any real score (keeps validity distinct from legit <=0 scores)
 
     def per_image(boxes, sc):
         all_scores, all_idx, all_label = [], [], []
         for c in range(C):
             if c == background:
                 continue
-            s, order = _nms_class(boxes, sc[c], score_th, nms_th,
-                                  nms_top_k)
-            all_scores.append(s)
+            s, keep, order = _nms_class(boxes, sc[c], score_th, nms_th,
+                                        nms_top_k, normalized, nms_eta)
+            all_scores.append(jnp.where(keep, s, NEG))
             all_idx.append(order)
             all_label.append(jnp.full(s.shape, c, jnp.int32))
         cat_s = jnp.concatenate(all_scores)
@@ -222,15 +235,16 @@ def multiclass_nms(ctx, ins, attrs):
         cat_l = jnp.concatenate(all_label)
         k = min(keep_top_k, cat_s.shape[0])
         top_s, pick = lax.top_k(cat_s, k)
-        lab = jnp.where(top_s > 0, cat_l[pick], -1)
+        valid = top_s > NEG / 2
+        lab = jnp.where(valid, cat_l[pick], -1)
         bx = boxes[cat_i[pick]]
         rows = jnp.concatenate(
             [lab[:, None].astype(boxes.dtype), top_s[:, None], bx], axis=1)
-        rows = jnp.where(top_s[:, None] > 0, rows, -1.0)
+        rows = jnp.where(valid[:, None], rows, -1.0)
         if k < keep_top_k:
             rows = jnp.pad(rows, ((0, keep_top_k - k), (0, 0)),
                            constant_values=-1.0)
-        count = jnp.sum(top_s > 0)
+        count = jnp.sum(valid)
         return rows, count
 
     rows, counts = jax.vmap(per_image)(bboxes, scores)

@@ -239,3 +239,57 @@ def proximal_adagrad(ctx, ins, attrs):
     p_new = jnp.sign(prox) * jnp.maximum(
         jnp.abs(prox) - eff_lr * l1, 0.0) / (1.0 + eff_lr * l2)
     return {"ParamOut": [p_new], "MomentOut": [m_new]}
+
+
+@register_op("average_accumulates")
+def average_accumulates(ctx, ins, attrs):
+    """Parameter-averaging window accumulators (reference:
+    optimizers/average_accumulates_op.cc, driving ModelAverage):
+
+      num_updates += 1;  num_accumulates += 1;  sum_1 += param
+      if num_updates % max_acc == 0:  sum_2 += sum_1; sum_1 = 0
+      if num_accumulates >= min_window and
+         num_accumulates >= min(max_window, num_updates * window_rate):
+          sum_3 = sum_1 + sum_2; sum_1 = sum_2 = 0
+          old_num_accumulates = num_accumulates; num_accumulates = 0
+    """
+    p = first(ins, "Param")
+    s1 = first(ins, "Sum1")
+    s2 = first(ins, "Sum2")
+    s3 = first(ins, "Sum3")
+    num_acc = first(ins, "NumAccumulates").reshape(())
+    old_num = first(ins, "OldNumAccumulates").reshape(())
+    num_upd = first(ins, "NumUpdates").reshape(())
+    rate = float(attrs.get("average_window", 0.0))
+    max_acc = int(attrs.get("max_average_window", 10000))
+    min_w = int(attrs.get("min_average_window", 10000))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+    roll = (num_upd % max(max_acc, 1)) == 0
+    s2 = jnp.where(roll, s2 + s1, s2)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(jnp.asarray(float(max_acc)),
+                         num_upd.astype(jnp.float32) * rate)
+    emit = (num_acc >= min_w) & (num_acc.astype(jnp.float32) >= window)
+    s3 = jnp.where(emit, s1 + s2, s3)
+    s1 = jnp.where(emit, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(emit, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(emit, num_acc, old_num)
+    num_acc = jnp.where(emit, jnp.zeros_like(num_acc), num_acc)
+    return {"Sum1Out": [s1], "Sum2Out": [s2], "Sum3Out": [s3],
+            "NumAccumulatesOut": [num_acc.reshape((1,))],
+            "OldNumAccumulatesOut": [old_num.reshape((1,))],
+            "NumUpdatesOut": [num_upd.reshape((1,))]}
+
+
+@register_op("ema_accumulate")
+def ema_accumulate(ctx, ins, attrs):
+    """Exponential moving average of a param (reference: fluid's
+    ExponentialMovingAverage builds this from scale/sum ops;
+    one fused op here): ema = decay * ema + (1 - decay) * param."""
+    p = first(ins, "Param")
+    ema = first(ins, "Ema")
+    decay = float(attrs.get("decay", 0.999))
+    return {"EmaOut": [decay * ema + (1.0 - decay) * p]}

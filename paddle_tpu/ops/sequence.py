@@ -261,3 +261,54 @@ def add_position_encoding(ctx, ins, attrs):
     pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
     pe = pe.at[:, 1::2].set(jnp.cos(pos * div[: d // 2]))
     return out(Out=(alpha * x + beta * pe[None]).astype(x.dtype))
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(ctx, ins, attrs):
+    """Scatter per-sequence updates into X rows (reference
+    sequence_ops/sequence_scatter_op.cc): X (N, D); Ids (N, U) column
+    indices with IdsLen (N,) true counts; Updates (N, U).  Out[n, ids] +=
+    updates for the first IdsLen[n] entries."""
+    x = first(ins, "X")
+    ids = first(ins, "Ids").astype(jnp.int32)
+    upd = first(ins, "Updates")
+    ids_len = opt_in(ins, "IdsLen")
+    n, u = ids.shape
+    if ids_len is None:
+        ids_len = jnp.full((n,), u, jnp.int32)
+    else:
+        ids_len = ids_len.astype(jnp.int32)
+    valid = jnp.arange(u)[None, :] < ids_len[:, None]
+    upd = jnp.where(valid, upd, 0.0)
+    # padded entries scatter 0 wherever their id points — harmless
+    def one(row, i, v):
+        return row.at[i].add(v)
+    return out(Out=jax.vmap(one)(x, ids, upd))
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx, ins, attrs):
+    """Re-chunk each sequence to a new feature width (reference
+    sequence_ops/sequence_reshape_op.cc): X (N, T, D) + SeqLen; attr
+    new_dim.  Row n's seq_len*D values re-chunk to rows of new_dim:
+    out (N, T*D//new_dim, new_dim) with OutLen = seq_len*D//new_dim."""
+    x = first(ins, "X")
+    seq_len = opt_in(ins, "SeqLen")
+    new_dim = int(attrs["new_dim"])
+    n, t, d = x.shape
+    if (t * d) % new_dim != 0:
+        raise ValueError(
+            f"sequence_reshape: T*D={t*d} not divisible by new_dim "
+            f"{new_dim}")
+    if seq_len is None:
+        seq_len = jnp.full((n,), t, jnp.int32)
+    if (d % new_dim != 0) and (new_dim % d != 0):
+        raise ValueError("new_dim must divide or be divisible by D for "
+                         "padded re-chunking to preserve row alignment")
+    o = x.reshape(n, (t * d) // new_dim, new_dim)
+    # ceil: a sequence whose seq_len*D is not new_dim-divisible keeps its
+    # tail values in a final partially-padded row instead of silently
+    # truncating them (the reference errors per-sequence; static shapes
+    # preclude a data-dependent raise here, so no data is dropped)
+    out_len = -(-(seq_len.astype(jnp.int32) * d) // new_dim)
+    return out(Out=o, OutLen=out_len)

@@ -1,0 +1,242 @@
+"""Remaining vision operators: pool3d, spp, roi_pool, roi_align,
+affine_channel, affine_grid, crop, unpool.
+
+reference: paddle/fluid/operators/ — pool_op.cc (3d path), spp_op.cc,
+roi_pool_op.cc, roi_align_op.cc, affine_channel_op.cc,
+affine_grid_op.cc, crop_op.cc, unpool_op.cc.
+
+ROI ops take a static (R, 5) roi tensor [batch_idx, x1, y1, x2, y2]
+(batch index in the box replaces the reference's LoD row mapping).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+from .common import first, opt_in, out, pair
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return list(v) if len(v) == 3 else list(v) * 3
+    return [v, v, v]
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    """reference pool_op.cc 3-D kernels; NCDHW."""
+    x = first(ins, "X")
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        o = (jnp.max(x, axis=(2, 3, 4), keepdims=True) if ptype == "max"
+             else jnp.mean(x, axis=(2, 3, 4), keepdims=True))
+        return out(Out=o)
+    ksize = _triple(attrs["ksize"])
+    strides = _triple(attrs.get("strides", 1))
+    pads = _triple(attrs.get("paddings", 0))
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        o = lax.reduce_window(x, -jnp.inf, lax.max, window, stride,
+                              padding)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, stride, padding)
+        if attrs.get("exclusive", True) and any(p > 0 for p in pads):
+            ones = jnp.ones(x.shape[2:], x.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(ksize),
+                                    tuple(strides),
+                                    tuple((p, p) for p in pads))
+            o = s / cnt[None, None]
+        else:
+            o = s / float(ksize[0] * ksize[1] * ksize[2])
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("spp")
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference spp_op.cc): for levels
+    0..L-1, pool to (2^l × 2^l) bins and concat flattened — output
+    (N, C * Σ 4^l)."""
+    x = first(ins, "X")
+    n, c, h, w = x.shape
+    levels = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    pieces = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        window = (1, 1, kh, kw)
+        stride = (1, 1, kh, kw)
+        padding = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                   (pw, kw * bins - w - pw))
+        if ptype == "max":
+            o = lax.reduce_window(x, -jnp.inf, lax.max, window, stride,
+                                  padding)
+        else:
+            o = lax.reduce_window(x, 0.0, lax.add, window, stride,
+                                  padding) / float(kh * kw)
+        pieces.append(o.reshape(n, -1))
+    return out(Out=jnp.concatenate(pieces, axis=1).astype(x.dtype))
+
+
+def _roi_batch_split(rois):
+    """rois (R, 5): [batch_idx, x1, y1, x2, y2] (batch-in-box replaces
+    the reference's LoD mapping)."""
+    return rois[:, 0].astype(jnp.int32), rois[:, 1:]
+
+
+@register_op("roi_pool")
+def roi_pool(ctx, ins, attrs):
+    """Max pooling over ROI bins (reference roi_pool_op.cc)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    _n, c, h, w = x.shape
+    bix, boxes = _roi_batch_split(rois)
+
+    def one(bi, box):
+        fm = x[bi]                                   # (C, H, W)
+        x1 = jnp.round(box[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        # bin edges (float division, floor/ceil per reference)
+        ys = y1 + (jnp.arange(ph) * rh) // ph
+        ye = y1 + -(-((jnp.arange(ph) + 1) * rh) // ph)
+        xs = x1 + (jnp.arange(pw) * rw) // pw
+        xe = x1 + -(-((jnp.arange(pw) + 1) * rw) // pw)
+        yy = jnp.arange(h)[None, :]
+        in_y = (yy >= ys[:, None]) & (yy < ye[:, None])    # (ph, H)
+        xx = jnp.arange(w)[None, :]
+        in_x = (xx >= xs[:, None]) & (xx < xe[:, None])    # (pw, W)
+        m = in_y[:, None, :, None] & in_x[None, :, None, :]  # (ph,pw,H,W)
+        masked = jnp.where(m[None], fm[:, None, None, :, :], -jnp.inf)
+        o = jnp.max(masked, axis=(3, 4))                 # (C, ph, pw)
+        return jnp.where(jnp.isfinite(o), o, 0.0)
+
+    o = jax.vmap(one)(bix, boxes)
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("roi_align")
+def roi_align(ctx, ins, attrs):
+    """Bilinear ROI align (reference roi_align_op.cc)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    _n, c, h, w = x.shape
+    bix, boxes = _roi_batch_split(rois)
+
+    def bilinear(fm, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        ly = yy - y0
+        lx = xx - x0
+        v = (fm[:, y0, x0] * (1 - ly) * (1 - lx)
+             + fm[:, y1, x0] * ly * (1 - lx)
+             + fm[:, y0, x1] * (1 - ly) * lx
+             + fm[:, y1, x1] * ly * lx)
+        return v
+
+    def one(bi, box):
+        fm = x[bi]
+        rx1, ry1 = box[0] * scale, box[1] * scale
+        rw = jnp.maximum(box[2] * scale - rx1, 1.0)
+        rh = jnp.maximum(box[3] * scale - ry1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        iy = (jnp.arange(ph * ratio) + 0.5) / ratio      # sub-samples
+        ix = (jnp.arange(pw * ratio) + 0.5) / ratio
+        yy = ry1 + iy * bh                                # (ph*r,)
+        xx = rx1 + ix * bw
+        grid_y, grid_x = jnp.meshgrid(yy, xx, indexing="ij")
+        vals = bilinear(fm, grid_y.reshape(-1), grid_x.reshape(-1))
+        vals = vals.reshape(c, ph, ratio, pw, ratio)
+        return jnp.mean(vals, axis=(2, 4))
+
+    o = jax.vmap(one)(bix, boxes)
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("affine_channel")
+def affine_channel(ctx, ins, attrs):
+    """Per-channel scale+bias (reference affine_channel_op.cc); NCHW."""
+    x = first(ins, "X")
+    scale = first(ins, "Scale").reshape(-1)
+    bias = first(ins, "Bias").reshape(-1)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    return out(Out=x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@register_op("affine_grid")
+def affine_grid(ctx, ins, attrs):
+    """2-D affine sampling grid from theta (reference affine_grid_op.cc):
+    Theta (N, 2, 3) → Output (N, H, W, 2) normalized coords, align-corner
+    convention matching the reference CPU kernel."""
+    theta = first(ins, "Theta")
+    shape = attrs.get("output_shape")
+    if not shape:
+        shape = [int(s) for s in np.asarray(first(ins, "OutputShape"))]
+    n, _c, h, w = [int(s) for s in shape]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)         # (N, H, W, 2)
+    return out(Output=grid.astype(theta.dtype))
+
+
+@register_op("crop")
+def crop(ctx, ins, attrs):
+    """Static crop (reference crop_op.cc): offsets + shape attrs (or a Y
+    var supplying the target shape)."""
+    x = first(ins, "X")
+    y = opt_in(ins, "Y")
+    shape = attrs.get("shape") or (list(y.shape) if y is not None else None)
+    if shape is None:
+        raise ValueError("crop needs shape attr or Y input")
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    idx = tuple(slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return out(Out=x[idx])
+
+
+@register_op("unpool")
+def unpool(ctx, ins, attrs):
+    """Max-unpooling from pool2d_with_index's Mask (reference
+    unpool_op.cc): scatter values back to their argmax positions in the
+    (unpooled_h, unpooled_w) map."""
+    x = first(ins, "X")
+    mask = first(ins, "Indices").astype(jnp.int32)
+    n, c, ph, pw = x.shape
+    uh = int(attrs["unpooled_height"]) if "unpooled_height" in attrs else None
+    if uh is None:
+        ush = attrs["unpool_size"]
+        uh, uw = int(ush[0]), int(ush[1])
+    else:
+        uw = int(attrs["unpooled_width"])
+    flat_x = x.reshape(n, c, ph * pw)
+    flat_m = mask.reshape(n, c, ph * pw)
+
+    def scatter_plane(vals, pos):
+        return jnp.zeros((uh * uw,), vals.dtype).at[pos].set(vals)
+
+    o = jax.vmap(jax.vmap(scatter_plane))(flat_x, flat_m)
+    return out(Out=o.reshape(n, c, uh, uw))
+

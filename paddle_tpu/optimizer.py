@@ -9,7 +9,11 @@ forward, grads, updates — compiles into one XLA computation.
 
 from __future__ import annotations
 
+import contextlib
+
 from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from .clip import append_gradient_clip_ops
 from .core.backward import append_backward
@@ -353,3 +357,163 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 LarsMomentum = LarsMomentumOptimizer
+
+
+class _ScopeSwapMixin:
+    """Shared apply/restore protocol: back up params, install
+    `_swap_values(param)` for each, restore on exit (the scope-swap both
+    ModelAverage.apply and ExponentialMovingAverage.apply perform in the
+    reference)."""
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        from .core.executor import global_scope
+
+        scope = global_scope()
+        self._backup = {p.name: scope.find_var(p.name)
+                        for p in self._params}
+        for p in self._params:
+            scope.set_var(p.name, jnp.asarray(
+                np.asarray(self._swap_values(p)).astype("float32")))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from .core.executor import global_scope
+
+        scope = global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.set_var(name, val)
+        self._backup = {}
+
+
+class ModelAverage(_ScopeSwapMixin, Optimizer):
+    """Windowed parameter averaging for evaluation
+    (reference: python/paddle/fluid/optimizer.py:1407 ModelAverage +
+    operators/optimizers/average_accumulates_op.cc).
+
+    Build AFTER minimize(); accumulation ops are appended to the main
+    program so every training step updates the window sums.  Use
+    `with ma.apply(exe): ...` to evaluate with averaged weights and
+    restore afterwards.
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self.helper = LayerHelper(self.__class__.__name__)
+        from .core.program import default_main_program
+
+        program = default_main_program()
+        block = program.global_block()
+        self._params = [p for p in block.all_parameters()
+                        if getattr(p, "trainable", True)]
+        for param in self._params:
+            self._append_average_accumulate_op(param)
+
+    def _append_average_accumulate_op(self, param):
+        s1 = self._add_accumulator("sum_1", param)
+        s2 = self._add_accumulator("sum_2", param)
+        s3 = self._add_accumulator("sum_3", param)
+        num_acc = self._add_accumulator("num_accumulates", param,
+                                        shape=[1], dtype="float32")
+        old_num = self._add_accumulator("old_num_accumulates", param,
+                                        shape=[1], dtype="float32")
+        num_upd = self._add_accumulator("num_updates", param,
+                                        shape=[1], dtype="float32")
+        self.helper.append_op(
+            type="average_accumulates",
+            inputs={"Param": [param], "Sum1": [s1], "Sum2": [s2],
+                    "Sum3": [s3], "NumAccumulates": [num_acc],
+                    "OldNumAccumulates": [old_num],
+                    "NumUpdates": [num_upd]},
+            outputs={"Sum1Out": [s1], "Sum2Out": [s2], "Sum3Out": [s3],
+                     "NumAccumulatesOut": [num_acc],
+                     "OldNumAccumulatesOut": [old_num],
+                     "NumUpdatesOut": [num_upd]},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window})
+
+    def _swap_values(self, param):
+        """value to install during apply() — window average."""
+        return self._averaged_value(param)
+
+    def _averaged_value(self, param):
+        from .core.executor import global_scope
+
+        scope = global_scope()
+        import numpy as np
+
+        s1 = np.asarray(scope.find_var(f"{param.name}.sum_1"))
+        s2 = np.asarray(scope.find_var(f"{param.name}.sum_2"))
+        s3 = np.asarray(scope.find_var(f"{param.name}.sum_3"))
+        na = float(np.asarray(
+            scope.find_var(f"{param.name}.num_accumulates")).reshape(()))
+        on = float(np.asarray(scope.find_var(
+            f"{param.name}.old_num_accumulates")).reshape(()))
+        total = na + on
+        if total <= 0:
+            return np.asarray(scope.find_var(param.name))
+        return (s1 + s2 + s3) / total
+
+
+class ExponentialMovingAverage(_ScopeSwapMixin):
+    """EMA shadow weights with apply/restore (fluid's
+    ExponentialMovingAverage; built here on a fused ema_accumulate op
+    instead of the reference's scale/sum op composition).  apply() uses
+    the bias-corrected shadow ema / (1 - decay^t), matching the
+    reference's correction against the zero initialization."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = float(decay)
+        self.helper = LayerHelper("ema", name=name)
+        from .core.program import default_main_program
+
+        block = default_main_program().global_block()
+        self._params = [p for p in block.all_parameters()
+                        if getattr(p, "trainable", True)]
+        self._ema_vars = {}
+        for p in self._params:
+            ema = self.helper.create_or_get_global_variable(
+                name=f"{p.name}.ema", shape=list(p.shape), dtype=p.dtype,
+                persistable=True, initializer=Constant(0.0))
+            self._ema_vars[p.name] = ema
+        self._step_var = self.helper.create_or_get_global_variable(
+            name=f"{self.helper.name}.ema_step", shape=[1],
+            dtype="float32", persistable=True, initializer=Constant(0.0))
+
+    def update(self):
+        """Append the per-step EMA update ops (call after minimize)."""
+        from . import layers
+
+        for p in self._params:
+            ema = self._ema_vars[p.name]
+            self.helper.append_op(
+                type="ema_accumulate",
+                inputs={"Param": [p], "Ema": [ema]},
+                outputs={"EmaOut": [ema]},
+                attrs={"decay": self._decay})
+        layers.increment(self._step_var, value=1.0, in_place=True)
+
+    def _swap_values(self, param):
+        import numpy as np
+
+        from .core.executor import global_scope
+
+        scope = global_scope()
+        ema = np.asarray(scope.find_var(f"{param.name}.ema"))
+        t = float(np.asarray(
+            scope.find_var(self._step_var.name)).reshape(()))
+        if t <= 0:
+            return np.asarray(scope.find_var(param.name))
+        correction = 1.0 - self._decay ** t
+        return ema / correction

@@ -56,17 +56,21 @@ class ShardingRules:
 
 
 # Ready-made rule set for the transformer/bert models in models/:
-# embedding tables sharded over "mp" on the vocab dim, and every fc
-# weight column-parallel (output dim over "mp").  Column-everywhere is a
-# valid TP layout — GSPMD inserts the reduce where a row-parallel layout
-# would have placed its all-reduce; a name-aware column/row split
-# (classic Megatron, one collective per block) needs per-layer naming
-# and is a later-round refinement.
+# embedding tables sharded over "mp" on the vocab dim, and the classic
+# Megatron column/row pairing keyed by layer names
+# (models/transformer.py): attn_qkv + ffn_in weights column-parallel
+# (output dim over mp, activations stay head/hidden-sharded), attn_out +
+# ffn_out row-parallel (input dim over mp) — GSPMD then inserts exactly
+# one all-reduce per attention block and one per MLP block, matching
+# Megatron-LM's layout instead of the column-everywhere fallback.
 def megatron_transformer_rules(fsdp: bool = False) -> ShardingRules:
     return ShardingRules(
         rules=[
             (r"(word_emb|src_word_emb|trg_word_emb|word_embedding|fm_emb)",
              ("mp", None)),
+            (r"(attn_qkv|ffn_in)\S*\.w", (None, "mp")),
+            (r"(attn_out|ffn_out)\S*\.w", ("mp", None)),
+            # any remaining fc (e.g. the softmax projection): column
             (r"fc_\d+\.w_\d+", (None, "mp")),
         ],
         default="fsdp" if fsdp else None,
