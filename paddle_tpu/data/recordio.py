@@ -52,17 +52,20 @@ class Writer:
     def _flush_chunk(self):
         if not self._records:
             return
-        buf = io.BytesIO()
-        for r in self._records:
-            buf.write(struct.pack("<I", len(r)))
-            buf.write(r)
-        payload = buf.getvalue()
-        if self._compressor == COMPRESS_ZLIB:
-            payload = zlib.compress(payload)
-        crc = zlib.crc32(payload) & 0xFFFFFFFF
-        self._f.write(_HEADER.pack(MAGIC, self._compressor,
-                                   len(self._records), len(payload), crc))
-        self._f.write(payload)
+        chunk = _encode_chunk_native(self._records, self._compressor)
+        if chunk is None:
+            buf = io.BytesIO()
+            for r in self._records:
+                buf.write(struct.pack("<I", len(r)))
+                buf.write(r)
+            payload = buf.getvalue()
+            if self._compressor == COMPRESS_ZLIB:
+                payload = zlib.compress(payload)
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            chunk = _HEADER.pack(MAGIC, self._compressor,
+                                 len(self._records), len(payload),
+                                 crc) + payload
+        self._f.write(chunk)
         self._records = []
 
     def close(self):
@@ -96,6 +99,10 @@ class Scanner:
                 payload = f.read(plen)
                 if len(payload) < plen:
                     raise IOError("truncated recordio chunk payload")
+                records = _decode_chunk_native(head + payload, n)
+                if records is not None:
+                    yield from records
+                    continue
                 if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                     raise IOError("recordio chunk CRC mismatch")
                 if comp == COMPRESS_ZLIB:
@@ -106,6 +113,65 @@ class Scanner:
                     off += 4
                     yield payload[off:off + rlen]
                     off += rlen
+
+
+# ---------------------------------------------------------------------------
+# native codec bridge (ctypes → paddle_tpu/native/recordio.cc; wire
+# format byte-identical, so files interoperate with the python fallback)
+# ---------------------------------------------------------------------------
+
+def _encode_chunk_native(records: Sequence[bytes], compressor: int):
+    import ctypes
+
+    from ..native import recordio_lib
+
+    lib = recordio_lib()
+    if lib is None:
+        return None
+    concat = b"".join(records)
+    n = len(records)
+    lens = (ctypes.c_uint32 * n)(*[len(r) for r in records])
+    cap = lib.rio_encode_bound(len(concat), n)
+    out_buf = ctypes.create_string_buffer(int(cap))
+    written = lib.rio_encode_chunk(concat, lens, n, compressor, out_buf,
+                                   cap)
+    if written < 0:
+        return None
+    return out_buf.raw[:written]
+
+
+def _decode_chunk_native(chunk: bytes, n: int):
+    import ctypes
+
+    from ..native import recordio_lib
+
+    lib = recordio_lib()
+    if lib is None:
+        return None
+    # worst case: payload fully expands; retry with growth on -5
+    cap = max(4 * len(chunk), 1 << 16)
+    for _ in range(6):
+        out_buf = ctypes.create_string_buffer(int(cap))
+        lens = (ctypes.c_uint32 * max(n, 1))()
+        n_out = ctypes.c_int(0)
+        rc = lib.rio_decode_chunk(chunk, len(chunk), out_buf, cap, lens,
+                                  max(n, 1), ctypes.byref(n_out))
+        if rc == 0:
+            records = []
+            off = 0
+            for i in range(n_out.value):
+                records.append(out_buf.raw[off:off + lens[i]])
+                off += lens[i]
+            return records
+        if rc == -5:
+            cap *= 4
+            continue
+        if rc == -3:
+            raise IOError("recordio chunk CRC mismatch")
+        if rc in (-1, -2, -6):
+            raise IOError(f"corrupt recordio chunk (native rc={rc})")
+        return None  # -4 zlib issue: let python path try
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -1204,3 +1204,46 @@ def unpool(input, indices, unpool_size, name=None):
         outputs={"Out": [out]},
         attrs={"unpool_size": [int(s) for s in unpool_size]})
     return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both", name=None):
+    """In-graph tensor dump (reference layers/control_flow.py Print;
+    lowered to jax.debug.print, which streams asynchronously from the
+    device)."""
+    helper = LayerHelper("print", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or "",
+                            "summarize": int(summarize)})
+    out.desc.shape = tuple(input.shape)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Call a python function from inside the compiled program
+    (reference layers/nn.py py_func → py_func_op.cc; here a
+    jax.pure_callback host round-trip).  `out` is a Variable or list of
+    Variables with declared shapes/dtypes.  backward_func is not
+    supported: the callback is opaque to jax AD, so use it on
+    stop-gradient paths (metrics, logging, data munging)."""
+    from ..ops.misc import register_py_func
+
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func: the host callback is opaque to jax "
+            "AD; compute gradients in-graph instead")
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    handle = register_py_func(func)
+    helper.append_op(
+        type="py_func", inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"handle": handle,
+               "out_shapes": [list(o.shape) for o in outs],
+               "out_dtypes": [str(o.dtype) for o in outs]})
+    return out

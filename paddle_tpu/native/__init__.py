@@ -1,0 +1,59 @@
+"""Native (C++) components, loaded via ctypes.
+
+The reference keeps its record IO, readers, and executors in C++
+(reference: paddle/fluid/recordio/*.cc, operators/reader/*.cc); here the
+hot codec lives in recordio.cc and binds through the C ABI — no pybind
+dependency (ctypes per the environment's binding guidance).  Missing
+toolchain or failed build degrade gracefully to the pure-python
+implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "librecordio.so")
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["sh", os.path.join(_DIR, "build.sh")],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def recordio_lib() -> Optional[ctypes.CDLL]:
+    """The native codec, built on first use; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_DIR, "recordio.cc")
+        if not os.path.exists(src) or not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.rio_encode_bound.restype = ctypes.c_longlong
+    lib.rio_encode_bound.argtypes = [ctypes.c_longlong, ctypes.c_int]
+    lib.rio_encode_chunk.restype = ctypes.c_longlong
+    lib.rio_encode_chunk.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_longlong]
+    lib.rio_decode_chunk.restype = ctypes.c_int
+    lib.rio_decode_chunk.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char_p,
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_int)]
+    _lib = lib
+    return _lib

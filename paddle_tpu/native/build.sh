@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build the native components (reference analog: the cmake targets under
+# paddle/fluid/recordio + train/demo; SURVEY.md §2.6).
+#   sh paddle_tpu/native/build.sh        # builds librecordio.so
+# The python side (native.py) also invokes this lazily on first use and
+# falls back to the pure-python codec when no toolchain is available.
+set -e
+cd "$(dirname "$0")"
+CXX="${CXX:-g++}"
+"$CXX" -O2 -shared -fPIC -o librecordio.so recordio.cc -lz
+echo "built $(pwd)/librecordio.so"

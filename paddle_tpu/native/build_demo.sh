@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build the C++ train demo (reference: paddle/fluid/train/demo build).
+set -e
+cd "$(dirname "$0")"
+CXX="${CXX:-g++}"
+PY_INC="$(python3-config --includes)"
+PY_LD="$(python3-config --ldflags --embed 2>/dev/null \
+         || python3-config --ldflags)"
+# shellcheck disable=SC2086
+"$CXX" -O2 -o train_demo train_demo.cc $PY_INC $PY_LD
+echo "built $(pwd)/train_demo"

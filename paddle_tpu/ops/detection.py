@@ -193,8 +193,12 @@ def _nms_class(boxes, scores, score_threshold, nms_threshold, top_k,
             thr = jnp.where(kept_i & (thr > 0.5), thr * nms_eta, thr)
         return keep, thr
 
-    keep, _ = lax.fori_loop(
-        1, k, body, (valid0, jnp.asarray(nms_threshold, jnp.float32)))
+    # candidate 0 is kept whenever valid, and (reference NMSFast) a kept
+    # box immediately shrinks the adaptive threshold for later candidates
+    thr0 = jnp.asarray(nms_threshold, jnp.float32)
+    if nms_eta < 1.0:
+        thr0 = jnp.where(valid0[0] & (thr0 > 0.5), thr0 * nms_eta, thr0)
+    keep, _ = lax.fori_loop(1, k, body, (valid0, thr0))
     keep = keep & valid0
     return top_scores, keep, order
 

@@ -1,0 +1,97 @@
+"""Misc infra ops: print (in-graph tensor dump) and py_func (call back
+into Python from a compiled program).
+
+reference: paddle/fluid/operators/print_op.cc (debug dump with
+print_phase/summarize), operators/py_func_op.cc (registered python
+callables invoked by the executor).
+
+TPU-native mapping: `print` → jax.debug.print (works inside jit,
+streams from device asynchronously); `py_func` → jax.pure_callback
+(host round-trip per call — correctness escape hatch, not a fast path).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+# py_func registry: attr carries an integer handle (serialization-safe),
+# resolved here at trace time (reference py_func_op.cc keeps a static
+# vector of PyObject callables the same way).
+_PY_FUNCS: Dict[int, Callable] = {}
+
+
+def register_py_func(fn: Callable) -> int:
+    handle = len(_PY_FUNCS)
+    _PY_FUNCS[handle] = fn
+    return handle
+
+
+@register_op("print")
+def print_op(ctx, ins, attrs):
+    """Pass-through with a device-side debug dump (reference
+    print_op.cc: message, summarize, print_tensor_* knobs)."""
+    x = first(ins, "In")
+    message = attrs.get("message", "")
+    summarize = int(attrs.get("summarize", 20))
+    if summarize > 0:
+        flat_preview = x.reshape(-1)[:summarize]
+    else:
+        flat_preview = x
+    jax.debug.print(
+        "{msg} shape={shape} dtype={dtype} data={data}",
+        msg=message or "print_op", shape=str(x.shape),
+        dtype=str(x.dtype), data=flat_preview)
+    return out(Out=x)
+
+
+@register_op("py_func")
+def py_func(ctx, ins, attrs):
+    """Invoke a registered python callable on host (reference
+    py_func_op.cc).  attrs: handle (from register_py_func), out_shapes,
+    out_dtypes describing the callable's outputs."""
+    handle = int(attrs["handle"])
+    fn = _PY_FUNCS.get(handle)
+    if fn is None:
+        raise KeyError(f"py_func handle {handle} is not registered in "
+                       f"this process (handles do not serialize)")
+    xs = ins.get("X", [])
+    shapes = attrs.get("out_shapes", [])
+    dtypes = attrs.get("out_dtypes", [])
+
+    def resolve(shape):
+        # a declared dynamic dim (-1) resolves to the first input's batch
+        # at trace time (pure_callback needs concrete result shapes)
+        resolved = []
+        for d in shape:
+            if d == -1:
+                if not xs:
+                    raise ValueError(
+                        "py_func output declared with -1 dim but the op "
+                        "has no inputs to infer the batch from")
+                resolved.append(xs[0].shape[0])
+            else:
+                resolved.append(int(d))
+        return tuple(resolved)
+
+    result_shape = [
+        jax.ShapeDtypeStruct(resolve(s), jnp.dtype(d))
+        for s, d in zip(shapes, dtypes)
+    ]
+
+    def host_fn(*arrays):
+        import numpy as np
+
+        res = fn(*arrays)
+        if not isinstance(res, (list, tuple)):
+            res = (res,)
+        return tuple(np.asarray(r, dtype=jnp.dtype(d))
+                     for r, d in zip(res, dtypes))
+
+    results = jax.pure_callback(host_fn, tuple(result_shape), *xs)
+    return {"Out": list(results)}

@@ -115,13 +115,26 @@ def roi_pool(ctx, ins, attrs):
         ye = y1 + -(-((jnp.arange(ph) + 1) * rh) // ph)
         xs = x1 + (jnp.arange(pw) * rw) // pw
         xe = x1 + -(-((jnp.arange(pw) + 1) * rw) // pw)
-        yy = jnp.arange(h)[None, :]
-        in_y = (yy >= ys[:, None]) & (yy < ye[:, None])    # (ph, H)
-        xx = jnp.arange(w)[None, :]
-        in_x = (xx >= xs[:, None]) & (xx < xe[:, None])    # (pw, W)
-        m = in_y[:, None, :, None] & in_x[None, :, None, :]  # (ph,pw,H,W)
-        masked = jnp.where(m[None], fm[:, None, None, :, :], -jnp.inf)
-        o = jnp.max(masked, axis=(3, 4))                 # (C, ph, pw)
+        # separable max via per-pixel bin ids + segment_max: each pixel
+        # is touched once per axis ((C,H,pw) intermediate) instead of the
+        # (C,ph,pw,H,W) masked broadcast, which at detection sizes
+        # (R=300, C=256, 7x7 bins, 50x50 maps) would be tens of GB
+        col = jnp.arange(w)
+        bin_x = jnp.sum((col[None, :] >= xs[:, None]), axis=0) - 1
+        in_x = (col >= x1) & (col < x1 + rw)
+        bin_x = jnp.where(in_x, jnp.clip(bin_x, 0, pw - 1), pw)
+        row = jnp.arange(h)
+        bin_y = jnp.sum((row[None, :] >= ys[:, None]), axis=0) - 1
+        in_y = (row >= y1) & (row < y1 + rh)
+        bin_y = jnp.where(in_y, jnp.clip(bin_y, 0, ph - 1), ph)
+        # reduce W → pw (+1 overflow slot for out-of-roi pixels)
+        red_w = jax.ops.segment_max(
+            jnp.moveaxis(fm, 2, 0), bin_x, num_segments=pw + 1,
+            indices_are_sorted=False)                # (pw+1, C, H)
+        red_w = red_w[:pw]
+        red_hw = jax.ops.segment_max(
+            jnp.moveaxis(red_w, 2, 0), bin_y, num_segments=ph + 1)
+        o = jnp.transpose(red_hw[:ph], (2, 0, 1))      # (C, ph, pw)
         return jnp.where(jnp.isfinite(o), o, 0.0)
 
     o = jax.vmap(one)(bix, boxes)
@@ -143,8 +156,15 @@ def roi_align(ctx, ins, attrs):
     bix, boxes = _roi_batch_split(rois)
 
     def bilinear(fm, yy, xx):
-        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
-        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        # reference roi_align_op.cc sampling rules: samples fully outside
+        # [-1, H] contribute 0; coords in [-1, 0) clamp to 0 — the clamp
+        # must happen BEFORE computing the bilinear weights or border
+        # samples extrapolate with weights outside [0, 1]
+        outside = ((yy < -1.0) | (yy > h) | (xx < -1.0) | (xx > w))
+        yy = jnp.clip(yy, 0.0, h - 1)
+        xx = jnp.clip(xx, 0.0, w - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
         y1 = jnp.clip(y0 + 1, 0, h - 1)
         x1 = jnp.clip(x0 + 1, 0, w - 1)
         ly = yy - y0
@@ -153,7 +173,7 @@ def roi_align(ctx, ins, attrs):
              + fm[:, y1, x0] * ly * (1 - lx)
              + fm[:, y0, x1] * (1 - ly) * lx
              + fm[:, y1, x1] * ly * lx)
-        return v
+        return jnp.where(outside[None, :], 0.0, v)
 
     def one(bi, box):
         fm = x[bi]
@@ -192,7 +212,15 @@ def affine_grid(ctx, ins, attrs):
     theta = first(ins, "Theta")
     shape = attrs.get("output_shape")
     if not shape:
-        shape = [int(s) for s in np.asarray(first(ins, "OutputShape"))]
+        out_shape = first(ins, "OutputShape")
+        try:
+            shape = [int(s) for s in np.asarray(out_shape)]
+        except Exception as e:
+            raise ValueError(
+                "affine_grid: OutputShape fed as a runtime tensor is not "
+                "supported under XLA (grid dims fix the output shape at "
+                "compile time) — pass out_shape as a python list/tuple"
+            ) from e
     n, _c, h, w = [int(s) for s in shape]
     ys = jnp.linspace(-1.0, 1.0, h)
     xs = jnp.linspace(-1.0, 1.0, w)

@@ -117,3 +117,30 @@ def test_debugger_outputs():
     assert "mul" in text and "relu" in text
     dot = debugger.draw_block_graphviz(main.global_block())
     assert dot.startswith("digraph") and '"x"' in dot
+
+
+def test_print_op_passthrough_and_py_func():
+    """print → jax.debug.print passthrough; py_func → pure_callback
+    (reference print_op.cc, py_func_op.cc).  Note: host callbacks need a
+    backend with send/recv support (CPU here; real TPU runtimes support
+    them, the test-tunnel backend does not)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        y = layers.Print(layers.scale(x, 2.0), message="dbg")
+        o = main.global_block().create_var(name="pyout", shape=(4,),
+                                           dtype="float32")
+        layers.py_func(lambda a: a + 1.0, y, o)
+        o2 = main.global_block().create_var(name="pyout2", shape=(1,),
+                                            dtype="float32")
+        layers.py_func(lambda a: a.sum(keepdims=True), x, o2)
+    exe = fluid.Executor()
+    r1, r2 = exe.run(main, feed={"x": np.arange(4, dtype=np.float32)},
+                     fetch_list=[o, o2])
+    np.testing.assert_allclose(r1, np.arange(4) * 2 + 1)
+    np.testing.assert_allclose(r2, [6.0])

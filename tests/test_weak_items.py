@@ -304,3 +304,56 @@ def test_extra_grad_checks(op_type, ins, attrs, slot):
         # some ops name their output slot differently; surface clearly
         raise AssertionError(
             f"{op_type}: output slot {slot!r} missing")
+
+
+# ---------------------------------------------------------------------------
+# native components
+# ---------------------------------------------------------------------------
+
+def test_native_recordio_codec_interop(tmp_path):
+    """Native (C++) codec and pure-python fallback produce byte-compatible
+    files (skip when no toolchain)."""
+    from paddle_tpu.data import recordio
+    from paddle_tpu.native import recordio_lib
+
+    if recordio_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.RandomState(6)
+    samples = [(rng.rand(4, 2).astype(np.float32),) for _ in range(9)]
+    # native writer → python reader
+    p1 = os.path.join(tmp_path, "n.recordio")
+    recordio.write_arrays(p1, samples, max_chunk_records=4)
+    orig = recordio._decode_chunk_native
+    recordio._decode_chunk_native = lambda *a, **k: None
+    try:
+        back = list(recordio.read_arrays(p1))
+    finally:
+        recordio._decode_chunk_native = orig
+    assert len(back) == 9
+    np.testing.assert_array_equal(back[5][0], samples[5][0])
+    # python writer → native reader
+    p2 = os.path.join(tmp_path, "p.recordio")
+    orig_e = recordio._encode_chunk_native
+    recordio._encode_chunk_native = lambda *a, **k: None
+    try:
+        recordio.write_arrays(p2, samples, max_chunk_records=4)
+    finally:
+        recordio._encode_chunk_native = orig_e
+    back2 = list(recordio.read_arrays(p2))
+    assert len(back2) == 9
+    np.testing.assert_array_equal(back2[2][0], samples[2][0])
+
+
+def test_native_codec_crc_error(tmp_path):
+    from paddle_tpu.data import recordio
+    from paddle_tpu.native import recordio_lib
+
+    if recordio_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    path = os.path.join(tmp_path, "c.recordio")
+    recordio.write_arrays(path, [(np.arange(6, dtype=np.float32),)])
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(IOError):
+        list(recordio.read_arrays(path))
