@@ -434,6 +434,11 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
     log_sm = logits - lse
     if attrs.get("soft_label", False):
+        if float(attrs.get("label_smooth_eps", 0.0) or 0.0):
+            raise ValueError(
+                "label_smooth_eps only folds into hard-label CE; with "
+                "soft_label=True smooth the label distribution yourself "
+                "(layers.label_smooth)")
         loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
     else:
         lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 \
@@ -445,6 +450,14 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
             log_sm, safe_lbl[..., None].astype(jnp.int32), axis=-1)
         picked = jnp.where(valid[..., None], picked, 0.0)
         loss = -picked
+        eps = float(attrs.get("label_smooth_eps", 0.0) or 0.0)
+        if eps:
+            # folded label smoothing: with q = (1-eps)·onehot + eps/V,
+            #   CE(q) = (1-eps)·(lse - logit_y) + eps·(lse - mean logits)
+            mean_logits = jnp.mean(logits, axis=-1, keepdims=True)
+            smooth_term = lse - mean_logits
+            smooth_term = jnp.where(valid[..., None], smooth_term, 0.0)
+            loss = (1.0 - eps) * loss + eps * smooth_term
     return {"Loss": [loss], "Softmax": [jnp.exp(log_sm)]}
 
 

@@ -344,3 +344,30 @@ def test_interpolate_align_corners_bilinear():
                 + x[0, 0, y1, x0] * wy * (1-wx)
                 + x[0, 0, y1, x1] * wy * wx)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_label_smooth_ce_matches_composition():
+    """softmax_with_cross_entropy(label_smooth_eps=eps) must equal the
+    one_hot → label_smooth → soft-label CE composition it replaces
+    (models/transformer.py loss path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core.registry import OpContext, get_op_impl
+
+    rng = np.random.RandomState(0)
+    B, V = 6, 37
+    logits = jnp.asarray(rng.randn(B, V).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, V, (B, 1)).astype(np.int64))
+    eps = 0.1
+    impl = get_op_impl("softmax_with_cross_entropy")
+    ctx = OpContext(jax.random.PRNGKey(0))
+    fused = impl(ctx, {"Logits": [logits], "Label": [labels]},
+                 {"label_smooth_eps": eps})["Loss"][0]
+    onehot = jax.nn.one_hot(labels[:, 0], V)
+    smooth = (1 - eps) * onehot + eps / V
+    soft = impl(ctx, {"Logits": [logits], "Label": [smooth]},
+                {"soft_label": True})["Loss"][0]
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(soft),
+                               rtol=1e-5, atol=1e-6)
