@@ -36,6 +36,9 @@ from .inference import (AnalysisConfig, Predictor,  # noqa: F401
                         create_paddle_predictor)
 from .quantize import QuantizeTranspiler  # noqa: F401
 from . import data  # noqa: F401
+from . import contrib  # noqa: F401
+from .async_executor import AsyncExecutor  # noqa: F401
+from .data.data_feed import DataFeedDesc  # noqa: F401
 from . import debugger  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import metrics  # noqa: F401

@@ -1,0 +1,5 @@
+"""High-level contrib APIs (reference: python/paddle/fluid/contrib/)."""
+
+from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401
+                      CheckpointConfig, EndEpochEvent, EndStepEvent,
+                      Inferencer, Trainer)

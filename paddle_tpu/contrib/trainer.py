@@ -1,0 +1,251 @@
+"""High-level Trainer / Inferencer with checkpoint-based recovery.
+
+TPU-native analog of the reference contrib trainer
+(reference: python/paddle/fluid/contrib/trainer.py — Trainer:100 event
+loop over epochs with BeginEpoch/BeginStep/EndStep/EndEpoch events,
+CheckpointConfig:100 epoch/step cadence, _save_checkpoint/
+_load_checkpoint recovery at :580/:1047; Inferencer).
+
+This is also the framework's failure-recovery story (SURVEY.md §5.3):
+synchronous ICI training has no per-worker elasticity, so recovery =
+periodic checkpoints + restart-and-resume.  Trainer checkpoints
+persistables plus its own (epoch, step) cursor at the configured
+cadence, and a restarted Trainer resumes from the newest valid
+checkpoint automatically — the TPU equivalent of the reference's
+trainer-0 persistables + checkpoint_notify flow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import io as fluid_io
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.program import Program, default_main_program, program_guard
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id: int, step_id: int):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id: int, step_id: int, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference contrib/trainer.py CheckpointConfig:100."""
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 max_num_checkpoints: int = 3,
+                 epoch_interval: int = 1, step_interval: int = 10):
+        self.checkpoint_dir = checkpoint_dir or "checkpoints"
+        self.max_num_checkpoints = max(1, int(max_num_checkpoints))
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+
+
+class Trainer:
+    """Event-driven training loop with checkpoint/resume.
+
+        def train_func():
+            loss = build_network()
+            return loss                      # or [loss, metric, ...]
+
+        trainer = Trainer(train_func=train_func,
+                          optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                          checkpoint_config=CheckpointConfig("ckpts"))
+        trainer.train(num_epochs=3, event_handler=handler,
+                      reader=batch_dict_reader, feed_order=[...])
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 place=None, checkpoint_config: Optional[CheckpointConfig]
+                 = None, scope: Optional[Scope] = None):
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = scope or Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.place = place
+        # fresh unique_name counters so generated var names (optimizer
+        # lr/accumulators, tmp params) are deterministic across process
+        # restarts — required for checkpoint resume (fluid's Trainer
+        # builds under unique_name.guard for the same reason)
+        from ..core import unique_name
+
+        with unique_name.guard(), \
+                program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            if isinstance(outs, (list, tuple)):
+                self.train_outputs = list(outs)
+            else:
+                self.train_outputs = [outs]
+            optimizer = optimizer_func()
+            optimizer.minimize(self.train_outputs[0])
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+        # resume point restored from the newest checkpoint: the epoch to
+        # continue in, plus how many of its batches were already consumed
+        self._resume_epoch = 0
+        self._resume_step_in_epoch = 0
+        if self.checkpoint_cfg:
+            self._try_resume()
+
+    # -- checkpointing ---------------------------------------------------
+    def _ckpt_root(self) -> str:
+        return self.checkpoint_cfg.checkpoint_dir
+
+    def _list_checkpoints(self) -> List[int]:
+        root = self._ckpt_root()
+        if not os.path.isdir(root):
+            return []
+        ids = []
+        for d in os.listdir(root):
+            if d.startswith("ckpt_") and os.path.exists(
+                    os.path.join(root, d, "__trainer_state__.json")):
+                try:
+                    ids.append(int(d.split("_")[1]))
+                except ValueError:
+                    continue
+        return sorted(ids)
+
+    def _save_checkpoint(self, serial: int, epoch: int, step: int):
+        root = self._ckpt_root()
+        path = os.path.join(root, f"ckpt_{serial}")
+        os.makedirs(path, exist_ok=True)
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, path,
+                                       main_program=self.train_program)
+        with open(os.path.join(path, "__trainer_state__.json"), "w") as f:
+            json.dump({"epoch": epoch, "step": step, "serial": serial}, f)
+        # rotate (reference keeps max_num_checkpoints, deleting oldest)
+        ids = self._list_checkpoints()
+        while len(ids) > self.checkpoint_cfg.max_num_checkpoints:
+            victim = os.path.join(root, f"ckpt_{ids.pop(0)}")
+            shutil.rmtree(victim, ignore_errors=True)
+
+    def _try_resume(self):
+        ids = self._list_checkpoints()
+        if not ids:
+            return
+        path = os.path.join(self._ckpt_root(), f"ckpt_{ids[-1]}")
+        with scope_guard(self.scope):
+            fluid_io.load_persistables(self.exe, path,
+                                       main_program=self.train_program)
+        with open(os.path.join(path, "__trainer_state__.json")) as f:
+            st = json.load(f)
+        self._resume_epoch = int(st.get("epoch", 0))
+        self._resume_step_in_epoch = int(st.get("step", 0))
+
+    # -- the loop --------------------------------------------------------
+    def train(self, num_epochs: int, event_handler: Optional[Callable]
+              = None, reader: Optional[Callable] = None,
+              feed_order: Optional[Sequence[str]] = None):
+        """reader: callable -> iterable of feed dicts (or tuples aligned
+        with feed_order)."""
+        handler = event_handler or (lambda e: None)
+        serial = ((self._list_checkpoints() or [-1])[-1] + 1
+                  if self.checkpoint_cfg else 0)
+        fetch = [o.name for o in self.train_outputs]
+        skip = self._resume_step_in_epoch  # mid-epoch fast-forward
+        for epoch in range(self._resume_epoch, num_epochs):
+            handler(BeginEpochEvent(epoch))
+            step = 0
+            done = 0
+            for batch in (reader() if reader else iter(())):
+                # resume semantics: a mid-epoch checkpoint records how
+                # many batches of its epoch were consumed; with a
+                # deterministic reader, skipping them continues exactly
+                # where the dead process stopped (already-trained
+                # batches are not replayed onto updated params)
+                if skip > 0:
+                    skip -= 1
+                    step += 1
+                    continue
+                if not isinstance(batch, dict):
+                    if feed_order is None:
+                        raise ValueError(
+                            "tuple batches need feed_order")
+                    batch = dict(zip(feed_order, batch))
+                begin = BeginStepEvent(epoch, step)
+                handler(begin)
+                with scope_guard(self.scope):
+                    metrics = self.exe.run(
+                        self.train_program, feed=batch,
+                        fetch_list=fetch if begin.fetch_metrics else [])
+                handler(EndStepEvent(epoch, step, metrics))
+                step += 1
+                done += 1
+                if (self.checkpoint_cfg and
+                        done % self.checkpoint_cfg.step_interval == 0):
+                    self._save_checkpoint(serial, epoch, step)
+                    serial += 1
+            if (self.checkpoint_cfg and
+                    (epoch + 1) % self.checkpoint_cfg.epoch_interval == 0):
+                self._save_checkpoint(serial, epoch + 1, 0)
+                serial += 1
+            handler(EndEpochEvent(epoch))
+
+    def save_params(self, dirname: str):
+        with scope_guard(self.scope):
+            fluid_io.save_params(self.exe, dirname,
+                                 main_program=self.train_program)
+
+    def save_inference_model(self, dirname: str,
+                             feeded_var_names: Sequence[str],
+                             target_vars: Sequence):
+        with scope_guard(self.scope):
+            fluid_io.save_inference_model(
+                dirname, feeded_var_names, list(target_vars), self.exe,
+                main_program=self.train_program)
+
+    def stop(self):
+        self.exe.close()
+
+
+class Inferencer:
+    """reference contrib/trainer.py Inferencer: load params produced by a
+    Trainer and run a forward network."""
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 shared_scope: Optional[Scope] = None):
+        self.scope = shared_scope or Scope()
+        self.program = Program()
+        startup = Program()
+        from ..core import unique_name
+
+        with unique_name.guard(), program_guard(self.program, startup):
+            outs = infer_func()
+            self.outputs = (list(outs) if isinstance(outs, (list, tuple))
+                            else [outs])
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            fluid_io.load_params(self.exe, param_path,
+                                 main_program=self.program)
+
+    def infer(self, inputs: Dict[str, np.ndarray]):
+        with scope_guard(self.scope):
+            return self.exe.run(self.program, feed=inputs,
+                                fetch_list=[o.name for o in self.outputs])

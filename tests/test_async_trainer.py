@@ -1,0 +1,288 @@
+"""AsyncExecutor (multi-thread file-shard training) + contrib
+Trainer/Inferencer (checkpoint recovery) tests.
+
+reference patterns: python/paddle/fluid/tests/demo/async_executor.py,
+contrib trainer usage in tests/book."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import CheckpointConfig, Inferencer, Trainer
+from paddle_tpu.data.data_feed import DataFeedDesc, MultiSlotDataFeed
+
+
+# ---------------------------------------------------------------------------
+# DataFeed
+# ---------------------------------------------------------------------------
+
+def _write_multislot(path, rng, n_lines, vocab=50):
+    """slots: sparse ids (var len <=5), dense 3-float, label."""
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            k = rng.randint(1, 6)
+            ids = rng.randint(0, vocab, k)
+            dense = rng.rand(3)
+            label = rng.randint(0, 2)
+            parts = ([str(k)] + [str(i) for i in ids]
+                     + ["3"] + [f"{v:.4f}" for v in dense]
+                     + ["1", str(label)])
+            f.write(" ".join(parts) + "\n")
+
+
+def _desc(batch_size):
+    return DataFeedDesc.from_slots([
+        {"name": "ids", "type": "uint64", "dense": False, "max_len": 5},
+        {"name": "dense", "type": "float", "dense": True, "dim": 3},
+        {"name": "label", "type": "uint64", "dense": True, "dim": 1},
+    ], batch_size=batch_size)
+
+
+def test_multislot_datafeed_parses(tmp_path):
+    rng = np.random.RandomState(0)
+    p = os.path.join(tmp_path, "part-0")
+    _write_multislot(p, rng, 10)
+    feed = MultiSlotDataFeed(_desc(4))
+    batches = list(feed.batches([p]))
+    assert len(batches) == 2  # 10 lines, bs 4, trailing 2 dropped
+    b = batches[0]
+    assert b["ids"].shape == (4, 5)
+    assert b["ids.seq_len"].shape == (4,)
+    assert b["dense"].shape == (4, 3)
+    assert b["label"].shape == (4, 1)
+    assert (b["ids.seq_len"] >= 1).all()
+
+
+def test_async_executor_trains_over_shards(tmp_path):
+    rng = np.random.RandomState(1)
+    files = []
+    for i in range(4):
+        p = os.path.join(tmp_path, f"part-{i}")
+        _write_multislot(p, rng, 24)
+        files.append(p)
+
+    B = 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[B, 5], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        dense = layers.data("dense", shape=[B, 3],
+                            append_batch_size=False)
+        label = layers.data("label", shape=[B, 1], dtype="int64",
+                            append_batch_size=False)
+        emb = layers.embedding(ids, size=[50, 8], is_sparse=True)
+        pooled = layers.sequence_pool(emb, "sum")
+        feat = layers.concat([pooled, dense], axis=1)
+        pred = layers.fc(feat, size=2)
+        loss = layers.reduce_mean(layers.softmax_with_cross_entropy(
+            pred, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+    aexe = fluid.AsyncExecutor()
+    stats = aexe.run(main, _desc(B), files, thread_num=2,
+                     fetch=[loss], scope=scope)
+    assert np.isfinite(stats[loss.name])
+    # 4 files × 24 lines / bs 8 = 12 batches; run again, loss lower
+    stats2 = aexe.run(main, _desc(B), files, thread_num=2,
+                      fetch=[loss], scope=scope)
+    assert stats2[loss.name] < stats[loss.name]
+
+
+def test_async_executor_validates(tmp_path):
+    main = fluid.Program()
+    aexe = fluid.AsyncExecutor()
+    with pytest.raises(ValueError):
+        aexe.run(main, _desc(4), [], thread_num=2, fetch=[])
+    with pytest.raises(ValueError):
+        aexe.run(main, _desc(4), ["x"], thread_num=0, fetch=[])
+
+
+def test_async_executor_surfaces_shard_errors(tmp_path):
+    rng = np.random.RandomState(5)
+    good = os.path.join(tmp_path, "part-0")
+    _write_multislot(good, rng, 16)
+    missing = os.path.join(tmp_path, "does-not-exist")
+    B = 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        dense = layers.data("dense", shape=[B, 3],
+                            append_batch_size=False)
+        loss = layers.reduce_mean(dense)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    desc = DataFeedDesc.from_slots(
+        [{"name": "ids", "dense": False, "max_len": 5, "used": False},
+         {"name": "dense", "type": "float", "dense": True, "dim": 3},
+         {"name": "label", "dense": True, "dim": 1, "used": False}],
+        batch_size=B)
+    aexe = fluid.AsyncExecutor()
+    with pytest.raises(RuntimeError, match="shard reader failed"):
+        aexe.run(main, desc, [good, missing], thread_num=2,
+                 fetch=[loss], scope=scope)
+
+
+def test_multislot_uint64_hash_ids(tmp_path):
+    p = os.path.join(tmp_path, "part-u")
+    with open(p, "w") as f:
+        f.write("2 9223372036854775808 3 1 0.5 1 1\n")
+    desc = DataFeedDesc.from_slots(
+        [{"name": "ids", "dense": False, "max_len": 4},
+         {"name": "d", "type": "float", "dense": True, "dim": 1},
+         {"name": "label", "dense": True, "dim": 1}], batch_size=1)
+    (b,) = list(MultiSlotDataFeed(desc).batches([p]))
+    # 2**63 reinterpreted into int64 (bit pattern preserved)
+    assert b["ids"][0, 0] == np.uint64(2 ** 63).astype(np.int64)
+    assert b["ids"][0, 1] == 3
+
+
+def test_multislot_sparse_requires_max_len(tmp_path):
+    p = os.path.join(tmp_path, "part-m")
+    with open(p, "w") as f:
+        f.write("1 7 1 1\n")
+    desc = DataFeedDesc.from_slots(
+        [{"name": "ids", "dense": False},
+         {"name": "label", "dense": True, "dim": 1}], batch_size=1)
+    with pytest.raises(ValueError, match="max_len"):
+        list(MultiSlotDataFeed(desc).batches([p]))
+
+
+# ---------------------------------------------------------------------------
+# Trainer / Inferencer
+# ---------------------------------------------------------------------------
+
+def _make_reader(w, steps=8, B=4):
+    def reader():
+        rng = np.random.RandomState(3)
+        for _ in range(steps):
+            x = rng.rand(B, 4).astype(np.float32)
+            yield {"x": x, "y": x @ w}
+    return reader
+
+
+def _train_func(B=4):
+    x = layers.data("x", shape=[B, 4], append_batch_size=False)
+    y = layers.data("y", shape=[B, 1], append_batch_size=False)
+    pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"),
+                     bias_attr=False)
+    return layers.reduce_mean(layers.square_error_cost(pred, y))
+
+
+def test_trainer_without_checkpoint_config():
+    w = np.random.RandomState(9).rand(4, 1).astype(np.float32)
+    losses = []
+    trainer = Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.2))
+    trainer.train(
+        num_epochs=1,
+        event_handler=lambda e: losses.append(e.metrics[0])
+        if type(e).__name__ == "EndStepEvent" else None,
+        reader=_make_reader(w))
+    assert len(losses) == 8
+    assert float(losses[-1].reshape(-1)[0]) < float(
+        losses[0].reshape(-1)[0])
+
+
+def test_trainer_mid_epoch_resume_skips_consumed_batches(tmp_path):
+    """A mid-epoch checkpoint resumes at the next batch of its epoch
+    rather than replaying the epoch from batch 0."""
+    w = np.random.RandomState(10).rand(4, 1).astype(np.float32)
+    ckpt = os.path.join(tmp_path, "ck")
+    # 8 steps/epoch, checkpoint every 3 steps: newest mid-epoch ckpt is
+    # at step 6 of epoch 0 after we stop the first trainer "mid-crash"
+    t1 = Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.2),
+        checkpoint_config=CheckpointConfig(ckpt, max_num_checkpoints=1,
+                                           step_interval=3,
+                                           epoch_interval=10**9))
+    seen = []
+
+    class Stop(Exception):
+        pass
+
+    def crash_handler(e):
+        if type(e).__name__ == "EndStepEvent":
+            seen.append(e.step)
+            if e.epoch == 0 and e.step == 6:
+                raise Stop
+
+    with pytest.raises(Stop):
+        t1.train(num_epochs=1, event_handler=crash_handler,
+                 reader=_make_reader(w))
+
+    t2 = Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.2),
+        checkpoint_config=CheckpointConfig(ckpt, max_num_checkpoints=1,
+                                           step_interval=3,
+                                           epoch_interval=10**9))
+    assert t2._resume_epoch == 0
+    assert t2._resume_step_in_epoch == 6
+    resumed_steps = []
+    t2.train(num_epochs=1,
+             event_handler=lambda e: resumed_steps.append(e.step)
+             if type(e).__name__ == "EndStepEvent" else None,
+             reader=_make_reader(w))
+    # only batches 6 and 7 of the epoch run after resume
+    assert resumed_steps == [6, 7]
+
+
+def test_trainer_events_checkpoint_resume(tmp_path):
+    w = np.random.RandomState(2).rand(4, 1).astype(np.float32)
+    ckpt = os.path.join(tmp_path, "ckpts")
+    events = []
+
+    trainer = Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.2),
+        checkpoint_config=CheckpointConfig(ckpt, max_num_checkpoints=2,
+                                           step_interval=4))
+    trainer.train(num_epochs=2,
+                  event_handler=lambda e: events.append(type(e).__name__),
+                  reader=_make_reader(w))
+    assert events.count("BeginEpochEvent") == 2
+    assert events.count("EndStepEvent") == 16
+    # checkpoints rotated to the cap
+    names = [d for d in os.listdir(ckpt) if d.startswith("ckpt_")]
+    assert 1 <= len(names) <= 2
+    trained_w = np.asarray(trainer.scope.find_var("tw")).copy()
+
+    # a fresh Trainer resumes from the newest checkpoint: same params,
+    # and the finished epochs are not re-run
+    steps_after_resume = []
+    trainer2 = Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.2),
+        checkpoint_config=CheckpointConfig(ckpt, max_num_checkpoints=2,
+                                           step_interval=4))
+    resumed_w = np.asarray(trainer2.scope.find_var("tw"))
+    np.testing.assert_allclose(resumed_w, trained_w)
+    trainer2.train(num_epochs=2,
+                   event_handler=lambda e: steps_after_resume.append(e),
+                   reader=_make_reader(w))
+    assert trainer2._resume_epoch == 2
+    assert len([e for e in steps_after_resume
+                if type(e).__name__ == "EndStepEvent"]) == 0
+
+    # params export + Inferencer round-trip
+    params_dir = os.path.join(tmp_path, "params")
+    trainer.save_params(params_dir)
+
+    def infer_func():
+        x = layers.data("x", shape=[4, 4], append_batch_size=False)
+        return layers.fc(x, size=1,
+                         param_attr=fluid.ParamAttr(name="tw"),
+                         bias_attr=False)
+
+    inferencer = Inferencer(infer_func, params_dir)
+    xv = np.random.RandomState(4).rand(4, 4).astype(np.float32)
+    (pv,) = inferencer.infer({"x": xv})
+    np.testing.assert_allclose(pv, xv @ trained_w, rtol=1e-5)
