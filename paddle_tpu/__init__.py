@@ -40,6 +40,7 @@ from . import contrib  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401
 from .data.data_feed import DataFeedDesc  # noqa: F401
 from . import debugger  # noqa: F401
+from . import imperative  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401

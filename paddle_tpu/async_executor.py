@@ -68,6 +68,16 @@ class AsyncExecutor:
 
         merged: "queue_mod.Queue" = queue_mod.Queue(maxsize=4 * len(shards))
         _STOP = object()
+        abort = threading.Event()
+
+        def _put(item) -> bool:
+            while not abort.is_set():
+                try:
+                    merged.put(item, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
 
         def worker(paths):
             # shard failures surface on the consumer (reference: the
@@ -75,10 +85,11 @@ class AsyncExecutor:
             # never silently truncate the dataset
             try:
                 for batch in feed_parser.batches(paths):
-                    merged.put(batch)
-                merged.put(_STOP)
+                    if not _put(batch):
+                        return
+                _put(_STOP)
             except BaseException as e:
-                merged.put(_ReaderError(e))
+                _put(_ReaderError(e))
 
         threads = [threading.Thread(target=worker, args=(s,), daemon=True)
                    for s in shards]
@@ -102,20 +113,32 @@ class AsyncExecutor:
         totals = {n: 0.0 for n in fetch_names}
         steps = 0
         target_scope = scope or global_scope()
-        with scope_guard(target_scope):
-            for feed in feeder:
-                vals = self._exe.run(program, feed=feed,
-                                     fetch_list=list(fetch_names))
-                steps += 1
-                for n, v in zip(fetch_names, vals):
-                    totals[n] += float(np.asarray(v).reshape(-1)[0])
-                if debug and steps % report_every == 0:
-                    stats = ", ".join(
-                        f"{n}={totals[n] / steps:.6f}"
-                        for n in fetch_names)
-                    print(f"[async_executor] step {steps}: {stats}")
-        for t in threads:
-            t.join(timeout=5)
+        try:
+            with scope_guard(target_scope):
+                for feed in feeder:
+                    vals = self._exe.run(program, feed=feed,
+                                         fetch_list=list(fetch_names))
+                    steps += 1
+                    for n, v in zip(fetch_names, vals):
+                        totals[n] += float(np.asarray(v).reshape(-1)[0])
+                    if debug and steps % report_every == 0:
+                        stats = ", ".join(
+                            f"{n}={totals[n] / steps:.6f}"
+                            for n in fetch_names)
+                        print(f"[async_executor] step {steps}: {stats}")
+        finally:
+            # on any consumer-side exit, unblock and reap the parser
+            # threads (they would otherwise park forever on the bounded
+            # queue, leaking threads + file handles per retry)
+            abort.set()
+            try:
+                while True:
+                    merged.get_nowait()
+            except queue_mod.Empty:
+                pass
+            feeder.reset()
+            for t in threads:
+                t.join(timeout=5)
         if steps == 0:
             raise RuntimeError(
                 "no batches produced — check filelist contents and the "

@@ -201,6 +201,12 @@ class Trainer:
                         done % self.checkpoint_cfg.step_interval == 0):
                     self._save_checkpoint(serial, epoch, step)
                     serial += 1
+            if skip > 0:
+                raise RuntimeError(
+                    f"resume cursor expected at least {skip} more batches "
+                    f"in epoch {epoch} than the reader produced — the "
+                    f"dataset/reader changed since the checkpoint")
+            skip = 0  # fast-forward applies to the resume epoch only
             if (self.checkpoint_cfg and
                     (epoch + 1) % self.checkpoint_cfg.epoch_interval == 0):
                 self._save_checkpoint(serial, epoch + 1, 0)

@@ -77,6 +77,10 @@ class MultiSlotDataFeed:
             n = int(toks[pos])
             pos += 1
             vals = toks[pos:pos + n]
+            if len(vals) != n:
+                raise ValueError(
+                    f"corrupt MultiSlot line: slot {slot['name']!r} "
+                    f"declares {n} values, found {len(vals)}")
             pos += n
             if slot.get("used", True) is False:
                 continue

@@ -1,0 +1,270 @@
+"""Imperative (eager / proto-dygraph) mode.
+
+TPU-native analog of the reference's imperative embryo
+(reference: paddle/fluid/imperative/ — VarBase with var+grad slots
+(layer.h:83), OpBase, Tracer::Trace recording ops and building grad ops
+on the fly (tracer.h:51,57), autograd RunBackward (layer.h:103);
+python/paddle/fluid/imperative/layers.py PyLayer).
+
+Mapping: jax is already eager — each traced op executes immediately on
+device.  The reference Tracer's grad-op construction becomes a tape of
+(op impl, input VarBases, attrs) entries; `VarBase.backward()` walks the
+tape in reverse applying per-op `jax.vjp`, accumulating cotangents into
+`VarBase.grad` — autodiff without grad-op makers, matching how the
+static-graph side replaces append_backward with jax AD.
+
+    with imperative.guard():
+        x = imperative.to_variable(np_x)
+        fc = imperative.FC(64, act="relu")
+        y = fc(x)
+        loss = imperative.trace_op("reduce_mean", {"X": [y]})
+        loss.backward()
+        g = fc.w.grad
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.registry import OpContext, get_op_impl
+
+
+class VarBase:
+    """Eager variable: value + grad slot (reference imperative/layer.h:83).
+    """
+
+    def __init__(self, value, stop_gradient: bool = False,
+                 name: Optional[str] = None):
+        import jax.numpy as jnp
+
+        self.value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        # autograd bookkeeping (set by the tracer for op outputs)
+        self._producer: Optional["_TapeEntry"] = None
+        self._out_index: int = 0
+
+    # -- tensor-ish surface ---------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def __repr__(self):
+        return (f"VarBase(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient})")
+
+    # -- autograd -------------------------------------------------------
+    def backward(self):
+        """Reverse the tape from this scalar-ish output
+        (reference layer.h:103 RunBackward)."""
+        tracer = _active_tracer()
+        if tracer is None:
+            raise RuntimeError("backward() outside imperative.guard()")
+        tracer.run_backward(self)
+
+
+class _TapeEntry:
+    __slots__ = ("op_type", "ins", "attrs", "in_vars", "out_vars", "fn")
+
+    def __init__(self, op_type, ins, attrs, in_vars, out_vars, fn):
+        self.op_type = op_type
+        self.ins = ins
+        self.attrs = attrs
+        self.in_vars = in_vars    # [VarBase] (differentiable positions)
+        self.out_vars = out_vars  # [VarBase]
+        self.fn = fn              # arrays-in → arrays-out pure function
+
+
+class Tracer:
+    """Eager op recorder (reference imperative/tracer.h:51 Tracer::Trace:
+    execute the op now, remember how to differentiate it)."""
+
+    def __init__(self):
+        self.tape: List[_TapeEntry] = []
+        self._op_counter = 0
+
+    # -- forward --------------------------------------------------------
+    def trace_op(self, op_type: str, ins: Dict[str, Sequence[VarBase]],
+                 attrs: Optional[Dict[str, Any]] = None,
+                 out_slots: Optional[Sequence[str]] = None) -> Any:
+        import jax
+
+        impl = get_op_impl(op_type)
+        attrs = dict(attrs or {})
+        self._op_counter += 1
+        ctx = OpContext(jax.random.PRNGKey(self._op_counter),
+                        op_index=self._op_counter)
+
+        # differentiable leaves: VarBases without stop_gradient
+        diff_vars: List[VarBase] = []
+        slots = {k: list(v) for k, v in ins.items()}
+        positions = []  # (slot, idx) aligned with diff_vars
+        for slot, vs in slots.items():
+            for i, v in enumerate(vs):
+                if isinstance(v, VarBase) and not v.stop_gradient:
+                    positions.append((slot, i))
+                    diff_vars.append(v)
+
+        def fn(diff_arrays):
+            call_ins = {
+                slot: [v.value if isinstance(v, VarBase) else v
+                       for v in vs]
+                for slot, vs in slots.items()
+            }
+            for (slot, i), a in zip(positions, diff_arrays):
+                call_ins[slot][i] = a
+            outs = impl(ctx, call_ins, attrs)
+            keys = out_slots or sorted(outs)
+            return tuple(o for k in keys for o in outs[k])
+
+        out_arrays = fn(tuple(v.value for v in diff_vars))
+        out_vars = []
+        entry = _TapeEntry(op_type, slots, attrs, diff_vars, out_vars, fn)
+        for i, a in enumerate(out_arrays):
+            ov = VarBase(a)
+            ov._producer = entry
+            ov._out_index = i
+            out_vars.append(ov)
+        if diff_vars:
+            self.tape.append(entry)
+        if len(out_vars) == 1:
+            return out_vars[0]
+        return out_vars
+
+    # -- backward -------------------------------------------------------
+    def run_backward(self, root: VarBase):
+        import jax
+        import jax.numpy as jnp
+
+        cot: Dict[int, Any] = {id(root): jnp.ones_like(root.value)}
+        # the tape is already in execution order; reverse it
+        for entry in reversed(self.tape):
+            out_cots = [cot.get(id(ov)) for ov in entry.out_vars]
+            if all(c is None for c in out_cots):
+                continue
+            out_cots = tuple(
+                c if c is not None else jnp.zeros_like(ov.value)
+                for c, ov in zip(out_cots, entry.out_vars))
+            primals = tuple(v.value for v in entry.in_vars)
+            _out, vjp_fn = jax.vjp(entry.fn, primals)
+            (in_cots,) = vjp_fn(out_cots)
+            for v, g in zip(entry.in_vars, in_cots):
+                if id(v) in cot:
+                    cot[id(v)] = cot[id(v)] + g
+                else:
+                    cot[id(v)] = g
+                # leaves (params / user vars) accumulate into .grad
+                if v._producer is None:
+                    v.grad = (g if v.grad is None else v.grad + g)
+        # non-leaf grads are discarded like the reference (only VarBases
+        # the user holds references to matter)
+
+    def reset(self):
+        self.tape = []
+
+
+_tracer_stack: List[Tracer] = []
+
+
+def _active_tracer() -> Optional[Tracer]:
+    return _tracer_stack[-1] if _tracer_stack else None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enable eager mode (reference python dygraph guard)."""
+    t = Tracer()
+    _tracer_stack.append(t)
+    try:
+        yield t
+    finally:
+        _tracer_stack.pop()
+
+
+def to_variable(value, stop_gradient: bool = False) -> VarBase:
+    return VarBase(value, stop_gradient=stop_gradient)
+
+
+def trace_op(op_type: str, ins, attrs=None, out_slots=None):
+    tracer = _active_tracer()
+    if tracer is None:
+        raise RuntimeError("trace_op outside imperative.guard()")
+    return tracer.trace_op(op_type, ins, attrs, out_slots)
+
+
+class Layer:
+    """Eager layer base (reference imperative/layers.py PyLayer / Layer):
+    hold parameters, define forward()."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or self.__class__.__name__
+        self._params: Dict[str, VarBase] = {}
+        self._sublayers: Dict[str, "Layer"] = {}
+
+    def __setattr__(self, key, value):
+        if isinstance(value, Layer):
+            self.__dict__.setdefault("_sublayers", {})[key] = value
+        super().__setattr__(key, value)
+
+    def create_parameter(self, name: str, shape, dtype="float32",
+                         initializer=None) -> VarBase:
+        if initializer is None:
+            rng = np.random.RandomState(abs(hash(name)) % (2 ** 31))
+            fan_in = int(np.prod(shape[:-1])) or 1
+            value = (rng.randn(*shape) / np.sqrt(fan_in)).astype(dtype)
+        else:
+            value = np.asarray(initializer, dtype=dtype)
+        p = VarBase(value, name=f"{self._name}.{name}")
+        self._params[name] = p
+        return p
+
+    def parameters(self) -> List[VarBase]:
+        out = list(self._params.values())
+        for sub in self._sublayers.values():
+            out.extend(sub.parameters())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+
+class FC(Layer):
+    """Eager fully-connected layer (the reference embryo's test layer)."""
+
+    def __init__(self, input_dim: int, size: int, act: Optional[str] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.w = self.create_parameter("w", [input_dim, size])
+        self.b = self.create_parameter(
+            "b", [size], initializer=np.zeros([size], np.float32))
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        y = trace_op("mul", {"X": [x], "Y": [self.w]},
+                     {"x_num_col_dims": 1, "y_num_col_dims": 1})
+        y = trace_op("elementwise_add", {"X": [y], "Y": [self.b]},
+                     {"axis": 1})
+        if self._act:
+            y = trace_op(self._act, {"X": [y]})
+        return y

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""API-stability gate (reference: tools/diff_api.py): compare the live
+API signatures against the checked-in baseline and fail on drift.
+Refresh the baseline deliberately with:
+    python tools/print_signatures.py > tools/api_signatures.txt
+"""
+
+from __future__ import annotations
+
+import difflib
+import io
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE = os.path.join(HERE, "api_signatures.txt")
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(HERE))
+    from print_signatures import dump
+
+    buf = io.StringIO()
+    dump(buf)
+    current = buf.getvalue().splitlines(keepends=True)
+    if not os.path.exists(BASELINE):
+        print(f"no baseline at {BASELINE}; run print_signatures.py first",
+              file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        baseline = f.readlines()
+    diff = list(difflib.unified_diff(baseline, current,
+                                     fromfile="api_signatures.txt",
+                                     tofile="<current>"))
+    if diff:
+        sys.stderr.writelines(diff)
+        print("\nAPI drift detected — update tools/api_signatures.txt "
+              "if intentional", file=sys.stderr)
+        return 1
+    print("API surface matches baseline "
+          f"({len(baseline)} signatures)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Dump the public API surface as stable one-line signatures.
+
+TPU-native analog of the reference's API-stability gate
+(reference: tools/print_signatures.py + tools/diff_api.py — CI fails
+when the dumped signature list drifts from the checked-in baseline).
+
+Usage:
+    python tools/print_signatures.py > tools/api_signatures.txt  # refresh
+    python tools/diff_api.py                                     # gate
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.io",
+    "paddle_tpu.inference",
+    "paddle_tpu.quantize",
+    "paddle_tpu.metrics",
+    "paddle_tpu.parallel",
+    "paddle_tpu.data.pipeline",
+    "paddle_tpu.data.recordio",
+    "paddle_tpu.data.data_feed",
+    "paddle_tpu.contrib",
+    "paddle_tpu.imperative",
+]
+
+
+def _signature_of(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def dump(out=sys.stdout):
+    import importlib
+
+    lines = []
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            qual = f"{mod_name}.{name}"
+            if inspect.isfunction(obj):
+                # only functions defined under the package (skip
+                # re-exports of stdlib/jax helpers)
+                if not (obj.__module__ or "").startswith("paddle_tpu"):
+                    continue
+                lines.append(f"{qual}{_signature_of(obj)}")
+            elif inspect.isclass(obj):
+                if not (obj.__module__ or "").startswith("paddle_tpu"):
+                    continue
+                lines.append(f"{qual}{_signature_of(obj.__init__)}")
+    for line in sorted(set(lines)):
+        print(line, file=out)
+
+
+if __name__ == "__main__":
+    dump()

@@ -1,0 +1,22 @@
+#!/bin/sh
+# CI entry (reference analog: paddle/scripts/paddle_build.sh).
+# Runs the full gate: native build, test suite on the virtual 8-device
+# CPU mesh, API-stability diff, multichip dryrun compile check.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== native components =="
+sh paddle_tpu/native/build.sh
+sh paddle_tpu/native/build_demo.sh
+
+echo "== tests (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== API stability =="
+python tools/diff_api.py
+
+echo "== multichip dryrun (8 virtual devices) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+echo "CI OK"
