@@ -127,15 +127,21 @@ class AsyncExecutor:
                             for n in fetch_names)
                         print(f"[async_executor] step {steps}: {stats}")
         finally:
-            # on any consumer-side exit, unblock and reap the parser
-            # threads (they would otherwise park forever on the bounded
-            # queue, leaking threads + file handles per retry)
+            # on any consumer-side exit, unblock and reap BOTH sides:
+            # parser threads parked on merged.put (abort flag + drain)
+            # AND the DeviceFeeder producer parked on merged.get (one
+            # _STOP per worker completes reader()'s done-count)
             abort.set()
             try:
                 while True:
                     merged.get_nowait()
             except queue_mod.Empty:
                 pass
+            for _ in threads:
+                try:
+                    merged.put_nowait(_STOP)
+                except queue_mod.Full:
+                    break
             feeder.reset()
             for t in threads:
                 t.join(timeout=5)

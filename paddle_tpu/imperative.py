@@ -223,7 +223,12 @@ class Layer:
     def create_parameter(self, name: str, shape, dtype="float32",
                          initializer=None) -> VarBase:
         if initializer is None:
-            rng = np.random.RandomState(abs(hash(name)) % (2 ** 31))
+            import zlib
+
+            # stable digest, NOT hash(): str hashing is salted per
+            # process and would make default inits non-reproducible
+            seed = zlib.crc32(f"{self._name}.{name}".encode())
+            rng = np.random.RandomState(seed % (2 ** 31))
             fan_in = int(np.prod(shape[:-1])) or 1
             value = (rng.randn(*shape) / np.sqrt(fan_in)).astype(dtype)
         else:

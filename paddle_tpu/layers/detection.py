@@ -200,3 +200,109 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     return nn_layers.elementwise_add(
         nn_layers.scale(loc_loss, scale=loc_loss_weight),
         nn_layers.scale(conf_loss, scale=conf_loss_weight))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """reference layers/detection.py anchor_generator."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": [float(s) for s in anchor_sizes],
+               "aspect_ratios": [float(r) for r in aspect_ratios],
+               "variances": list(variance),
+               "stride": [float(s) for s in stride],
+               "offset": float(offset)})
+    return anchors, var
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    """reference layers/detection.py density_prior_box."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": [int(d) for d in densities],
+               "fixed_sizes": [float(s) for s in fixed_sizes],
+               "fixed_ratios": [float(r) for r in fixed_ratios],
+               "variances": list(variance), "clip": bool(clip),
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": float(offset)})
+    return boxes, var
+
+
+def box_clip(input, im_info=None, im_shape=None, name=None):
+    """reference layers/detection.py box_clip."""
+    helper = LayerHelper("box_clip", name=name)
+    output = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input]}
+    attrs = {}
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    elif im_shape is not None:
+        attrs["im_shape"] = [int(s) for s in im_shape]
+    else:
+        raise ValueError("box_clip needs im_info or im_shape")
+    helper.append_op(type="box_clip", inputs=ins,
+                     outputs={"Output": [output]}, attrs=attrs)
+    return output
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """reference layers/detection.py bipartite_match."""
+    helper = LayerHelper("bipartite_match", name=name)
+    match_indices = helper.create_variable_for_type_inference("int32")
+    match_dist = helper.create_variable_for_type_inference(
+        dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [match_indices],
+                 "ColToRowMatchDist": [match_dist]},
+        attrs={"match_type": match_type,
+               "dist_threshold": float(dist_threshold)})
+    return match_indices, match_dist
+
+
+def target_assign(input, matched_indices, mismatch_value=0, name=None):
+    """reference layers/detection.py target_assign."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value})
+    return out, out_weight
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference layers/detection.py generate_proposals; static-shape
+    contract: (N, post_nms_top_n, 4) zero-padded + valid counts."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    rois_num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoisNum": [rois_num]},
+        attrs={"pre_nms_topN": int(pre_nms_top_n),
+               "post_nms_topN": int(post_nms_top_n),
+               "nms_thresh": float(nms_thresh),
+               "min_size": float(min_size),
+               "eta": float(eta)})
+    return rois, rois_num

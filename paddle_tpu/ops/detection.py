@@ -385,3 +385,269 @@ def yolov3_loss(ctx, ins, attrs):
     per_gt = jnp.where(assigned, coord + cls_loss, 0.0)
     loss = obj_loss + jnp.sum(per_gt, axis=1)
     return out(Loss=loss)
+
+# ---------------------------------------------------------------------------
+# anchor_generator / density_prior_box
+# ---------------------------------------------------------------------------
+
+@register_op("anchor_generator")
+def anchor_generator(ctx, ins, attrs):
+    """Faster-RCNN anchors for one feature map (reference
+    detection/anchor_generator_op.cc): per cell, boxes of every
+    (anchor_size, aspect_ratio) pair in input-image pixels.
+
+    inputs: Input (N, C, H, W); outputs: Anchors (H, W, A, 4) pixel
+    [x1,y1,x2,y2], Variances (H, W, A, 4).
+    """
+    feat = first(ins, "Input")
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    offset = float(attrs.get("offset", 0.5))
+
+    # reference anchor_generator_op.h: per ratio, the base side is
+    # round(sqrt(area/ratio)) and h = round(w * ratio); corners use the
+    # (side - 1)/2 centering convention of the RCNN lineage, so
+    # checkpoint-compatible anchors come out (e.g. size 32 ratio 1 at
+    # stride 16 → [-7.5, -7.5, 23.5, 23.5])
+    ws, hs = [], []
+    for r in ratios:
+        for s in sizes:
+            area = s * s
+            base_w = round((area / r) ** 0.5)
+            base_h = round(base_w * r)
+            ws.append(float(base_w))
+            hs.append(float(base_h))
+    bw = (jnp.asarray(ws) - 1.0) / 2.0
+    bh = (jnp.asarray(hs) - 1.0) / 2.0
+    a = len(ws)
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cxg = jnp.broadcast_to(cx[None, :, None], (h, w, a))
+    cyg = jnp.broadcast_to(cy[:, None, None], (h, w, a))
+    anchors = jnp.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances), (h, w, a, 4))
+    return out(Anchors=anchors.astype(feat.dtype),
+               Variances=var.astype(feat.dtype))
+
+
+@register_op("density_prior_box")
+def density_prior_box(ctx, ins, attrs):
+    """Dense SSD priors (reference detection/density_prior_box_op.cc):
+    for each fixed_size with its density d, a d×d sub-grid of shifted
+    boxes per cell per fixed_ratio."""
+    feat = first(ins, "Input")
+    image = first(ins, "Image")
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    densities = [int(d) for d in attrs["densities"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / w
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / h
+    offset = float(attrs.get("offset", 0.5))
+
+    centers_x, centers_y, ws, hs = [], [], [], []
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw_ = size * ratio ** 0.5
+            bh_ = size / ratio ** 0.5
+            shift_x = step_w / dens
+            shift_y = step_h / dens
+            for dy in range(dens):
+                for dx in range(dens):
+                    centers_x.append((dx + 0.5) * shift_x - step_w / 2)
+                    centers_y.append((dy + 0.5) * shift_y - step_h / 2)
+                    ws.append(bw_ / 2.0)
+                    hs.append(bh_ / 2.0)
+    p = len(ws)
+    dx_off = jnp.asarray(centers_x)
+    dy_off = jnp.asarray(centers_y)
+    bw = jnp.asarray(ws)
+    bh = jnp.asarray(hs)
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    cxg = cx[None, :, None] + dx_off[None, None, :]
+    cyg = cy[:, None, None] + dy_off[None, None, :]
+    cxg = jnp.broadcast_to(cxg, (h, w, p))
+    cyg = jnp.broadcast_to(cyg, (h, w, p))
+    boxes = jnp.stack(
+        [(cxg - bw) / img_w, (cyg - bh) / img_h,
+         (cxg + bw) / img_w, (cyg + bh) / img_h], axis=-1)
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), (h, w, p, 4))
+    return out(Boxes=boxes.astype(feat.dtype),
+               Variances=var.astype(feat.dtype))
+
+
+# ---------------------------------------------------------------------------
+# box_clip / bipartite_match / target_assign
+# ---------------------------------------------------------------------------
+
+@register_op("box_clip")
+def box_clip(ctx, ins, attrs):
+    """Clip boxes to image extents (reference detection/box_clip_op.cc).
+    Input (..., 4); ImInfo (N, 3) [h, w, scale] when batched, else clip
+    to attrs im_shape."""
+    boxes = first(ins, "Input")
+    im_info = opt_in(ins, "ImInfo")
+    if im_info is not None:
+        # im_info rows are [h, w, scale] of the NETWORK input; boxes are
+        # in original-image coordinates, so clip to (h/scale, w/scale)
+        # (reference box_clip_op.h GetImInfo)
+        scale = jnp.maximum(im_info[:, 2], 1e-6)
+        hmax = im_info[:, 0] / scale - 1.0
+        wmax = im_info[:, 1] / scale - 1.0
+        shape = (-1,) + (1,) * (boxes.ndim - 2)
+        x1 = jnp.clip(boxes[..., 0], 0.0, wmax.reshape(shape))
+        y1 = jnp.clip(boxes[..., 1], 0.0, hmax.reshape(shape))
+        x2 = jnp.clip(boxes[..., 2], 0.0, wmax.reshape(shape))
+        y2 = jnp.clip(boxes[..., 3], 0.0, hmax.reshape(shape))
+        return out(Output=jnp.stack([x1, y1, x2, y2], axis=-1))
+    h, w = attrs["im_shape"]
+    lo = jnp.asarray([0.0, 0.0, 0.0, 0.0])
+    hi = jnp.asarray([w - 1.0, h - 1.0, w - 1.0, h - 1.0])
+    return out(Output=jnp.clip(boxes, lo, hi))
+
+
+@register_op("bipartite_match")
+def bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching over a similarity matrix (reference
+    detection/bipartite_match_op.cc BipartiteMatch): repeatedly take the
+    globally-best (row, col) pair, retiring both; then (match_type
+    'per_prediction') also match leftover columns whose best row clears
+    dist_threshold.
+
+    inputs: DistMat (R, C) — rows = gt, cols = priors.
+    outputs: ColToRowMatchIndices (1, C) int32 (-1 unmatched),
+             ColToRowMatchDist (1, C).
+    """
+    dist = first(ins, "DistMat")
+    r, c = dist.shape
+    neg = jnp.asarray(-1e9, dist.dtype)
+
+    def body(carry, _):
+        d, col_idx, col_dist = carry
+        flat = jnp.argmax(d)
+        i, j = flat // c, flat % c
+        best = d[i, j]
+        ok = best > 0
+        col_idx = jnp.where(ok, col_idx.at[j].set(i.astype(jnp.int32)),
+                            col_idx)
+        col_dist = jnp.where(ok, col_dist.at[j].set(best), col_dist)
+        d = jnp.where(ok, d.at[i, :].set(neg).at[:, j].set(neg), d)
+        return (d, col_idx, col_dist), None
+
+    init = (dist, jnp.full((c,), -1, jnp.int32),
+            jnp.zeros((c,), dist.dtype))
+    (d_f, col_idx, col_dist), _ = lax.scan(body, init, None,
+                                           length=min(r, c))
+
+    if attrs.get("match_type", "bipartite") == "per_prediction":
+        thr = float(attrs.get("dist_threshold", 0.5))
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = (col_idx < 0) & (best_val >= thr)
+        col_idx = jnp.where(extra, best_row, col_idx)
+        col_dist = jnp.where(extra, best_val, col_dist)
+    return out(ColToRowMatchIndices=col_idx[None, :],
+               ColToRowMatchDist=col_dist[None, :])
+
+
+@register_op("target_assign")
+def target_assign(ctx, ins, attrs):
+    """Scatter per-gt attributes onto matched priors (reference
+    detection/target_assign_op.cc): Out[j] = X[MatchIndices[j]] where
+    matched, else mismatch_value; OutWeight 1/0.
+
+    inputs: X (R, K) gt attributes, MatchIndices (1, C) or (C,).
+    """
+    x = first(ins, "X")
+    match = first(ins, "MatchIndices").reshape(-1).astype(jnp.int32)
+    mismatch = attrs.get("mismatch_value", 0)
+    matched = match >= 0
+    safe = jnp.clip(match, 0, x.shape[0] - 1)
+    gathered = jnp.take(x, safe, axis=0)
+    fill = jnp.full_like(gathered, mismatch)
+    o = jnp.where(matched[:, None], gathered, fill)
+    wt = matched.astype(jnp.float32)[:, None]
+    return out(Out=o, OutWeight=wt)
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals (RPN)
+# ---------------------------------------------------------------------------
+
+@register_op("generate_proposals")
+def generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference
+    detection/generate_proposals_op.cc): decode anchor deltas, clip to
+    the image, drop tiny boxes (score masked), NMS, keep post_nms_topN —
+    with a static-shape contract: RpnRois is (N, post_nms_topN, 4)
+    zero-padded and RpnRoisNum the valid counts.
+
+    inputs: Scores (N, A, H, W), BboxDeltas (N, 4A, H, W),
+            ImInfo (N, 3), Anchors (H, W, A, 4), Variances (H, W, A, 4).
+    """
+    scores = first(ins, "Scores")
+    deltas = first(ins, "BboxDeltas")
+    im_info = first(ins, "ImInfo")
+    anchors = first(ins, "Anchors").reshape(-1, 4)
+    variances = first(ins, "Variances").reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    eta = float(attrs.get("eta", 1.0))
+
+    n, a, h, w = scores.shape
+    total = a * h * w
+    pre_n = min(pre_n, total)
+    # (N, A, H, W) → (N, H*W*A) aligned with anchors (H, W, A)
+    sc = jnp.transpose(scores, (0, 2, 3, 1)).reshape(n, -1)
+    dl = jnp.transpose(deltas.reshape(n, a, 4, h, w),
+                       (0, 3, 4, 1, 2)).reshape(n, -1, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw / 2.0
+    acy = anchors[:, 1] + ah / 2.0
+
+    def per_image(s, d, info):
+        cx = acx + d[:, 0] * variances[:, 0] * aw
+        cy = acy + d[:, 1] * variances[:, 1] * ah
+        bw = aw * jnp.exp(jnp.clip(d[:, 2] * variances[:, 2], -10, 10))
+        bh = ah * jnp.exp(jnp.clip(d[:, 3] * variances[:, 3], -10, 10))
+        x1 = jnp.clip(cx - bw / 2.0, 0.0, info[1] - 1.0)
+        y1 = jnp.clip(cy - bh / 2.0, 0.0, info[0] - 1.0)
+        x2 = jnp.clip(cx + bw / 2.0, 0.0, info[1] - 1.0)
+        y2 = jnp.clip(cy + bh / 2.0, 0.0, info[0] - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        keep_size = ((x2 - x1 + 1.0 >= min_size * info[2])
+                     & (y2 - y1 + 1.0 >= min_size * info[2]))
+        s_masked = jnp.where(keep_size, s, -1e9)
+        top_s, top_i = lax.top_k(s_masked, pre_n)
+        cand = boxes[top_i]
+        # NMS walks the FULL pre_nms pool (reference NMS loop continues
+        # until post_nms_topN survivors are collected), not just the top
+        # post_n candidates — suppressed slots backfill from the pool
+        kept_s, keep, order = _nms_class(
+            cand, top_s, -1e8, nms_thresh, pre_n, nms_eta=eta)
+        sel = jnp.where(keep, kept_s, -1e30)
+        final_s, pick = lax.top_k(sel, min(post_n, sel.shape[0]))
+        valid = final_s > -1e29
+        rois = cand[order[pick]]
+        rois = jnp.where(valid[:, None], rois, 0.0)
+        if rois.shape[0] < post_n:
+            rois = jnp.pad(rois, ((0, post_n - rois.shape[0]), (0, 0)))
+            valid = jnp.pad(valid, (0, post_n - valid.shape[0]))
+        return rois, jnp.sum(valid).astype(jnp.int32)
+
+    rois, counts = jax.vmap(per_image)(sc, dl, im_info)
+    return out(RpnRois=rois, RpnRoisNum=counts)

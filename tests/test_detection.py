@@ -242,3 +242,120 @@ def test_ssd_head_smoke():
     }
     (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
     assert np.isfinite(lv).all() and lv.reshape(-1)[0] > 0
+
+
+# ---------------------------------------------------------------------------
+# extended detection set
+# ---------------------------------------------------------------------------
+
+def test_anchor_generator_reference_cell():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    anchors = run_op("anchor_generator", {"Input": feat},
+                     attrs={"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                            "stride": [16.0, 16.0]},
+                     out_slot="Anchors")
+    assert anchors.shape == (2, 2, 1, 4)
+    # RCNN-lineage convention: size 32 ratio 1 at stride 16 centers on
+    # (8, 8) with (side-1)/2 half-extents → [-7.5, -7.5, 23.5, 23.5]
+    np.testing.assert_allclose(anchors[0, 0, 0],
+                               [-7.5, -7.5, 23.5, 23.5], atol=1e-5)
+    # aspect ratio 2: base w = round(sqrt(1024/2)) = 23, h = 46
+    a2 = run_op("anchor_generator", {"Input": feat},
+                attrs={"anchor_sizes": [32.0], "aspect_ratios": [2.0],
+                       "stride": [16.0, 16.0]}, out_slot="Anchors")
+    w = a2[0, 0, 0, 2] - a2[0, 0, 0, 0] + 1
+    h = a2[0, 0, 0, 3] - a2[0, 0, 0, 1] + 1
+    assert (w, h) == (23.0, 46.0)
+
+
+def test_density_prior_box_counts():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes = run_op("density_prior_box", {"Input": feat, "Image": img},
+                   attrs={"densities": [2], "fixed_sizes": [8.0],
+                          "fixed_ratios": [1.0]}, out_slot="Boxes")
+    # density 2 → 4 shifted priors per cell per ratio
+    assert boxes.shape == (2, 2, 4, 4)
+    # all boxes are 8x8 in a 32px image → 0.25 normalized
+    sz = boxes[..., 2] - boxes[..., 0]
+    interior = sz[sz > 0.24]
+    np.testing.assert_allclose(interior, 0.25, rtol=1e-5)
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -3.0, 50.0, 20.0]]], np.float32)
+    im_info = np.array([[30.0, 40.0, 1.0]], np.float32)
+    got = run_op("box_clip", {"Input": boxes, "ImInfo": im_info},
+                 out_slot="Output")
+    np.testing.assert_allclose(got[0, 0], [0, 0, 39, 20])
+
+
+def test_bipartite_match_greedy():
+    # classic greedy: global max first, rows/cols retired
+    dist = np.array([[0.6, 0.9, 0.2],
+                     [0.8, 0.7, 0.1]], np.float32)
+    idx = run_op("bipartite_match", {"DistMat": dist},
+                 out_slot="ColToRowMatchIndices")
+    d = run_op("bipartite_match", {"DistMat": dist},
+               out_slot="ColToRowMatchDist")
+    # best 0.9 → (row0, col1); then 0.8 → (row1, col0); col2 unmatched
+    np.testing.assert_array_equal(idx[0], [1, 0, -1])
+    np.testing.assert_allclose(d[0], [0.8, 0.9, 0.0], rtol=1e-6)
+    # per_prediction: col2's best row (row0 @0.2) below threshold stays
+    # unmatched; with threshold 0.1 it matches
+    idx2 = run_op("bipartite_match", {"DistMat": dist},
+                  attrs={"match_type": "per_prediction",
+                         "dist_threshold": 0.15},
+                  out_slot="ColToRowMatchIndices")
+    np.testing.assert_array_equal(idx2[0], [1, 0, 0])
+
+
+def test_target_assign():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    match = np.array([[1, -1, 0]], np.int32)
+    got = run_op("target_assign", {"X": x, "MatchIndices": match},
+                 attrs={"mismatch_value": -7})
+    wt = run_op("target_assign", {"X": x, "MatchIndices": match},
+                attrs={"mismatch_value": -7}, out_slot="OutWeight")
+    np.testing.assert_allclose(got, [[3, 4], [-7, -7], [1, 2]])
+    np.testing.assert_allclose(wt[:, 0], [1, 0, 1])
+
+
+def test_generate_proposals_smoke():
+    rng = np.random.RandomState(6)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    anchors = run_op("anchor_generator",
+                     {"Input": np.zeros((N, 8, H, W), np.float32)},
+                     attrs={"anchor_sizes": [16.0, 32.0],
+                            "aspect_ratios": [1.0, 2.0],
+                            "stride": [16.0, 16.0]}, out_slot="Anchors")
+    variances = np.full(anchors.shape, 1.0, np.float32)
+    post_n = 8
+    rois = run_op("generate_proposals",
+                  {"Scores": scores,
+                   "BboxDeltas": deltas,
+                   "ImInfo": im_info,
+                   "Anchors": anchors[..., :A, :],
+                   "Variances": variances[..., :A, :]},
+                  attrs={"pre_nms_topN": 20, "post_nms_topN": post_n,
+                         "nms_thresh": 0.7, "min_size": 1.0},
+                  out_slot="RpnRois")
+    counts = run_op("generate_proposals",
+                    {"Scores": scores, "BboxDeltas": deltas,
+                     "ImInfo": im_info, "Anchors": anchors[..., :A, :],
+                     "Variances": variances[..., :A, :]},
+                    attrs={"pre_nms_topN": 20, "post_nms_topN": post_n,
+                           "nms_thresh": 0.7, "min_size": 1.0},
+                    out_slot="RpnRoisNum")
+    assert rois.shape == (N, post_n, 4)
+    n_valid = int(counts[0])
+    assert 1 <= n_valid <= post_n
+    v = rois[0, :n_valid]
+    # valid rois are inside the image and non-degenerate
+    assert (v[:, 0] >= 0).all() and (v[:, 2] <= 63).all()
+    assert (v[:, 2] > v[:, 0]).all() and (v[:, 3] > v[:, 1]).all()
+    # padding is zeros
+    np.testing.assert_allclose(rois[0, n_valid:], 0.0)
