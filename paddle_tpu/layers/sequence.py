@@ -38,7 +38,19 @@ def _seq_inputs(x: Variable, slot="X"):
     sl = seq_len_var(x)
     if sl is not None:
         ins["SeqLen"] = [sl]
+    sl2 = seq_len2_var(x)
+    if sl2 is not None:
+        ins["SeqLen2"] = [sl2]
     return ins
+
+
+def seq_len2_var(x: Variable):
+    """The level-2 (nested) length companion, if any (lod_level=2
+    inputs: data padded (B, S1, S2, ...) with seq_len (B,) counting
+    sub-sequences and seq_len2 (B, S1) counting their items)."""
+    block = default_main_program().current_block()
+    name = f"{x.name}.seq_len2"
+    return block.var(name) if block.has_var(name) else None
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +192,10 @@ def sequence_pool(input, pool_type, is_test=False):
                      inputs=_seq_inputs(input),
                      outputs={"Out": [out], "MaxIndex": [max_index]},
                      attrs={"pooltype": pool_type.upper()})
+    if seq_len2_var(input) is not None:
+        # pooling a nested sequence removes the innermost level: the
+        # output is a level-1 sequence carrying the level-1 lengths
+        _propagate_seq_len(input, out)
     return out
 
 

@@ -408,24 +408,25 @@ def anchor_generator(ctx, ins, attrs):
     stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
     offset = float(attrs.get("offset", 0.5))
 
-    # reference anchor_generator_op.h: per ratio, the base side is
-    # round(sqrt(area/ratio)) and h = round(w * ratio); corners use the
-    # (side - 1)/2 centering convention of the RCNN lineage, so
-    # checkpoint-compatible anchors come out (e.g. size 32 ratio 1 at
-    # stride 16 → [-7.5, -7.5, 23.5, 23.5])
+    # reference anchor_generator_op.h:55-84 exactly: the base box comes
+    # from the STRIDE area (base_w = round(sqrt(stride_w*stride_h / ar)),
+    # base_h = round(base_w * ar)) scaled by anchor_size/stride; centers
+    # are i*stride + offset*(stride-1); corners use (side-1)/2 — the
+    # RCNN-lineage convention, checkpoint-compatible (size 32 ratio 1 at
+    # stride 16 → [-8, -8, 23, 23])
     ws, hs = [], []
     for r in ratios:
         for s in sizes:
-            area = s * s
+            area = stride[0] * stride[1]
             base_w = round((area / r) ** 0.5)
             base_h = round(base_w * r)
-            ws.append(float(base_w))
-            hs.append(float(base_h))
+            ws.append(float(base_w) * (s / stride[0]))
+            hs.append(float(base_h) * (s / stride[1]))
     bw = (jnp.asarray(ws) - 1.0) / 2.0
     bh = (jnp.asarray(hs) - 1.0) / 2.0
     a = len(ws)
-    cx = (jnp.arange(w) + offset) * stride[0]
-    cy = (jnp.arange(h) + offset) * stride[1]
+    cx = jnp.arange(w) * stride[0] + offset * (stride[0] - 1)
+    cy = jnp.arange(h) * stride[1] + offset * (stride[1] - 1)
     cxg = jnp.broadcast_to(cx[None, :, None], (h, w, a))
     cyg = jnp.broadcast_to(cy[:, None, None], (h, w, a))
     anchors = jnp.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh], axis=-1)
@@ -452,17 +453,23 @@ def density_prior_box(ctx, ins, attrs):
     step_h = float(attrs.get("step_h", 0.0)) or img_h / h
     offset = float(attrs.get("offset", 0.5))
 
+    # reference density_prior_box_op.h:65-90 exactly: one integer
+    # step_average = int((step_w + step_h)/2) drives BOTH axes' integer
+    # shift = step_average // density, and the sub-grid centers offset by
+    # -step_average/2 + shift/2 + d*shift
+    step_average = int((step_w + step_h) * 0.5)
     centers_x, centers_y, ws, hs = [], [], [], []
     for size, dens in zip(fixed_sizes, densities):
         for ratio in fixed_ratios:
             bw_ = size * ratio ** 0.5
             bh_ = size / ratio ** 0.5
-            shift_x = step_w / dens
-            shift_y = step_h / dens
+            shift = step_average // dens
             for dy in range(dens):
                 for dx in range(dens):
-                    centers_x.append((dx + 0.5) * shift_x - step_w / 2)
-                    centers_y.append((dy + 0.5) * shift_y - step_h / 2)
+                    centers_x.append(
+                        -step_average / 2.0 + shift / 2.0 + dx * shift)
+                    centers_y.append(
+                        -step_average / 2.0 + shift / 2.0 + dy * shift)
                     ws.append(bw_ / 2.0)
                     hs.append(bh_ / 2.0)
     p = len(ws)
@@ -629,16 +636,28 @@ def generate_proposals(ctx, ins, attrs):
         x2 = jnp.clip(cx + bw / 2.0, 0.0, info[1] - 1.0)
         y2 = jnp.clip(cy + bh / 2.0, 0.0, info[0] - 1.0)
         boxes = jnp.stack([x1, y1, x2, y2], axis=1)
-        keep_size = ((x2 - x1 + 1.0 >= min_size * info[2])
-                     & (y2 - y1 + 1.0 >= min_size * info[2]))
+        # reference FilterBoxes (generate_proposals_op.cc:161-176):
+        # min_size floors to 1.0, sizes measured in ORIGINAL image scale
+        # ((x2-x1)/im_scale + 1), centers must lie inside the image
+        msize = max(min_size, 1.0)
+        scale_ = jnp.maximum(info[2], 1e-6)
+        ws_orig = (x2 - x1) / scale_ + 1.0
+        hs_orig = (y2 - y1) / scale_ + 1.0
+        cx_c = x1 + (x2 - x1 + 1.0) / 2.0
+        cy_c = y1 + (y2 - y1 + 1.0) / 2.0
+        keep_size = ((ws_orig >= msize) & (hs_orig >= msize)
+                     & (cx_c <= info[1]) & (cy_c <= info[0]))
         s_masked = jnp.where(keep_size, s, -1e9)
         top_s, top_i = lax.top_k(s_masked, pre_n)
         cand = boxes[top_i]
         # NMS walks the FULL pre_nms pool (reference NMS loop continues
         # until post_nms_topN survivors are collected), not just the top
-        # post_n candidates — suppressed slots backfill from the pool
+        # post_n candidates — suppressed slots backfill from the pool;
+        # pixel-coordinate IoU uses the +1 convention
+        # (JaccardOverlap normalized=false, generate_proposals_op.cc:269)
         kept_s, keep, order = _nms_class(
-            cand, top_s, -1e8, nms_thresh, pre_n, nms_eta=eta)
+            cand, top_s, -1e8, nms_thresh, pre_n, normalized=False,
+            nms_eta=eta)
         sel = jnp.where(keep, kept_s, -1e30)
         final_s, pick = lax.top_k(sel, min(post_n, sel.shape[0]))
         valid = final_s > -1e29

@@ -26,11 +26,34 @@ def _mask(x_len, t, dtype=jnp.float32):
     return (jnp.arange(t)[None, :] < x_len[:, None]).astype(dtype)
 
 
+def _reject_nested(ins, op_name):
+    """Kernels without nested (LoD level-2) support must fail loudly
+    rather than silently applying level-1 semantics to the sub-sequence
+    axis (only sequence_pool removes a nesting level)."""
+    if ins.get("SeqLen2"):
+        raise NotImplementedError(
+            f"{op_name} does not support nested (lod_level=2) inputs; "
+            f"pool the inner level first (sequence_pool)")
+
+
 @register_op("sequence_pool")
 def sequence_pool(ctx, ins, attrs):
     x = first(ins, "X")  # (N, T, D...)
     seq_len = opt_in(ins, "SeqLen")
+    seq_len2 = opt_in(ins, "SeqLen2")
     pool = attrs.get("pooltype", "AVERAGE").upper()
+    if seq_len2 is not None:
+        # nested (LoD level-2) input (B, S1, S2, D...): pooling removes
+        # the INNERMOST level (reference sequence_pooling over the last
+        # LoD level) → (B, S1, D...) with the level-1 lengths surviving
+        # as the output's .seq_len (handled by the layer)
+        b, s1 = x.shape[0], x.shape[1]
+        flat = x.reshape((b * s1,) + x.shape[2:])
+        sub = {"X": [flat], "SeqLen": [seq_len2.reshape(-1)]}
+        inner = sequence_pool(ctx, sub, attrs)
+        return {"Out": [inner["Out"][0].reshape((b, s1) +
+                                                inner["Out"][0].shape[1:])],
+                "MaxIndex": [jnp.zeros((b,), jnp.int32)]}
     n, t = x.shape[0], x.shape[1]
     if seq_len is None:
         seq_len = jnp.full((n,), t, jnp.int32)
@@ -61,6 +84,7 @@ def sequence_pool(ctx, ins, attrs):
 
 @register_op("sequence_softmax")
 def sequence_softmax(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_softmax")
     x = first(ins, "X")  # (N, T) or (N, T, 1)
     seq_len = opt_in(ins, "SeqLen")
     squeeze = x.ndim == 3 and x.shape[-1] == 1
@@ -79,6 +103,7 @@ def sequence_softmax(ctx, ins, attrs):
 
 @register_op("sequence_expand")
 def sequence_expand(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_expand")
     """Expand each row of X to match Y's per-sequence repetition
     (reference sequence_expand_op).  Padded semantics: X (N, D) or
     (N, 1, D) broadcast along Y's time axis."""
@@ -91,11 +116,13 @@ def sequence_expand(ctx, ins, attrs):
 
 @register_op("sequence_expand_as")
 def sequence_expand_as(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_expand_as")
     return sequence_expand(ctx, ins, attrs)
 
 
 @register_op("sequence_mask")
 def sequence_mask(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_mask")
     x = first(ins, "X")  # lengths (N,) or (N,1)
     lens = x.reshape(-1)
     maxlen = attrs.get("maxlen", -1)
@@ -110,6 +137,7 @@ def sequence_mask(ctx, ins, attrs):
 
 @register_op("sequence_reverse")
 def sequence_reverse(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_reverse")
     x = first(ins, "X")  # (N, T, ...)
     seq_len = opt_in(ins, "SeqLen")
     n, t = x.shape[0], x.shape[1]
@@ -126,12 +154,14 @@ def sequence_reverse(ctx, ins, attrs):
 
 @register_op("sequence_concat")
 def sequence_concat(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_concat")
     # padded semantics: concat along time
     return out(Out=jnp.concatenate(ins["X"], axis=1))
 
 
 @register_op("sequence_pad")
 def sequence_pad(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_pad")
     """Already-padded representation: pads/truncates to padded_length."""
     x = first(ins, "X")
     seq_len = opt_in(ins, "SeqLen")
@@ -155,6 +185,7 @@ def sequence_pad(ctx, ins, attrs):
 
 @register_op("sequence_unpad")
 def sequence_unpad(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_unpad")
     """Inverse of sequence_pad.  Padded world: zero the invalid tail and
     pass lengths through (downstream seq ops mask again)."""
     x = first(ins, "X")
@@ -166,6 +197,7 @@ def sequence_unpad(ctx, ins, attrs):
 
 @register_op("sequence_slice")
 def sequence_slice(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_slice")
     x = first(ins, "X")
     offset = first(ins, "Offset").reshape(-1)
     length = first(ins, "Length").reshape(-1)
@@ -180,6 +212,7 @@ def sequence_slice(ctx, ins, attrs):
 
 @register_op("sequence_enumerate")
 def sequence_enumerate(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_enumerate")
     x = first(ins, "X")  # (N, T) int ids
     win = attrs["win_size"]
     pad_value = attrs.get("pad_value", 0)
@@ -194,6 +227,7 @@ def sequence_enumerate(ctx, ins, attrs):
 
 @register_op("sequence_erase")
 def sequence_erase(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_erase")
     """Mark erased tokens with -1 (static shapes forbid true removal; the
     companion mask/SeqLen convention treats negatives as holes)."""
     x = first(ins, "X")
@@ -206,6 +240,7 @@ def sequence_erase(ctx, ins, attrs):
 
 @register_op("sequence_conv")
 def sequence_conv(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_conv")
     """Window convolution over time (reference sequence_conv_op.cc):
     X (N, T, D), Filter (context_length*D, num_filters)."""
     x = first(ins, "X")
@@ -288,6 +323,7 @@ def sequence_scatter(ctx, ins, attrs):
 
 @register_op("sequence_reshape")
 def sequence_reshape(ctx, ins, attrs):
+    _reject_nested(ins, "sequence_reshape")
     """Re-chunk each sequence to a new feature width (reference
     sequence_ops/sequence_reshape_op.cc): X (N, T, D) + SeqLen; attr
     new_dim.  Row n's seq_len*D values re-chunk to rows of new_dim:

@@ -255,17 +255,18 @@ def test_anchor_generator_reference_cell():
                             "stride": [16.0, 16.0]},
                      out_slot="Anchors")
     assert anchors.shape == (2, 2, 1, 4)
-    # RCNN-lineage convention: size 32 ratio 1 at stride 16 centers on
-    # (8, 8) with (side-1)/2 half-extents → [-7.5, -7.5, 23.5, 23.5]
+    # reference anchor_generator_op.h values: stride 16, size 32, ratio
+    # 1 → base_w = round(sqrt(256)) = 16 scaled ×2 = 32, center
+    # 0.5*(16-1) = 7.5 → [-8, -8, 23, 23]
     np.testing.assert_allclose(anchors[0, 0, 0],
-                               [-7.5, -7.5, 23.5, 23.5], atol=1e-5)
-    # aspect ratio 2: base w = round(sqrt(1024/2)) = 23, h = 46
+                               [-8.0, -8.0, 23.0, 23.0], atol=1e-5)
+    # ratio 2: base_w = round(sqrt(256/2)) = 11, base_h = 22, ×2 → 22×44
     a2 = run_op("anchor_generator", {"Input": feat},
                 attrs={"anchor_sizes": [32.0], "aspect_ratios": [2.0],
                        "stride": [16.0, 16.0]}, out_slot="Anchors")
     w = a2[0, 0, 0, 2] - a2[0, 0, 0, 0] + 1
     h = a2[0, 0, 0, 3] - a2[0, 0, 0, 1] + 1
-    assert (w, h) == (23.0, 46.0)
+    assert (w, h) == (22.0, 44.0)
 
 
 def test_density_prior_box_counts():

@@ -153,3 +153,53 @@ def test_train_with_feeder_and_reader_pipeline():
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
         assert np.isfinite(losses).all() if hasattr(np, 'isfinite') else True
         assert losses[-1] < losses[0] * 2
+
+
+def test_lod_level2_feed_and_pool():
+    """Nested sequences (reference LoD level 2, lod_tensor.h:58): feed a
+    batch of paragraphs (lists of sentences of word vectors), pool the
+    innermost level, then the outer level."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    B, S1, S2, D = 2, 4, 8, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, S1, S2, D],
+                        append_batch_size=False, lod_level=2)
+        inner = layers.sequence_pool(x, "sum")       # (B, S1, D), lvl-1
+        outer = layers.sequence_pool(inner, "sum")   # (B, D)
+        feeder = fluid.DataFeeder(feed_list=[x], program=main)
+
+    # sample 0: 2 sentences (3 and 1 words); sample 1: 1 sentence (2)
+    rng = np.random.RandomState(0)
+    s0 = [rng.rand(3, D).astype(np.float32),
+          rng.rand(1, D).astype(np.float32)]
+    s1v = [rng.rand(2, D).astype(np.float32)]
+    feed = feeder.feed([(s0,), (s1v,)])
+    assert feed["x"].shape == (2, S1, S2, D)
+    np.testing.assert_array_equal(feed["x.seq_len"], [2, 1])
+    assert feed["x.seq_len2"].shape == (2, S1)
+    np.testing.assert_array_equal(feed["x.seq_len2"][0, :2], [3, 1])
+
+    exe = fluid.Executor()
+    (o,) = exe.run(main, feed=feed, fetch_list=[outer])
+    want0 = s0[0].sum(axis=0) + s0[1].sum(axis=0)
+    want1 = s1v[0].sum(axis=0)
+    np.testing.assert_allclose(o[0], want0, rtol=1e-5)
+    np.testing.assert_allclose(o[1], want1, rtol=1e-5)
+
+
+def test_lod_level3_rejected():
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with pytest.raises(NotImplementedError):
+            layers.data("deep", shape=[2, 3, 4, 5],
+                        append_batch_size=False, lod_level=3)
