@@ -33,6 +33,17 @@ def _propagate_seq_len(src: Variable, dst: Variable):
                     outputs={"Out": [new]})
 
 
+def _require_level1(x: Variable, api: str):
+    """Layer-level rejection for APIs without nested (lod_level=2)
+    support — fails loudly at graph-build time instead of running
+    level-1 semantics on the sub-sequence axis (only sequence_pool
+    removes a nesting level)."""
+    if seq_len2_var(x) is not None:
+        raise NotImplementedError(
+            f"{api} does not support nested (lod_level=2) inputs; pool "
+            f"the inner level first (sequence_pool)")
+
+
 def _seq_inputs(x: Variable, slot="X"):
     ins = {slot: [x]}
     sl = seq_len_var(x)
@@ -168,6 +179,7 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):
+    _require_level1(input, "row_conv")
     helper = LayerHelper("row_conv", act=act)
     f = helper.create_parameter(
         param_attr, shape=[future_context_size, input.shape[-1]],
@@ -218,6 +230,7 @@ def sequence_softmax(input, use_cudnn=False, name=None):
 
 
 def sequence_expand(x, y, ref_level=-1, name=None):
+    _require_level1(x, "sequence_expand")
     helper = LayerHelper("sequence_expand", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(type="sequence_expand",
@@ -228,6 +241,7 @@ def sequence_expand(x, y, ref_level=-1, name=None):
 
 
 def sequence_expand_as(x, y, name=None):
+    _require_level1(x, "sequence_expand_as")
     helper = LayerHelper("sequence_expand_as", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(type="sequence_expand_as",
@@ -238,6 +252,8 @@ def sequence_expand_as(x, y, name=None):
 
 
 def sequence_concat(input, name=None):
+    for item in (input if isinstance(input, (list, tuple)) else [input]):
+        _require_level1(item, "sequence_concat")
     helper = LayerHelper("sequence_concat", name=name)
     out = helper.create_variable_for_type_inference(input[0].dtype)
     helper.append_op(type="sequence_concat", inputs={"X": input},
@@ -277,6 +293,7 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
 
 
 def sequence_unpad(x, length, name=None):
+    _require_level1(x, "sequence_unpad")
     helper = LayerHelper("sequence_unpad", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(type="sequence_unpad",
@@ -286,6 +303,7 @@ def sequence_unpad(x, length, name=None):
 
 
 def sequence_slice(input, offset, length, name=None):
+    _require_level1(input, "sequence_slice")
     helper = LayerHelper("sequence_slice", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(type="sequence_slice",
@@ -296,6 +314,7 @@ def sequence_slice(input, offset, length, name=None):
 
 
 def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    _require_level1(input, "sequence_enumerate")
     helper = LayerHelper("sequence_enumerate", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(type="sequence_enumerate", inputs={"X": [input]},
@@ -306,6 +325,7 @@ def sequence_enumerate(input, win_size, pad_value=0, name=None):
 
 
 def sequence_erase(input, tokens, name=None):
+    _require_level1(input, "sequence_erase")
     helper = LayerHelper("sequence_erase", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(type="sequence_erase", inputs={"X": [input]},
@@ -353,6 +373,7 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
 
 
 def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    _require_level1(input, "add_position_encoding")
     helper = LayerHelper("add_position_encoding", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(type="add_position_encoding", inputs={"X": [input]},

@@ -37,9 +37,6 @@ def _act(name):
 
 @register_op("dynamic_lstm")
 def dynamic_lstm(ctx, ins, attrs):
-    if ins.get("SeqLen2"):
-        raise NotImplementedError(
-            "dynamic_lstm does not support nested (lod_level=2) inputs")
     """Input (N, T, 4H) — already projected by the preceding fc, matching
     the reference contract (lstm_op.cc expects x @ W_x done outside).
     Weight (H, 4H) recurrent projection; Bias (1, 4H) or (1, 7H) with
@@ -114,11 +111,11 @@ def dynamic_lstm(ctx, ins, attrs):
 
 @register_op("dynamic_gru")
 def dynamic_gru(ctx, ins, attrs):
-    if ins.get("SeqLen2"):
-        raise NotImplementedError(
-            "dynamic_gru does not support nested (lod_level=2) inputs")
     """Input (N, T, 3H) pre-projected; Weight is the recurrent
     (H, 3H) = [update|reset | candidate] split like gru_op.cc."""
+    from .sequence import _reject_nested
+
+    _reject_nested(ins, "dynamic_gru")
     x = first(ins, "Input")
     w = first(ins, "Weight")
     bias = opt_in(ins, "Bias")

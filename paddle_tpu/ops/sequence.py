@@ -103,7 +103,6 @@ def sequence_softmax(ctx, ins, attrs):
 
 @register_op("sequence_expand")
 def sequence_expand(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_expand")
     """Expand each row of X to match Y's per-sequence repetition
     (reference sequence_expand_op).  Padded semantics: X (N, D) or
     (N, 1, D) broadcast along Y's time axis."""
@@ -116,13 +115,11 @@ def sequence_expand(ctx, ins, attrs):
 
 @register_op("sequence_expand_as")
 def sequence_expand_as(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_expand_as")
     return sequence_expand(ctx, ins, attrs)
 
 
 @register_op("sequence_mask")
 def sequence_mask(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_mask")
     x = first(ins, "X")  # lengths (N,) or (N,1)
     lens = x.reshape(-1)
     maxlen = attrs.get("maxlen", -1)
@@ -154,7 +151,6 @@ def sequence_reverse(ctx, ins, attrs):
 
 @register_op("sequence_concat")
 def sequence_concat(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_concat")
     # padded semantics: concat along time
     return out(Out=jnp.concatenate(ins["X"], axis=1))
 
@@ -185,7 +181,6 @@ def sequence_pad(ctx, ins, attrs):
 
 @register_op("sequence_unpad")
 def sequence_unpad(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_unpad")
     """Inverse of sequence_pad.  Padded world: zero the invalid tail and
     pass lengths through (downstream seq ops mask again)."""
     x = first(ins, "X")
@@ -197,7 +192,6 @@ def sequence_unpad(ctx, ins, attrs):
 
 @register_op("sequence_slice")
 def sequence_slice(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_slice")
     x = first(ins, "X")
     offset = first(ins, "Offset").reshape(-1)
     length = first(ins, "Length").reshape(-1)
@@ -212,7 +206,6 @@ def sequence_slice(ctx, ins, attrs):
 
 @register_op("sequence_enumerate")
 def sequence_enumerate(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_enumerate")
     x = first(ins, "X")  # (N, T) int ids
     win = attrs["win_size"]
     pad_value = attrs.get("pad_value", 0)
@@ -227,7 +220,6 @@ def sequence_enumerate(ctx, ins, attrs):
 
 @register_op("sequence_erase")
 def sequence_erase(ctx, ins, attrs):
-    _reject_nested(ins, "sequence_erase")
     """Mark erased tokens with -1 (static shapes forbid true removal; the
     companion mask/SeqLen convention treats negatives as holes)."""
     x = first(ins, "X")

@@ -112,3 +112,51 @@ def test_bert_model_tiny():
                                  n_head=2, d_model=32, d_inner=64,
                                  max_predictions=4, warmup_steps=10),
         batch, steps=2)
+
+
+def test_word2vec_nce_trains():
+    """Book model: N-gram LM with NCE (reference book/test_word2vec.py)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import word2vec
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = word2vec.build_model(dict_size=200, batch_size=32,
+                                     learning_rate=0.05)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = word2vec.make_fake_batch(32, dict_size=200)
+        losses = [
+            float(exe.run(main, feed=feed,
+                          fetch_list=[model["loss"]])[0].reshape(()))
+            for _ in range(40)
+        ]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+def test_recommender_system_trains():
+    """Book model: two-tower recommender (reference
+    book/test_recommender_system.py)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import recommender
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = recommender.build_model(batch_size=32)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = recommender.make_fake_batch(32)
+        losses = [
+            float(exe.run(main, feed=feed,
+                          fetch_list=[model["loss"]])[0].reshape(()))
+            for _ in range(25)
+        ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
