@@ -39,15 +39,20 @@ class AsyncExecutor:
         self._exe = Executor(place)
 
     def run(self, program: Program, data_feed: DataFeedDesc,
-            filelist: Sequence[str], thread_num: int,
-            fetch: Sequence, mode: str = "", debug: bool = False,
+            filelist: Sequence[str], thread_num: Optional[int] = None,
+            fetch: Sequence = (), mode: str = "", debug: bool = False,
             scope: Optional[Scope] = None,
             report_every: int = 100) -> Dict[str, float]:
         """Train over `filelist` once.  thread_num parser threads split
         the shards (reference async_executor.cc: files round-robin over
-        threads); fetch vars are averaged and (debug=True) printed every
-        `report_every` steps.  Returns {fetch_name: mean_over_run}.
+        threads; default FLAGS.paddle_num_threads); fetch vars are
+        averaged and (debug=True) printed every `report_every` steps.
+        Returns {fetch_name: mean_over_run}.
         """
+        if thread_num is None:
+            from .flags import FLAGS
+
+            thread_num = int(FLAGS.paddle_num_threads)
         if thread_num < 1:
             raise ValueError("thread_num must be >= 1")
         if not filelist:

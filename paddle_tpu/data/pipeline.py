@@ -52,6 +52,10 @@ class DeviceFeeder:
     def start(self):
         """Begin prefetching a fresh pass over the reader."""
         self.reset()
+        # a fresh pass must not serve the previous pass's cached
+        # speed-test batch
+        if hasattr(self, "_speed_test_batch"):
+            del self._speed_test_batch
         self._queue = queue.Queue(maxsize=self._capacity)
         self._stop.clear()
         self._thread = threading.Thread(
@@ -74,6 +78,8 @@ class DeviceFeeder:
             self._thread.join(timeout=5)
         self._thread = None
         self._queue = None
+        if hasattr(self, "_speed_test_batch"):
+            del self._speed_test_batch
 
     # -- producer -------------------------------------------------------
     def _put(self, q: queue.Queue, item) -> bool:
@@ -113,7 +119,21 @@ class DeviceFeeder:
     def __next__(self) -> Dict[str, np.ndarray]:
         if self._queue is None:
             raise StopIteration
-        item = self._queue.get()
+        from ..flags import FLAGS
+
+        if FLAGS.reader_queue_speed_test_mode:
+            # non-destructive mode (reference
+            # FLAGS_reader_queue_speed_test_mode): serve the first batch
+            # forever so consumer-side throughput excludes producer cost
+            if not hasattr(self, "_speed_test_batch"):
+                self._speed_test_batch = self._queue.get()
+            if self._speed_test_batch is _STOP or isinstance(
+                    self._speed_test_batch, _ReaderError):
+                item = self._speed_test_batch
+            else:
+                return self._speed_test_batch
+        else:
+            item = self._queue.get()
         if item is _STOP:
             self._queue = None
             self._thread = None

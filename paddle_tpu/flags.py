@@ -93,9 +93,38 @@ if "FLAGS_fraction_of_tpu_memory_to_use" in os.environ:
 # Executor behavior
 FLAGS.define("use_mkldnn", False, "parity no-op (MKLDNN is x86-only)")
 FLAGS.define("reader_queue_speed_test_mode", False,
-             "non-destructive reader queue for throughput tests")
+             "non-destructive reader queue for throughput tests: "
+             "DeviceFeeder serves its first batch repeatedly so consumer "
+             "speed is measured without producer cost (reference "
+             "FLAGS_reader_queue_speed_test_mode)")
 FLAGS.define("eager_delete_tensor_gb", 0.0,
              "parity no-op; XLA buffer liveness handles eager deletion")
+# Host-side parallelism (reference FLAGS_paddle_num_threads sized the CPU
+# math thread pool; here it sizes host data-parsing pools — device math
+# threads are XLA's business)
+FLAGS.define("paddle_num_threads", 2,
+             "default worker-thread count for host pipelines "
+             "(AsyncExecutor parser shards)")
+# Distributed (reference FLAGS_rpc_deadline/max_retry guarded the gRPC
+# client; here the deadline bounds jax.distributed bootstrap)
+FLAGS.define("rpc_deadline", 180000,
+             "multi-host bootstrap timeout in ms "
+             "(jax.distributed initialization)")
+# Determinism aliases (reference FLAGS_cudnn_deterministic pinned conv
+# algos; XLA/TPU kernels are deterministic by construction)
+FLAGS.define("cudnn_deterministic", True,
+             "parity alias; TPU compilation is deterministic")
+FLAGS.define("sync_nccl_allreduce", True,
+             "parity alias; GSPMD collectives are synchronous by design")
+FLAGS.define("enable_parallel_graph", False,
+             "parity no-op; XLA owns scheduling")
+FLAGS.define("init_allocated_mem", False,
+             "parity no-op; XLA zero-initializes nothing by default and "
+             "the framework never reads uninitialized buffers")
+FLAGS.define("free_idle_memory", False,
+             "parity no-op; XLA allocator retains its HBM arena")
+FLAGS.define("inner_op_parallelism", 0,
+             "parity no-op; op-internal parallelism is the compiler's")
 
 
 def init_from_env():

@@ -41,6 +41,9 @@ def dynamic_lstm(ctx, ins, attrs):
     the reference contract (lstm_op.cc expects x @ W_x done outside).
     Weight (H, 4H) recurrent projection; Bias (1, 4H) or (1, 7H) with
     peepholes."""
+    from .sequence import _reject_nested
+
+    _reject_nested(ins, "dynamic_lstm")
     x = first(ins, "Input")
     w = first(ins, "Weight")
     bias = opt_in(ins, "Bias")

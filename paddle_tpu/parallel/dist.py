@@ -56,11 +56,16 @@ def init_distributed(trainer_id: Optional[int] = None,
             "multi-trainer bootstrap needs a coordinator endpoint: pass "
             "coordinator= or set PADDLE_COORDINATOR / "
             "PADDLE_TRAINER_ENDPOINTS")
+    from ..flags import FLAGS
+
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_trainers,
         process_id=trainer_id,
         local_device_ids=local_device_ids,
+        # bound the bootstrap wait (reference FLAGS_rpc_deadline guarded
+        # the gRPC client the same way; ms → s)
+        initialization_timeout=max(1, int(FLAGS.rpc_deadline / 1000)),
     )
     return trainer_id, num_trainers
 

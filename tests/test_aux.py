@@ -144,3 +144,41 @@ def test_print_op_passthrough_and_py_func():
                      fetch_list=[o, o2])
     np.testing.assert_allclose(r1, np.arange(4) * 2 + 1)
     np.testing.assert_allclose(r2, [6.0])
+
+
+def test_reader_queue_speed_test_mode_flag():
+    """FLAGS.reader_queue_speed_test_mode serves the first batch forever
+    (reference reader-throughput test mode)."""
+    import numpy as np
+
+    from paddle_tpu.data.pipeline import DeviceFeeder
+    from paddle_tpu.flags import FLAGS
+
+    def reader():
+        for i in range(3):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    FLAGS.reader_queue_speed_test_mode = True
+    try:
+        feeder = iter(DeviceFeeder(reader, capacity=2).start())
+        got = [float(np.asarray(next(feeder)["x"])[0]) for _ in range(6)]
+        assert got == [0.0] * 6  # first batch repeated, never consumed
+    finally:
+        FLAGS.reader_queue_speed_test_mode = False
+        feeder_obj = feeder
+        feeder_obj.reset()
+    # normal mode still consumes in order
+    feeder = iter(DeviceFeeder(reader, capacity=2).start())
+    got = [float(np.asarray(b["x"])[0]) for b in feeder]
+    assert got == [0.0, 1.0, 2.0]
+
+
+def test_flag_registry_breadth():
+    from paddle_tpu.flags import FLAGS
+
+    d = FLAGS.to_dict()
+    for name in ["check_nan_inf", "benchmark", "paddle_num_threads",
+                 "rpc_deadline", "cudnn_deterministic",
+                 "reader_queue_speed_test_mode",
+                 "fraction_of_tpu_memory_to_use"]:
+        assert name in d
