@@ -15,7 +15,10 @@ headline `value` is the minimum MFU across the two models — the number
 the north-star bar is set on.
 
 Run on the real TPU chip: `python bench.py [--model all|resnet50|
-transformer] [--batch N] [--steps N] [--no-amp] [--no-flash]`.
+transformer|deepfm|serving] [--batch N] [--steps N] [--no-amp]
+[--no-flash] [--data frozen|synthetic|host]`.  Default 60 timed steps:
+compile time dominates wall clock, and a ~3 s timed window keeps the
+reported MFU stable run-to-run (20-step windows wobbled by ~2 MFU pts).
 """
 
 from __future__ import annotations
@@ -299,7 +302,7 @@ def main():
                    choices=["all", "resnet50", "transformer", "deepfm",
                             "serving"])
     p.add_argument("--batch", type=int, default=0)
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=60)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--no-amp", action="store_true")
     p.add_argument("--no-flash", action="store_true")
