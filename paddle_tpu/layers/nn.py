@@ -1253,3 +1253,114 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                "out_shapes": [list(o.shape) for o in outs],
                "out_dtypes": [str(o.dtype) for o in outs]})
     return out
+
+
+# ---------------------------------------------------------------------------
+# Straggler ops (round-3 sweep): mean_iou, similarity_focus, psroi_pool,
+# random_crop, conv_shift, modified_huber_loss, positive_negative_pair.
+# reference: layers/nn.py mean_iou:6957, similarity_focus:8951,
+# psroi_pool:9628, random_crop:6814; conv_shift_op.cc,
+# modified_huber_loss_op.cc, positive_negative_pair_op.cc (op-level APIs).
+# ---------------------------------------------------------------------------
+
+def mean_iou(input, label, num_classes):
+    """Mean IoU over classes (reference layers/nn.py mean_iou:6957).
+    Returns (mean_iou scalar, out_wrong (C,), out_correct (C,))."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": int(num_classes)})
+    return miou, wrong, correct
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus mask (reference layers/nn.py
+    similarity_focus:8951)."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="similarity_focus", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"axis": int(axis), "indexes": [int(i) for i in indexes]})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """Position-sensitive ROI pooling for R-FCN (reference layers/nn.py
+    psroi_pool:9628); rois (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="psroi_pool", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"output_channels": int(output_channels),
+               "spatial_scale": float(spatial_scale),
+               "pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width)})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """Per-instance random crop (reference layers/nn.py random_crop:6814).
+    Randomness comes from the program RNG state rather than a threaded
+    Seed tensor; `seed` is accepted for API parity and ignored."""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape]})
+    return out
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution (reference conv_shift_op.cc, Neural Turing
+    Machine shift weighting): X (B, M), Y (B, N) with N odd -> (B, M)."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def modified_huber_loss(x, y, name=None):
+    """Modified Huber loss for binary classification (reference
+    modified_huber_loss_op.cc); x = f(x) scores (N, 1), y labels in
+    {0, 1} (N, 1)."""
+    helper = LayerHelper("modified_huber_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inter = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="modified_huber_loss",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out], "IntermediateVal": [inter]})
+    return out
+
+
+def positive_negative_pair(score, label, query_id, weight=None, column=-1,
+                           accumulators=None, name=None):
+    """Learning-to-rank pair counts (reference
+    positive_negative_pair_op.cc).  Returns (pos, neg, neutral) scalars;
+    `accumulators` is an optional (pos, neg, neu) tuple of previous
+    totals to stream across batches."""
+    helper = LayerHelper("positive_negative_pair", name=name)
+    pos = helper.create_variable_for_type_inference("float32")
+    neg = helper.create_variable_for_type_inference("float32")
+    neu = helper.create_variable_for_type_inference("float32")
+    ins = {"Score": [score], "Label": [label], "QueryID": [query_id]}
+    if weight is not None:
+        ins["Weight"] = [weight]
+    if accumulators is not None:
+        ins["AccumulatePositivePair"] = [accumulators[0]]
+        ins["AccumulateNegativePair"] = [accumulators[1]]
+        ins["AccumulateNeutralPair"] = [accumulators[2]]
+    helper.append_op(type="positive_negative_pair", inputs=ins,
+                     outputs={"PositivePair": [pos], "NegativePair": [neg],
+                              "NeutralPair": [neu]},
+                     attrs={"column": int(column)})
+    return pos, neg, neu

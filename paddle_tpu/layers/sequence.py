@@ -106,6 +106,83 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     return hidden_out, cell_out
 
 
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None):
+    """LSTM with recurrent projection (reference layers/nn.py
+    dynamic_lstmp:655) — input (N, T, 4*hidden) pre-projected by the
+    caller's fc; size is 4*hidden, proj_size the projection width.
+    Returns (projection (N, T, proj_size), cell (N, T, hidden))."""
+    helper = LayerHelper("lstmp", name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, shape=[proj_size, 4 * hidden],
+                                dtype=dtype)
+    w_proj = helper.create_parameter(param_attr, shape=[hidden, proj_size],
+                                     dtype=dtype)
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    b = helper.create_parameter(ParamAttr._to_attr(bias_attr) or ParamAttr(),
+                                shape=[1, bias_size], dtype=dtype,
+                                is_bias=True)
+    proj_out = helper.create_variable_for_type_inference(dtype)
+    cell_out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    ins = _seq_inputs(input, "Input")
+    ins.update({"Weight": [w], "ProjWeight": [w_proj], "Bias": [b]})
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    helper.append_op(
+        type="lstmp", inputs=ins,
+        outputs={"Projection": [proj_out], "Cell": [cell_out],
+                 "LastH": [last_h], "LastC": [last_c]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    _propagate_seq_len(input, proj_out)
+    _propagate_seq_len(input, cell_out)
+    return proj_out, cell_out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Re-segment a token stream (reference layers/nn.py lod_reset:5900).
+
+    Divergence note: the reference accepts a runtime Y whose LoD (or
+    rows) define the new structure; under jit the new segmentation fixes
+    the output's padded shape, so it must be static — pass `target_lod`
+    as a python list of offsets (a python-list `y` of lengths is
+    converted).  A traced tensor Y is rejected."""
+    if target_lod is None:
+        if isinstance(y, (list, tuple)):
+            off = [0]
+            for l in y:
+                off.append(off[-1] + int(l))
+            target_lod = off
+        else:
+            raise ValueError(
+                "lod_reset needs a static target_lod (list of offsets) "
+                "or a python-list y of lengths; a runtime tensor lod "
+                "would make the padded output shape dynamic under jit")
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int32")
+    ins = _seq_inputs(x)
+    helper.append_op(type="lod_reset", inputs=ins,
+                     outputs={"Out": [out], "Length": [length]},
+                     attrs={"target_lod": [int(v) for v in target_lod]})
+    block = default_main_program().current_block()
+    sl = block.create_var(name=f"{out.name}.seq_len", shape=length.shape,
+                          dtype="int32", stop_gradient=True)
+    block.append_op(type="assign", inputs={"X": [length]},
+                    outputs={"Out": [sl]})
+    return out
+
+
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation="sigmoid",
                 candidate_activation="tanh", h_0=None, dtype="float32",

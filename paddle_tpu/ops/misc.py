@@ -95,3 +95,49 @@ def py_func(ctx, ins, attrs):
 
     results = jax.pure_callback(host_fn, tuple(result_shape), *xs)
     return {"Out": list(results)}
+
+
+@register_op("conv_shift")
+def conv_shift(ctx, ins, attrs):
+    """Circular (modular) correlation of two vector batches as used by
+    Neural Turing Machines (reference conv_shift_op.cc):
+    Out[b, i] = sum_{j=-(N-1)/2}^{(N-1)/2} X[b, (i+j) mod M] * Y[b, j'].
+    X (B, M), Y (B, N) with N odd and N <= M; Out (B, M)."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    m, n = x.shape[1], y.shape[1]
+    half = (n - 1) // 2
+    # gather X at the circularly shifted positions for every tap: XLA
+    # lowers the static roll stack to a single gather/concat fusion
+    shifted = jnp.stack(
+        [jnp.roll(x, -j, axis=1) for j in range(-half, half + 1)], axis=1
+    )  # (B, N, M)
+    o = jnp.einsum("bnm,bn->bm", shifted, y)
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("random_crop")
+def random_crop(ctx, ins, attrs):
+    """Per-instance random spatial crop (reference random_crop_op.cc):
+    X (N, d1..dk) cropped to attr `shape` over the trailing len(shape)
+    dims; each instance draws its own uniform offsets.  The reference
+    threads a Seed tensor through; here randomness comes from the
+    program RNG state (ctx.rng()), which advances per step."""
+    x = first(ins, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    k = len(shape)
+    batch_dims = x.shape[: x.ndim - k]
+    n = 1
+    for d in batch_dims:
+        n *= d
+    flat = x.reshape((n,) + x.shape[x.ndim - k:])
+    keys = jax.random.split(ctx.rng(), n * k).reshape(n, k, 2)
+
+    def one(inst, ks):
+        starts = [jax.random.randint(ks[i], (), 0,
+                                     inst.shape[i] - shape[i] + 1)
+                  for i in range(k)]
+        return jax.lax.dynamic_slice(inst, starts, shape)
+
+    o = jax.vmap(one)(flat, keys)
+    return out(Out=o.reshape(batch_dims + tuple(shape)).astype(x.dtype))

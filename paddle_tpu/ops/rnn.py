@@ -208,6 +208,85 @@ def gru_unit(ctx, ins, attrs):
             "ResetHiddenPrev": [r * h_prev]}
 
 
+@register_op("lstmp")
+def lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (reference lstmp_op.cc): the
+    hidden state h (size D) is projected to r (size P) each step and r —
+    not h — feeds the recurrence.  Input (N, T, 4D) pre-projected like
+    dynamic_lstm; Weight (P, 4D) recurrent-on-projection; ProjWeight
+    (D, P); Bias (1, 4D) or (1, 7D) with peepholes.  Outputs the
+    projection sequence (N, T, P) and cell sequence (N, T, D)."""
+    from .sequence import _reject_nested
+
+    _reject_nested(ins, "lstmp")
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    w_proj = first(ins, "ProjWeight")
+    bias = opt_in(ins, "Bias")
+    seq_len = opt_in(ins, "SeqLen")
+    h0 = opt_in(ins, "H0")
+    c0 = opt_in(ins, "C0")
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "tanh"))
+    use_peepholes = attrs.get("use_peepholes", False)
+    is_reverse = attrs.get("is_reverse", False)
+
+    n, t, g4 = x.shape
+    h_dim = g4 // 4
+    p_dim = w_proj.shape[1]
+    w_ic = w_fc = w_oc = jnp.zeros((h_dim,), x.dtype)
+    if bias is not None:
+        x = x + bias.reshape(-1)[: 4 * h_dim]
+        if use_peepholes:
+            peep = bias.reshape(-1)[4 * h_dim: 7 * h_dim]
+            w_ic, w_fc, w_oc = (peep[:h_dim], peep[h_dim: 2 * h_dim],
+                                peep[2 * h_dim:])
+    # initial recurrent input is the projection of H0 (OrderedP0)
+    r_prev = proj_act(h0 @ w_proj) if h0 is not None \
+        else jnp.zeros((n, p_dim), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((n, h_dim), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    steps = jnp.arange(t)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+        steps = jnp.flip(steps)
+
+    def step(carry, inp):
+        r, c = carry
+        xt, tidx = inp
+        gates = xt + r @ w
+        cand, i, f, o = jnp.split(gates, 4, axis=-1)  # reference order
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = gate_act(i), gate_act(f)
+        c_new = f * c + i * cand_act(cand)
+        if use_peepholes:
+            o = o + c_new * w_oc
+        h_new = gate_act(o) * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        if seq_len is not None:
+            valid = (tidx < seq_len)[:, None]
+            r_new = jnp.where(valid, r_new, r)
+            c_new = jnp.where(valid, c_new, c)
+        return (r_new, c_new), (r_new, c_new)
+
+    (r_last, c_last), (rs, cs) = lax.scan(step, (r_prev, c_prev),
+                                          (xs, steps))
+    if is_reverse:
+        rs = jnp.flip(rs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    return {
+        "Projection": [jnp.swapaxes(rs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+        "LastH": [r_last],
+        "LastC": [c_last],
+    }
+
+
 @register_op("row_conv")
 def row_conv(ctx, ins, attrs):
     """Lookahead row convolution (reference row_conv_op.cc): X (N, T, D),

@@ -340,3 +340,62 @@ def sequence_reshape(ctx, ins, attrs):
     # preclude a data-dependent raise here, so no data is dropped)
     out_len = -(-(seq_len.astype(jnp.int32) * d) // new_dim)
     return out(Out=o, OutLen=out_len)
+
+
+@register_op("lod_reset")
+def lod_reset(ctx, ins, attrs):
+    """Re-segment a token stream under a new LoD (reference
+    lod_reset_op.cc: the underlying rows are kept, only the sequence
+    structure is replaced).  The new structure must be STATIC — the attr
+    `target_lod` offsets — because it determines the padded output
+    shape; a dynamic Y-provided LoD cannot exist under jit (divergence
+    note in the layer docstring).
+
+    X is either a plain (R, ...) row stream (each row one token) or a
+    padded (N, T, ...) sequence batch with SeqLen, whose valid tokens
+    concatenate (in batch order) to the stream being re-segmented."""
+    _reject_nested(ins, "lod_reset")
+    x = first(ins, "X")
+    seq_len = opt_in(ins, "SeqLen")
+    target_lod = [int(v) for v in attrs["target_lod"]]
+    if len(target_lod) < 2 or target_lod[0] != 0:
+        raise ValueError(f"target_lod must start at 0 with >=2 offsets, "
+                         f"got {target_lod}")
+    new_lens = [target_lod[i + 1] - target_lod[i]
+                for i in range(len(target_lod) - 1)]
+    if any(l < 0 for l in new_lens):
+        raise ValueError(f"target_lod must be non-decreasing: {target_lod}")
+    num_new, max_new = len(new_lens), max(new_lens)
+    total = target_lod[-1]
+
+    # flat token index t -> source position
+    t_idx = jnp.arange(total)
+    if seq_len is None:
+        # rows ARE the stream; the new lod must span exactly the rows
+        # (reference lod_reset_op.cc InferShape enforces the same)
+        if total != x.shape[0]:
+            raise ValueError(
+                f"lod_reset: target_lod covers {total} rows but X has "
+                f"{x.shape[0]}")
+        gathered = x[t_idx]
+    else:
+        lens = seq_len.astype(jnp.int32)
+        starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(lens)])[:-1]
+        # row owning token t: last n with starts[n] <= t
+        n_of = jnp.sum(t_idx[:, None] >= (starts + lens)[None, :],
+                       axis=1)
+        n_of = jnp.clip(n_of, 0, x.shape[0] - 1)
+        pos = jnp.clip(t_idx - starts[n_of], 0, x.shape[1] - 1)
+        gathered = x[n_of, pos]
+
+    # scatter the stream into the new padded layout
+    out_shape = (num_new, max_new) + x.shape[(1 if seq_len is None
+                                              else 2):]
+    o = jnp.zeros(out_shape, x.dtype)
+    seq_of = jnp.searchsorted(jnp.asarray(target_lod[1:]), t_idx,
+                              side="right")
+    pos_new = t_idx - jnp.asarray(target_lod)[seq_of]
+    o = o.at[seq_of, pos_new].set(gathered)
+    return {"Out": [o],
+            "Length": [jnp.asarray(new_lens, jnp.int32)]}

@@ -601,3 +601,97 @@ def precision_recall(ctx, ins, attrs):
     return out(BatchMetrics=metrics(batch_states),
                AccumMetrics=metrics(accum_states),
                AccumStatesInfo=accum_states)
+
+
+@register_op("mean_iou")
+def mean_iou(ctx, ins, attrs):
+    """Mean intersection-over-union for semantic segmentation (reference
+    mean_iou_op.cc / mean_iou_op.h): per-class correct/wrong counts from
+    int predictions vs labels; IoU_c = correct_c / (wrong_c + correct_c)
+    averaged over classes that appear; optional InWrongs/InCorrects/
+    InMeanIou accumulator lists add onto the outputs (streaming eval)."""
+    pred = first(ins, "Predictions").reshape(-1)
+    label = first(ins, "Labels").reshape(-1)
+    num_classes = int(attrs["num_classes"])
+
+    match = pred == label
+    # reference mean_iou_op.h:92-99 — a correct pixel increments
+    # correct[pred]; a wrong pixel increments BOTH wrong[label] and
+    # wrong[pred] (union counting)
+    correct = jnp.zeros((num_classes,), jnp.int32).at[pred].add(
+        match.astype(jnp.int32), mode="drop")
+    wrong = jnp.zeros((num_classes,), jnp.int32).at[label].add(
+        (~match).astype(jnp.int32), mode="drop")
+    wrong = wrong.at[pred].add((~match).astype(jnp.int32), mode="drop")
+    for prev in ins.get("InCorrects", []):
+        correct = correct + prev.astype(jnp.int32)
+    for prev in ins.get("InWrongs", []):
+        wrong = wrong + prev.astype(jnp.int32)
+
+    denom = wrong + correct
+    valid = denom > 0
+    iou = jnp.where(valid, correct / jnp.maximum(denom, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    for prev in ins.get("InMeanIou", []):
+        miou = miou + prev.reshape(())
+    return {"OutMeanIou": [miou.reshape(1).astype(jnp.float32)],
+            "OutWrong": [wrong], "OutCorrect": [correct]}
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(ctx, ins, attrs):
+    """Binary-classification modified Huber loss (reference
+    modified_huber_loss_op.cc): labels in {0,1} are scaled to {-1,+1};
+    loss = max(0, 1-yf)^2 when yf >= -1 else -4yf."""
+    x = first(ins, "X")
+    y = first(ins, "Y").astype(x.dtype)
+    yf = (2.0 * y - 1.0) * x
+    loss = jnp.where(yf >= -1.0,
+                     jnp.square(jnp.maximum(0.0, 1.0 - yf)),
+                     -4.0 * yf)
+    return {"Out": [loss.astype(x.dtype)],
+            "IntermediateVal": [yf.astype(x.dtype)]}
+
+
+@register_op("positive_negative_pair")
+def positive_negative_pair(ctx, ins, attrs):
+    """Learning-to-rank pair statistics (reference
+    positive_negative_pair_op.cc): within each query group, count item
+    pairs whose score order agrees (positive), disagrees (negative), or
+    ties (neutral) with the label order; ties in label are skipped.
+    Optional weight column averages (w_i + w_j)/2 per pair; optional
+    Accumulate* inputs stream across batches."""
+    score = first(ins, "Score")
+    label = first(ins, "Label").reshape(-1).astype(jnp.float32)
+    query = first(ins, "QueryID").reshape(-1)
+    weight = opt_in(ins, "Weight")
+    col = int(attrs.get("column", -1))
+    s = score[:, col].astype(jnp.float32)
+    n = s.shape[0]
+    w = (jnp.ones((n,), jnp.float32) if weight is None
+         else weight.reshape(-1).astype(jnp.float32))
+
+    # dense pairwise comparison (upper triangle counts each pair once);
+    # the reference iterates itertools-style per query — O(N^2) either
+    # way, but the dense form is one fused XLA kernel
+    upper = jnp.triu(jnp.ones((n, n), jnp.bool_), k=1)
+    same_q = query[:, None] == query[None, :]
+    dl = label[:, None] - label[None, :]
+    ds = s[:, None] - s[None, :]
+    pair_ok = upper & same_q & (dl != 0)
+    pw = (w[:, None] + w[None, :]) * 0.5
+    pos = jnp.sum(jnp.where(pair_ok & (ds * dl > 0), pw, 0.0))
+    neg = jnp.sum(jnp.where(pair_ok & (ds != 0) & (ds * dl < 0), pw, 0.0))
+    neu = jnp.sum(jnp.where(pair_ok & (ds == 0), pw, 0.0))
+    acc_p = opt_in(ins, "AccumulatePositivePair")
+    acc_n = opt_in(ins, "AccumulateNegativePair")
+    acc_u = opt_in(ins, "AccumulateNeutralPair")
+    if acc_p is not None:
+        pos = pos + acc_p.reshape(())
+    if acc_n is not None:
+        neg = neg + acc_n.reshape(())
+    if acc_u is not None:
+        neu = neu + acc_u.reshape(())
+    return {"PositivePair": [pos.reshape(1)],
+            "NegativePair": [neg.reshape(1)],
+            "NeutralPair": [neu.reshape(1)]}

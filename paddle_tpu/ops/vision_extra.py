@@ -268,3 +268,103 @@ def unpool(ctx, ins, attrs):
     o = jax.vmap(jax.vmap(scatter_plane))(flat_x, flat_m)
     return out(Out=o.reshape(n, c, uh, uw))
 
+
+
+@register_op("psroi_pool")
+def psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI average pooling for R-FCN (reference
+    psroi_pool_op.cc/.h): input channels factor as
+    output_channels * pooled_h * pooled_w, and output channel c's bin
+    (i, j) pools input channel (c*pooled_h + i)*pooled_w + j.  ROIs are
+    (R, 5) [batch_idx, x1, y1, x2, y2] (batch-in-box replaces LoD)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    c_out = int(attrs["output_channels"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    _n, c_in, h, w = x.shape
+    if c_in != c_out * ph * pw:
+        raise ValueError(
+            f"psroi_pool: input channels {c_in} != output_channels "
+            f"{c_out} * pooled_height {ph} * pooled_width {pw}")
+    bix, boxes = _roi_batch_split(rois)
+    # (N, C_out, ph, pw, H, W): position-sensitive channel unfold
+    xs = x.reshape(_n, c_out, ph, pw, h, w)
+
+    def one(bi, box):
+        fm = xs[bi]
+        # reference rounds corners, then end+1 (psroi_pool_op.h:84-91)
+        x1 = jnp.round(box[0]) * scale
+        y1 = jnp.round(box[1]) * scale
+        x2 = (jnp.round(box[2]) + 1.0) * scale
+        y2 = (jnp.round(box[3]) + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        ys = jnp.clip(jnp.floor(jnp.arange(ph) * bh + y1), 0, h)
+        ye = jnp.clip(jnp.ceil((jnp.arange(ph) + 1) * bh + y1), 0, h)
+        xs_ = jnp.clip(jnp.floor(jnp.arange(pw) * bw + x1), 0, w)
+        xe = jnp.clip(jnp.ceil((jnp.arange(pw) + 1) * bw + x1), 0, w)
+        row = jnp.arange(h, dtype=jnp.float32)
+        col = jnp.arange(w, dtype=jnp.float32)
+        rm = ((row[None, :] >= ys[:, None]) &
+              (row[None, :] < ye[:, None])).astype(x.dtype)  # (ph, H)
+        cm = ((col[None, :] >= xs_[:, None]) &
+              (col[None, :] < xe[:, None])).astype(x.dtype)  # (pw, W)
+        t = jnp.einsum("ih,cijhw->cijw", rm, fm)
+        s = jnp.einsum("jw,cijw->cij", cm, t)                # (C_out,ph,pw)
+        area = ((ye - ys)[:, None] * (xe - xs_)[None, :])
+        return jnp.where(area > 0, s / jnp.maximum(area, 1.0), 0.0)
+
+    o = jax.vmap(one)(bix, boxes)
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("similarity_focus")
+def similarity_focus(ctx, ins, attrs):
+    """Similarity-focus mask (reference similarity_focus_op.h): for each
+    batch item and each selected slice along `axis`, greedily pick the
+    largest values such that every (row, col) of the remaining two dims
+    is used at most once, mark those positions 1, broadcast along
+    `axis`, and OR across indexes."""
+    x = first(ins, "X")
+    axis = int(attrs["axis"])
+    indexes = [int(i) for i in attrs["indexes"]]
+    if axis not in (1, 2, 3):
+        raise ValueError(f"similarity_focus axis must be 1, 2 or 3, "
+                         f"got {axis}")
+
+    def greedy_mask(t):
+        """t (R, C) → 0/1 mask with min(R,C) greedy row/col-unique
+        argmax picks (equivalent to the reference's sorted scan with
+        tagged-row/col skipping)."""
+        r, c = t.shape
+        neg = jnp.asarray(-jnp.inf, jnp.float32)
+
+        def body(_, carry):
+            mask, rfree, cfree = carry
+            avail = jnp.where(rfree[:, None] & cfree[None, :],
+                              t.astype(jnp.float32), neg)
+            flat = jnp.argmax(avail)
+            ri, ci = flat // c, flat % c
+            mask = mask.at[ri, ci].set(1.0)
+            return (mask, rfree.at[ri].set(False),
+                    cfree.at[ci].set(False))
+
+        mask0 = jnp.zeros((r, c), jnp.float32)
+        mask, _, _ = jax.lax.fori_loop(
+            0, min(r, c), body,
+            (mask0, jnp.ones((r,), jnp.bool_), jnp.ones((c,), jnp.bool_)))
+        return mask
+
+    masks = []
+    for idx in indexes:
+        sl = jax.lax.index_in_dim(x, idx, axis=axis, keepdims=False)
+        m = jax.vmap(greedy_mask)(sl.reshape((x.shape[0],) + sl.shape[1:]))
+        masks.append(jnp.expand_dims(m, axis))
+    combined = masks[0]
+    for m in masks[1:]:
+        combined = jnp.maximum(combined, m)
+    o = jnp.broadcast_to(combined, x.shape)
+    return out(Out=o.astype(x.dtype))

@@ -1,0 +1,450 @@
+"""Straggler-op sweep (VERDICT round-2 item 10): lstmp, mean_iou,
+psroi_pool, random_crop, conv_shift, lod_reset, modified_huber_loss,
+similarity_focus, positive_negative_pair.
+
+Each op's numeric check mirrors the reference kernel semantics
+(reference file cited per test); reference numbers are recomputed here
+in plain numpy, never copied.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from op_test import check_grad, check_output, run_op
+
+
+# -- conv_shift (reference conv_shift_op.cc) --------------------------------
+
+def _conv_shift_np(x, y):
+    b, m = x.shape
+    n = y.shape[1]
+    half = (n - 1) // 2
+    o = np.zeros_like(x)
+    for i in range(m):
+        for j in range(n):
+            o[:, i] += x[:, (i + j - half + m) % m] * y[:, j]
+    return o
+
+
+def test_conv_shift_forward():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 17).astype(np.float32)
+    y = rng.rand(4, 3).astype(np.float32)
+    check_output("conv_shift", {"X": x, "Y": y}, _conv_shift_np(x, y),
+                 rtol=1e-5)
+
+
+def test_conv_shift_grad():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 9).astype(np.float32)
+    y = rng.rand(2, 3).astype(np.float32)
+    check_grad("conv_shift", {"X": x, "Y": y}, "X")
+    check_grad("conv_shift", {"X": x, "Y": y}, "Y")
+
+
+# -- modified_huber_loss (reference modified_huber_loss_op.cc) --------------
+
+def test_modified_huber_loss():
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 1).astype(np.float32)
+    y = rng.randint(0, 2, (8, 1)).astype(np.float32)
+    yf = (2 * y - 1) * x
+    expected = np.where(yf >= -1, np.maximum(0, 1 - yf) ** 2, -4 * yf)
+    check_output("modified_huber_loss", {"X": x, "Y": y}, expected)
+    # keep x away from the yf == -1 kink for finite differences
+    x2 = np.where(np.abs((2 * y - 1) * x + 1) < 0.05, x + 0.2, x)
+    check_grad("modified_huber_loss", {"X": x2, "Y": y}, "X")
+
+
+# -- mean_iou (reference mean_iou_op.h) -------------------------------------
+
+def _mean_iou_np(pred, label, n_cls):
+    wrong = np.zeros(n_cls, np.int32)
+    correct = np.zeros(n_cls, np.int32)
+    for p, l in zip(pred.ravel(), label.ravel()):
+        if p == l:
+            correct[p] += 1
+        else:
+            wrong[l] += 1
+            wrong[p] += 1
+    denom = wrong + correct
+    valid = denom > 0
+    iou = np.where(valid, correct / np.maximum(denom, 1), 0.0)
+    return iou.sum() / max(valid.sum(), 1), wrong, correct
+
+
+def test_mean_iou():
+    rng = np.random.RandomState(3)
+    pred = rng.randint(0, 5, (4, 16)).astype(np.int32)
+    label = rng.randint(0, 5, (4, 16)).astype(np.int32)
+    miou, wrong, correct = _mean_iou_np(pred, label, 5)
+    got_miou, got_wrong, got_correct = (
+        run_op("mean_iou", {"Predictions": pred, "Labels": label},
+               {"num_classes": 5}, out_slot="OutMeanIou"),
+        run_op("mean_iou", {"Predictions": pred, "Labels": label},
+               {"num_classes": 5}, out_slot="OutWrong"),
+        run_op("mean_iou", {"Predictions": pred, "Labels": label},
+               {"num_classes": 5}, out_slot="OutCorrect"),
+    )
+    np.testing.assert_allclose(got_miou, [miou], rtol=1e-6)
+    np.testing.assert_array_equal(got_wrong, wrong)
+    np.testing.assert_array_equal(got_correct, correct)
+
+
+def test_mean_iou_accumulates():
+    pred = np.array([[0, 1]], np.int32)
+    label = np.array([[0, 1]], np.int32)
+    prev_w = np.array([1, 0, 0], np.int32)
+    prev_c = np.array([0, 2, 0], np.int32)
+    wrong = run_op("mean_iou",
+                   {"Predictions": pred, "Labels": label,
+                    "InWrongs": [prev_w], "InCorrects": [prev_c]},
+                   {"num_classes": 3}, out_slot="OutWrong")
+    correct = run_op("mean_iou",
+                     {"Predictions": pred, "Labels": label,
+                      "InWrongs": [prev_w], "InCorrects": [prev_c]},
+                     {"num_classes": 3}, out_slot="OutCorrect")
+    np.testing.assert_array_equal(wrong, [1, 0, 0])
+    np.testing.assert_array_equal(correct, [1, 3, 0])
+
+
+# -- positive_negative_pair (reference positive_negative_pair_op.cc) --------
+
+def _pnpair_np(score, label, query, column=-1, weight=None):
+    n = label.shape[0]
+    if weight is None:
+        weight = np.ones((n, 1), np.float32)
+    groups = {}
+    for s, l, q, w in zip(score, label, query, weight):
+        groups.setdefault(q[0], []).append((s[column], l[0], w[0]))
+    pos = neg = neu = 0.0
+    for ranks in groups.values():
+        for e1, e2 in itertools.combinations(ranks, 2):
+            (s1, l1, w1), (s2, l2, w2) = e1, e2
+            if l1 == l2:
+                continue
+            w = (w1 + w2) * 0.5
+            if s1 == s2:
+                neu += w
+            elif (s1 - s2) * (l1 - l2) > 0:
+                pos += w
+            else:
+                neg += w
+    return pos, neg, neu
+
+
+def test_positive_negative_pair():
+    rng = np.random.RandomState(4)
+    n = 20
+    score = rng.randn(n, 3).astype(np.float32)
+    label = rng.randint(0, 3, (n, 1)).astype(np.float32)
+    query = rng.randint(0, 4, (n, 1)).astype(np.int64)
+    pos, neg, neu = _pnpair_np(score, label, query, column=1)
+    ins = {"Score": score, "Label": label, "QueryID": query}
+    got_p = run_op("positive_negative_pair", ins, {"column": 1},
+                   out_slot="PositivePair")
+    got_n = run_op("positive_negative_pair", ins, {"column": 1},
+                   out_slot="NegativePair")
+    got_u = run_op("positive_negative_pair", ins, {"column": 1},
+                   out_slot="NeutralPair")
+    np.testing.assert_allclose(got_p, [pos], rtol=1e-5)
+    np.testing.assert_allclose(got_n, [neg], rtol=1e-5)
+    np.testing.assert_allclose(got_u, [neu], rtol=1e-5)
+
+
+def test_positive_negative_pair_weighted_accum():
+    rng = np.random.RandomState(5)
+    n = 12
+    score = rng.randn(n, 1).astype(np.float32)
+    label = rng.randint(0, 2, (n, 1)).astype(np.float32)
+    query = rng.randint(0, 2, (n, 1)).astype(np.int64)
+    weight = rng.rand(n, 1).astype(np.float32)
+    pos, _, _ = _pnpair_np(score, label, query, weight=weight)
+    got = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": query,
+                  "Weight": weight,
+                  "AccumulatePositivePair": np.array([2.5], np.float32)},
+                 {"column": -1}, out_slot="PositivePair")
+    np.testing.assert_allclose(got, [pos + 2.5], rtol=1e-5)
+
+
+# -- lstmp (reference lstmp_op.cc) ------------------------------------------
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def test_lstmp_matches_numpy_recurrence():
+    rng = np.random.RandomState(6)
+    n, t, d, p = 2, 5, 4, 3
+    x = rng.randn(n, t, 4 * d).astype(np.float32) * 0.5
+    w = rng.randn(p, 4 * d).astype(np.float32) * 0.3
+    w_proj = rng.randn(d, p).astype(np.float32) * 0.3
+    bias = rng.randn(1, 4 * d).astype(np.float32) * 0.1
+
+    r = np.zeros((n, p), np.float32)
+    c = np.zeros((n, d), np.float32)
+    rs = []
+    for step in range(t):
+        gates = x[:, step] + bias.reshape(-1) + r @ w
+        cand, i, f, o = np.split(gates, 4, axis=-1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        c = f * c + i * np.tanh(cand)
+        h = o * np.tanh(c)
+        r = np.tanh(h @ w_proj)
+        rs.append(r.copy())
+    expected = np.stack(rs, axis=1)
+
+    got = run_op("lstmp",
+                 {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                  "Bias": bias},
+                 {"use_peepholes": False}, out_slot="Projection")
+    np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_lstmp_grad_and_masking():
+    rng = np.random.RandomState(7)
+    n, t, d, p = 2, 4, 3, 2
+    x = rng.randn(n, t, 4 * d).astype(np.float32) * 0.3
+    w = rng.randn(p, 4 * d).astype(np.float32) * 0.3
+    w_proj = rng.randn(d, p).astype(np.float32) * 0.3
+    seq_len = np.array([4, 2], np.int32)
+    proj = run_op("lstmp",
+                  {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                   "SeqLen": seq_len},
+                  {"use_peepholes": False}, out_slot="Projection")
+    # past-end steps freeze the state
+    np.testing.assert_allclose(proj[1, 2], proj[1, 1], rtol=1e-6)
+    np.testing.assert_allclose(proj[1, 3], proj[1, 1], rtol=1e-6)
+    check_grad("lstmp",
+               {"Input": x, "Weight": w, "ProjWeight": w_proj},
+               "Weight", {"use_peepholes": False}, out_slot="Projection",
+               max_relative_error=2e-2)
+
+
+def test_dynamic_lstmp_layer_builds_and_runs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        data = layers.data(name="x", shape=[6, 8], dtype="float32")
+        proj, cell = layers.dynamic_lstmp(data, size=8, proj_size=3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(8).randn(2, 6, 8).astype(np.float32)
+        pv, cv = exe.run(main, feed={"x": xv}, fetch_list=[proj, cell])
+    assert pv.shape == (2, 6, 3)
+    assert cv.shape == (2, 6, 2)
+
+
+# -- psroi_pool (reference psroi_pool_op.h) ---------------------------------
+
+def _psroi_np(x, rois, c_out, ph, pw, scale):
+    _n, c_in, h, w = x.shape
+    out_arr = np.zeros((rois.shape[0], c_out, ph, pw), np.float32)
+    for ri, roi in enumerate(rois):
+        bi = int(roi[0])
+        x1 = round(roi[1]) * scale
+        y1 = round(roi[2]) * scale
+        x2 = (round(roi[3]) + 1.0) * scale
+        y2 = (round(roi[4]) + 1.0) * scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(c_out):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * bh + y1)), 0), h)
+                    he = min(max(int(np.ceil((i + 1) * bh + y1)), 0), h)
+                    ws = min(max(int(np.floor(j * bw + x1)), 0), w)
+                    we = min(max(int(np.ceil((j + 1) * bw + x1)), 0), w)
+                    cin = (c * ph + i) * pw + j
+                    if he <= hs or we <= ws:
+                        continue
+                    region = x[bi, cin, hs:he, ws:we]
+                    out_arr[ri, c, i, j] = region.sum() / (
+                        (he - hs) * (we - ws))
+    return out_arr
+
+
+def test_psroi_pool():
+    rng = np.random.RandomState(9)
+    c_out, ph, pw = 2, 2, 2
+    x = rng.rand(2, c_out * ph * pw, 8, 8).astype(np.float32)
+    rois = np.array([
+        [0, 1, 1, 6, 6],
+        [1, 0, 2, 7, 5],
+        [0, 3, 3, 3, 3],
+    ], np.float32)
+    expected = _psroi_np(x, rois, c_out, ph, pw, 1.0)
+    check_output("psroi_pool", {"X": x, "ROIs": rois}, expected,
+                 {"output_channels": c_out, "pooled_height": ph,
+                  "pooled_width": pw, "spatial_scale": 1.0}, rtol=1e-4,
+                 atol=1e-5)
+
+
+def test_psroi_pool_grad():
+    rng = np.random.RandomState(10)
+    x = rng.rand(1, 8, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    check_grad("psroi_pool", {"X": x, "ROIs": rois}, "X",
+               {"output_channels": 2, "pooled_height": 2,
+                "pooled_width": 2, "spatial_scale": 1.0},
+               max_relative_error=1e-2)
+
+
+# -- random_crop (reference random_crop_op.cc) ------------------------------
+
+def test_random_crop_shape_and_content():
+    rng = np.random.RandomState(11)
+    x = rng.rand(4, 3, 10, 10).astype(np.float32)
+    o = run_op("random_crop", {"X": x}, {"shape": [3, 6, 6]})
+    assert o.shape == (4, 3, 6, 6)
+    # every crop window must be a contiguous sub-block of its instance
+    for i in range(4):
+        found = False
+        for dy in range(5):
+            for dx in range(5):
+                if np.allclose(o[i], x[i, :, dy:dy + 6, dx:dx + 6]):
+                    found = True
+        assert found, f"crop {i} is not a sub-block of instance {i}"
+
+
+# -- lod_reset (reference lod_reset_op.cc) ----------------------------------
+
+def test_lod_reset_plain_rows():
+    rng = np.random.RandomState(12)
+    x = rng.rand(6, 3).astype(np.float32)
+    o = run_op("lod_reset", {"X": x}, {"target_lod": [0, 2, 6]})
+    lens = run_op("lod_reset", {"X": x}, {"target_lod": [0, 2, 6]},
+                  out_slot="Length")
+    assert o.shape == (2, 4, 3)
+    np.testing.assert_array_equal(lens, [2, 4])
+    np.testing.assert_allclose(o[0, :2], x[:2])
+    np.testing.assert_allclose(o[1, :4], x[2:])
+    np.testing.assert_allclose(o[0, 2:], 0)
+
+
+def test_lod_reset_from_padded_sequences():
+    rng = np.random.RandomState(13)
+    x = rng.rand(3, 4, 2).astype(np.float32)   # padded, lens [2, 4, 1]
+    seq_len = np.array([2, 4, 1], np.int32)
+    # stream = x[0,:2] + x[1,:4] + x[2,:1] (7 tokens) → re-split [3, 4]
+    o = run_op("lod_reset", {"X": x, "SeqLen": seq_len},
+               {"target_lod": [0, 3, 7]})
+    stream = np.concatenate([x[0, :2], x[1, :4], x[2, :1]])
+    np.testing.assert_allclose(o[0, :3], stream[:3])
+    np.testing.assert_allclose(o[1, :4], stream[3:])
+
+
+def test_lod_reset_layer_attaches_companion():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        o = layers.lod_reset(x, y=[2, 2])
+        assert layers.seq_len_var(o) is not None
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+        ov, = exe.run(main, feed={"x": xv}, fetch_list=[o])
+    assert ov.shape == (2, 2, 3)
+    np.testing.assert_allclose(ov.reshape(4, 3), xv)
+
+
+# -- similarity_focus (reference similarity_focus_op.h) ---------------------
+
+def _similarity_focus_np(x, axis, indexes):
+    b = x.shape[0]
+    dims = x.shape
+    o = np.zeros_like(x)
+    for i in range(b):
+        for index in indexes:
+            if axis == 1:
+                t = x[i, index, :, :]
+                r_dim, c_dim = dims[2], dims[3]
+            elif axis == 2:
+                t = x[i, :, index, :]
+                r_dim, c_dim = dims[1], dims[3]
+            else:
+                t = x[i, :, :, index]
+                r_dim, c_dim = dims[1], dims[2]
+            order = np.argsort(-t.ravel(), kind="stable")
+            tag_r = np.zeros(r_dim, bool)
+            tag_c = np.zeros(c_dim, bool)
+            picked = 0
+            for flat in order:
+                ri, ci = divmod(int(flat), c_dim)
+                if tag_r[ri] or tag_c[ci]:
+                    continue
+                tag_r[ri] = tag_c[ci] = True
+                picked += 1
+                if axis == 1:
+                    o[i, :, ri, ci] = 1
+                elif axis == 2:
+                    o[i, ri, :, ci] = 1
+                else:
+                    o[i, ri, ci, :] = 1
+                if picked == min(r_dim, c_dim):
+                    break
+    return o
+
+
+@pytest.mark.parametrize("axis", [1, 2, 3])
+def test_similarity_focus(axis):
+    rng = np.random.RandomState(14)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    expected = _similarity_focus_np(x, axis, [0, 1])
+    check_output("similarity_focus", {"X": x}, expected,
+                 {"axis": axis, "indexes": [0, 1]})
+
+
+# -- layer wrappers smoke ----------------------------------------------------
+
+def test_straggler_layer_wrappers_build():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(15)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        seg = layers.data(name="seg", shape=[16], dtype="int32")
+        lbl = layers.data(name="lbl", shape=[16], dtype="int32")
+        miou, _, _ = layers.mean_iou(seg, lbl, num_classes=4)
+        img = layers.data(name="img", shape=[8, 6, 6], dtype="float32")
+        rois = layers.data(name="rois", shape=[5], dtype="float32")
+        pooled = layers.psroi_pool(img, rois, output_channels=2,
+                                   spatial_scale=1.0, pooled_height=2,
+                                   pooled_width=2)
+        cropped = layers.random_crop(img, shape=[8, 4, 4])
+        flat = layers.reshape(img, shape=[0, 8 * 36])
+        cs = layers.conv_shift(
+            layers.slice(flat, axes=[1], starts=[0], ends=[9]),
+            layers.slice(flat, axes=[1], starts=[0], ends=[3]))
+        score = layers.data(name="score", shape=[1], dtype="float32")
+        ylab = layers.data(name="ylab", shape=[1], dtype="float32")
+        mh = layers.modified_huber_loss(score, ylab)
+        qid = layers.data(name="qid", shape=[1], dtype="int64")
+        pos, neg, neu = layers.positive_negative_pair(score, ylab, qid)
+        sf = layers.similarity_focus(img, axis=1, indexes=[0])
+        exe = fluid.Executor()
+        exe.run(startup)
+        feeds = {
+            "seg": rng.randint(0, 4, (2, 16)).astype(np.int32),
+            "lbl": rng.randint(0, 4, (2, 16)).astype(np.int32),
+            "img": rng.rand(2, 8, 6, 6).astype(np.float32),
+            "rois": np.array([[0, 0, 0, 5, 5]], np.float32),
+            "score": rng.randn(6, 1).astype(np.float32),
+            "ylab": rng.randint(0, 2, (6, 1)).astype(np.float32),
+            "qid": rng.randint(0, 2, (6, 1)).astype(np.int64),
+        }
+        vals = exe.run(main, feed=feeds,
+                       fetch_list=[miou, pooled, cropped, cs, mh, pos,
+                                   neg, neu, sf])
+    assert vals[1].shape == (1, 2, 2, 2)
+    assert vals[2].shape == (2, 8, 4, 4)
+    assert vals[8].shape == (2, 8, 6, 6)
