@@ -135,8 +135,11 @@ class Trainer:
         path = os.path.join(root, f"ckpt_{serial}")
         os.makedirs(path, exist_ok=True)
         with scope_guard(self.scope):
-            fluid_io.save_persistables(self.exe, path,
-                                       main_program=self.train_program)
+            # sharded writer: each process persists only its own array
+            # shards (io.py save_sharded) — scales to mp/fsdp state that
+            # must never gather to one host
+            fluid_io.save_sharded(self.exe, path,
+                                  main_program=self.train_program)
         with open(os.path.join(path, "__trainer_state__.json"), "w") as f:
             json.dump({"epoch": epoch, "step": step, "serial": serial}, f)
         # rotate (reference keeps max_num_checkpoints, deleting oldest)
@@ -151,8 +154,20 @@ class Trainer:
             return
         path = os.path.join(self._ckpt_root(), f"ckpt_{ids[-1]}")
         with scope_guard(self.scope):
-            fluid_io.load_persistables(self.exe, path,
-                                       main_program=self.train_program)
+            if os.path.exists(os.path.join(path,
+                                           fluid_io.SHARD_MANIFEST)):
+                # load each var straight into its target sharding when
+                # the program was compiled over a mesh (no host gather)
+                wrapper = getattr(self.train_program,
+                                  "_compiled_wrapper", None)
+                mesh = wrapper._mesh if wrapper is not None else None
+                fluid_io.load_sharded(self.exe, path,
+                                      main_program=self.train_program,
+                                      mesh=mesh)
+            else:
+                # checkpoint from the pre-sharded combined format
+                fluid_io.load_persistables(self.exe, path,
+                                           main_program=self.train_program)
         with open(os.path.join(path, "__trainer_state__.json")) as f:
             st = json.load(f)
         self._resume_epoch = int(st.get("epoch", 0))

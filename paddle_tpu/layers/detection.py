@@ -306,3 +306,123 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
                "min_size": float(min_size),
                "eta": float(eta)})
     return rois, rois_num
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      gt_num=None, name=None):
+    """RPN training-target assignment (reference layers/detection.py
+    rpn_target_assign:54): samples fg/bg anchors, gathers the matching
+    predictions, and returns
+    (predicted_scores, predicted_location, target_label, target_bbox,
+    bbox_inside_weight, score_weight).
+
+    bbox_pred (N, A, 4), cls_logits (N, A, 1); gt_boxes (N, G, 4)
+    zero-padded with `gt_num` valid counts (static-shape analog of the
+    reference's LoD gt input); the extra score_weight return (absent in
+    the reference, which emitted variable-length rows) masks padded
+    sample slots and anchor_var is accepted for API parity."""
+    from . import nn as nn_layers
+
+    helper = LayerHelper("rpn_target_assign", name=name)
+    loc_index = helper.create_variable_for_type_inference("int32")
+    tgt_bbox = helper.create_variable_for_type_inference(bbox_pred.dtype)
+    in_w = helper.create_variable_for_type_inference(bbox_pred.dtype)
+    score_index = helper.create_variable_for_type_inference("int32")
+    tgt_lbl = helper.create_variable_for_type_inference("int32")
+    score_w = helper.create_variable_for_type_inference("float32")
+    fg_num = helper.create_variable_for_type_inference("int32")
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if gt_num is not None:
+        ins["GtNum"] = [gt_num]
+    helper.append_op(
+        type="rpn_target_assign", inputs=ins,
+        outputs={"LocationIndex": [loc_index], "TargetBBox": [tgt_bbox],
+                 "BBoxInsideWeight": [in_w], "ScoreIndex": [score_index],
+                 "TargetLabel": [tgt_lbl], "ScoreWeight": [score_w],
+                 "ForegroundNumber": [fg_num]},
+        attrs={"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+               "rpn_straddle_thresh": float(rpn_straddle_thresh),
+               "rpn_fg_fraction": float(rpn_fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap),
+               "use_random": bool(use_random)})
+    # gather predictions at the sampled anchor slots (reference gathers
+    # on the flattened pred tensors)
+    pred_loc = nn_layers.batched_gather(bbox_pred, loc_index)
+    pred_score = nn_layers.batched_gather(cls_logits, score_index)
+    return (pred_score, pred_loc, tgt_lbl, tgt_bbox, in_w, score_w)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             rpn_rois_num=None, gt_num=None, name=None):
+    """Fast-RCNN head sampling (reference layers/detection.py
+    generate_proposal_labels:1648).  Returns (rois, labels_int32,
+    bbox_targets, bbox_inside_weights, bbox_outside_weights, rois_num);
+    all (N, B, ...) fixed-slot tensors with rois_num active counts."""
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgts = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    in_w = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    out_w = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    rois_num = helper.create_variable_for_type_inference("int32")
+    ins = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+           "GtBoxes": [gt_boxes], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if rpn_rois_num is not None:
+        ins["RpnRoisNum"] = [rpn_rois_num]
+    if gt_num is not None:
+        ins["GtNum"] = [gt_num]
+    helper.append_op(
+        type="generate_proposal_labels", inputs=ins,
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [tgts], "BboxInsideWeights": [in_w],
+                 "BboxOutsideWeights": [out_w], "RoisNum": [rois_num]},
+        attrs={"batch_size_per_im": int(batch_size_per_im),
+               "fg_fraction": float(fg_fraction),
+               "fg_thresh": float(fg_thresh),
+               "bg_thresh_hi": float(bg_thresh_hi),
+               "bg_thresh_lo": float(bg_thresh_lo),
+               "bbox_reg_weights": [float(v) for v in bbox_reg_weights],
+               "class_nums": int(class_nums or 81),
+               "use_random": bool(use_random)})
+    return rois, labels, tgts, in_w, out_w, rois_num
+
+
+def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative",
+                       name=None):
+    """Hard-negative mining (reference detection/
+    mine_hard_examples_op.cc).  Returns (neg_indices (N, P) padded -1,
+    neg_mask (N, P), updated_match_indices)."""
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg_idx = helper.create_variable_for_type_inference("int32")
+    neg_mask = helper.create_variable_for_type_inference("float32")
+    updated = helper.create_variable_for_type_inference("int32")
+    ins = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+           "MatchDist": [match_dist]}
+    if loc_loss is not None:
+        ins["LocLoss"] = [loc_loss]
+    helper.append_op(
+        type="mine_hard_examples", inputs=ins,
+        outputs={"NegIndices": [neg_idx], "NegMask": [neg_mask],
+                 "UpdatedMatchIndices": [updated]},
+        attrs={"neg_pos_ratio": float(neg_pos_ratio),
+               "neg_dist_threshold": float(neg_dist_threshold),
+               "sample_size": int(sample_size),
+               "mining_type": mining_type})
+    return neg_idx, neg_mask, updated

@@ -683,6 +683,17 @@ def gather(input, index):
     return out
 
 
+def batched_gather(input, index, name=None):
+    """Per-row gather: input (N, A, ...) gathered at index (N, S) →
+    (N, S, ...) (used by rpn_target_assign; see ops/basic.py)."""
+    helper = LayerHelper("batched_gather", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="batched_gather",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
 def scatter(input, index, updates, overwrite=True, name=None):
     helper = LayerHelper("scatter", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)

@@ -406,6 +406,20 @@ def gather(ctx, ins, attrs):
     return out(Out=jnp.take(x, index.reshape(-1), axis=0))
 
 
+@register_op("batched_gather")
+def batched_gather(ctx, ins, attrs):
+    """Per-row gather (batch_dims=1): X (N, A, ...) + Index (N, S) →
+    (N, S, ...).  TPU-native helper for the fixed-slot detection
+    sampling ops (rpn_target_assign gathers predictions at sampled
+    anchor slots); no direct fluid analog — the reference gathered on
+    flattened LoD rows instead."""
+    x, index = first(ins, "X"), first(ins, "Index")
+    idx = index.astype(jnp.int32)
+    idx_exp = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return out(Out=jnp.take_along_axis(
+        x, jnp.broadcast_to(idx_exp, idx.shape + x.shape[2:]), axis=1))
+
+
 @register_op("scatter")
 def scatter(ctx, ins, attrs):
     x, ids, updates = first(ins, "X"), first(ins, "Ids"), first(ins, "Updates")

@@ -670,3 +670,375 @@ def generate_proposals(ctx, ins, attrs):
 
     rois, counts = jax.vmap(per_image)(sc, dl, im_info)
     return out(RpnRois=rois, RpnRoisNum=counts)
+
+
+# ---------------------------------------------------------------------------
+# RPN training targets + proposal labels + hard-example mining
+# (reference: detection/rpn_target_assign_op.cc,
+#  detection/generate_proposal_labels_op.cc,
+#  detection/mine_hard_examples_op.cc).
+#
+# Static-shape contract (XLA): the reference emits variable-length index
+# lists (LoD); here every per-image sample budget is a FIXED slot count,
+# selected candidates are compacted to the front via a stable argsort on
+# (category, priority) keys, and a weight/count output marks the active
+# slots.  Sampling uses uniform-random priorities from the program RNG
+# instead of the reference's reservoir walk — the same "uniform random
+# subset of candidates" distribution, expressible with static shapes.
+# ---------------------------------------------------------------------------
+
+def _pixel_iou(a, b):
+    """(A, 4) x (G, 4) pixel-coordinate IoU with the reference's +1
+    convention (bbox_util.h BboxOverlaps)."""
+    area_a = (a[:, 2] - a[:, 0] + 1.0) * (a[:, 3] - a[:, 1] + 1.0)
+    area_b = (b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0)
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(x2 - x1 + 1.0, 0.0)
+    ih = jnp.maximum(y2 - y1 + 1.0, 0.0)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+def _box_to_delta(ex, gt, weights=None):
+    """bbox_util.h BoxToDelta with normalized=false (+1 widths)."""
+    ex_w = ex[:, 2] - ex[:, 0] + 1.0
+    ex_h = ex[:, 3] - ex[:, 1] + 1.0
+    ex_cx = ex[:, 0] + 0.5 * ex_w
+    ex_cy = ex[:, 1] + 0.5 * ex_h
+    gt_w = gt[:, 2] - gt[:, 0] + 1.0
+    gt_h = gt[:, 3] - gt[:, 1] + 1.0
+    gt_cx = gt[:, 0] + 0.5 * gt_w
+    gt_cy = gt[:, 1] + 0.5 * gt_h
+    d = jnp.stack([
+        (gt_cx - ex_cx) / ex_w,
+        (gt_cy - ex_cy) / ex_h,
+        jnp.log(jnp.maximum(gt_w, 1e-6) / jnp.maximum(ex_w, 1e-6)),
+        jnp.log(jnp.maximum(gt_h, 1e-6) / jnp.maximum(ex_h, 1e-6)),
+    ], axis=1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, d.dtype)[None, :]
+    return d
+
+
+def _sample_budget(cand_mask, budget, rng, use_random, priority=None):
+    """Pick up to `budget` (traced or static) candidates from a boolean
+    mask with static shapes: rank candidates by priority (uniform random
+    when use_random, else ascending index like the reference's
+    non-random path) and keep rank < min(budget, count).  Returns
+    (selected_mask, count)."""
+    n = cand_mask.shape[0]
+    if priority is None:
+        priority = (jax.random.uniform(rng, (n,)) if use_random
+                    else -jnp.arange(n, dtype=jnp.float32))
+    score = jnp.where(cand_mask, priority, -jnp.inf)
+    order = jnp.argsort(-score)           # best first
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    count = jnp.minimum(jnp.sum(cand_mask.astype(jnp.int32)),
+                        jnp.asarray(budget, jnp.int32))
+    sel = cand_mask & (rank < count)
+    return sel, count
+
+
+def _compact(masks_and_payloads, total):
+    """Stable-compact rows selected by category masks to the front.
+
+    masks_and_payloads: list of (mask (K,), category_rank) — rows with
+    lower category_rank come first; within a category, index order.
+    Returns `order` (total,) row indices (garbage past the active
+    count) and the active count."""
+    k = masks_and_payloads[0][0].shape[0]
+    key = jnp.full((k,), 1e9, jnp.float32)
+    for mask, cat in masks_and_payloads:
+        key = jnp.where(mask, cat * float(k) + jnp.arange(k, dtype=jnp.float32),
+                        key)
+    order = jnp.argsort(key)
+    if k < total:      # pool smaller than the slot budget: pad rows
+        order = jnp.pad(order, (0, total - k))
+    order = order[:total]
+    count = jnp.sum(jnp.asarray(
+        [jnp.sum(m.astype(jnp.int32)) for m, _ in masks_and_payloads]))
+    return order, count.astype(jnp.int32)
+
+
+@register_op("rpn_target_assign")
+def rpn_target_assign(ctx, ins, attrs):
+    """RPN anchor classification/regression targets (reference
+    detection/rpn_target_assign_op.cc).  Faster-RCNN rules: positives
+    are (i) per-gt max-overlap anchors and (ii) anchors with IoU >=
+    rpn_positive_overlap; negatives have max IoU < rpn_negative_overlap;
+    budgets rpn_fg_fraction * rpn_batch_size_per_im fg, remainder bg.
+
+    inputs: Anchor (A, 4); GtBoxes (N, G, 4) zero-padded; GtNum (N,)
+    valid counts (optional, default G); IsCrowd (N, G) optional;
+    ImInfo (N, 3).
+    outputs (fixed slots, F = fg budget, S = rpn_batch_size_per_im):
+      LocationIndex (N, F) anchor ids, fg compacted first;
+      TargetBBox (N, F, 4); BBoxInsideWeight (N, F, 4);
+      ScoreIndex (N, S) anchor ids (fg then bg); TargetLabel (N, S);
+      ScoreWeight (N, S) 1.0 on active slots (divergence: replaces the
+      reference's variable-length LoD outputs);
+      ForegroundNumber (N,) fg counts.
+
+    Divergences (documented): uniform-random sampling replaces the
+    reservoir walk; the reference's Detectron-compat bg-overwrites-fg
+    quirk (rpn_target_assign_op.cc:219 'it seems here is a bug') is NOT
+    replicated — selected fg anchors are excluded from bg candidates."""
+    anchor = first(ins, "Anchor").astype(jnp.float32)
+    gt_boxes = first(ins, "GtBoxes").astype(jnp.float32)
+    gt_num = opt_in(ins, "GtNum")
+    is_crowd = opt_in(ins, "IsCrowd")
+    im_info = first(ins, "ImInfo").astype(jnp.float32)
+
+    s_total = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_ov = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(attrs.get("rpn_negative_overlap", 0.3))
+    use_random = bool(attrs.get("use_random", True))
+    f_total = int(fg_frac * s_total)
+
+    n, g = gt_boxes.shape[0], gt_boxes.shape[1]
+    a = anchor.shape[0]
+    if gt_num is None:
+        gt_num = jnp.full((n,), g, jnp.int32)
+    if is_crowd is None:
+        is_crowd = jnp.zeros((n, g), jnp.int32)
+    rngs = jax.random.split(ctx.rng(), n * 2).reshape(n, 2, 2)
+
+    def per_image(gts, gnum, crowd, info, rng2):
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        if straddle >= 0:
+            inside = ((anchor[:, 0] >= -straddle) &
+                      (anchor[:, 1] >= -straddle) &
+                      (anchor[:, 2] < im_w + straddle) &
+                      (anchor[:, 3] < im_h + straddle))
+        else:
+            inside = jnp.ones((a,), jnp.bool_)
+        gt_valid = (jnp.arange(g) < gnum) & (crowd == 0)
+        gts_sc = gts * im_scale
+        iou = _pixel_iou(anchor, gts_sc)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        iou = jnp.where(inside[:, None], iou, -1.0)
+
+        a2g_max = jnp.max(iou, axis=1) if g else jnp.zeros((a,))
+        a2g_arg = jnp.argmax(iou, axis=1) if g else jnp.zeros((a,), jnp.int32)
+        g2a_max = jnp.max(iou, axis=0)
+
+        is_gt_best = jnp.any(
+            gt_valid[None, :] & (g2a_max[None, :] > 0) &
+            (jnp.abs(iou - g2a_max[None, :]) < 1e-5), axis=1)
+        fg_cand = inside & (is_gt_best | (a2g_max >= pos_ov))
+        fg_sel, fg_cnt = _sample_budget(fg_cand, f_total, rng2[0],
+                                        use_random)
+        bg_cand = inside & (a2g_max < neg_ov) & ~fg_sel
+        bg_sel, bg_cnt = _sample_budget(bg_cand, s_total - fg_cnt,
+                                        rng2[1], use_random)
+
+        loc_order, _ = _compact([(fg_sel, 0.0)], f_total)
+        fg_active = jnp.arange(f_total) < fg_cnt
+        tgt_gt = gts_sc[a2g_arg[loc_order]]
+        tgt_bbox = _box_to_delta(anchor[loc_order], tgt_gt)
+        tgt_bbox = jnp.where(fg_active[:, None], tgt_bbox, 0.0)
+        inside_w = jnp.where(fg_active[:, None],
+                             jnp.ones((f_total, 4)), 0.0)
+
+        score_order, score_cnt = _compact([(fg_sel, 0.0), (bg_sel, 1.0)],
+                                          s_total)
+        score_active = jnp.arange(s_total) < score_cnt
+        labels = jnp.where(jnp.arange(s_total) < fg_cnt, 1, 0)
+        return (jnp.where(fg_active, loc_order, 0).astype(jnp.int32),
+                tgt_bbox, inside_w,
+                jnp.where(score_active, score_order, 0).astype(jnp.int32),
+                jnp.where(score_active, labels, 0).astype(jnp.int32),
+                score_active.astype(jnp.float32),
+                fg_cnt)
+
+    (loc_idx, tgt_bbox, in_w, score_idx, labels, score_w,
+     fg_counts) = jax.vmap(per_image)(gt_boxes, gt_num, is_crowd, im_info,
+                                      rngs)
+    return {"LocationIndex": [loc_idx], "TargetBBox": [tgt_bbox],
+            "BBoxInsideWeight": [in_w], "ScoreIndex": [score_idx],
+            "TargetLabel": [labels], "ScoreWeight": [score_w],
+            "ForegroundNumber": [fg_counts]}
+
+
+@register_op("generate_proposal_labels")
+def generate_proposal_labels(ctx, ins, attrs):
+    """Fast-RCNN head sampling: proposals + gts → sampled rois with
+    class labels and per-class regression targets (reference
+    detection/generate_proposal_labels_op.cc).
+
+    inputs: RpnRois (N, R, 4) + RpnRoisNum (N,) (generate_proposals
+    contract), GtClasses (N, G), IsCrowd (N, G), GtBoxes (N, G, 4),
+    GtNum (N,), ImInfo (N, 3).
+    outputs (B = batch_size_per_im slots, fg compacted first):
+      Rois (N, B, 4) image-scale rois; LabelsInt32 (N, B) (bg 0, padded
+      slots -1); BboxTargets (N, B, 4C); BboxInsideWeights /
+      BboxOutsideWeights (N, B, 4C); RoisNum (N,) active counts."""
+    rois_in = first(ins, "RpnRois").astype(jnp.float32)
+    rois_num = opt_in(ins, "RpnRoisNum")
+    gt_classes = first(ins, "GtClasses")
+    is_crowd = opt_in(ins, "IsCrowd")
+    gt_boxes = first(ins, "GtBoxes").astype(jnp.float32)
+    gt_num = opt_in(ins, "GtNum")
+    im_info = first(ins, "ImInfo").astype(jnp.float32)
+
+    b_total = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    reg_w = [float(v) for v in attrs.get("bbox_reg_weights",
+                                         [0.1, 0.1, 0.2, 0.2])]
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    f_total = int(b_total * fg_frac)
+
+    n, r = rois_in.shape[0], rois_in.shape[1]
+    g = gt_boxes.shape[1]
+    k = g + r
+    if rois_num is None:
+        rois_num = jnp.full((n,), r, jnp.int32)
+    if gt_num is None:
+        gt_num = jnp.full((n,), g, jnp.int32)
+    if is_crowd is None:
+        is_crowd = jnp.zeros((n, g), jnp.int32)
+    rngs = jax.random.split(ctx.rng(), n * 2).reshape(n, 2, 2)
+
+    def per_image(props, pnum, gcls, crowd, gts, gnum, info, rng2):
+        im_scale = jnp.maximum(info[2], 1e-6)
+        props = props / im_scale
+        # candidate pool: gts first (reference Concat(gt, rois)), crowd
+        # gts kept as rows but disqualified below
+        boxes = jnp.concatenate([gts, props], axis=0)       # (K, 4)
+        gt_valid_col = jnp.arange(g) < gnum
+        row_valid = jnp.concatenate(
+            [gt_valid_col,
+             jnp.arange(r) < pnum])
+        iou = _pixel_iou(boxes, gts)
+        iou = jnp.where(gt_valid_col[None, :], iou, -1.0)
+        # crowd gts stay as COLUMNS like the reference (it computes
+        # BboxOverlaps(boxes, raw_gt) with no crowd column filter,
+        # generate_proposal_labels_op.cc:246-250 — only crowd ROWS are
+        # disqualified, :126-128); real IoUs are >= 0, so a -1 max means
+        # "no valid gt at all" → every proposal is background (the
+        # annotation-free-image case), not "no sample"
+        max_ov = jnp.max(iou, axis=1)
+        gt_arg = jnp.argmax(iou, axis=1)
+        max_ov = jnp.maximum(max_ov, 0.0)
+        is_crowd_row = jnp.concatenate(
+            [(crowd != 0) & gt_valid_col, jnp.zeros((r,), jnp.bool_)])
+        max_ov = jnp.where(is_crowd_row, -1.0, max_ov)
+        max_ov = jnp.where(row_valid, max_ov, -2.0)
+
+        fg_cand = max_ov > fg_thresh
+        fg_sel, fg_cnt = _sample_budget(fg_cand, f_total, rng2[0],
+                                        use_random)
+        bg_cand = (max_ov >= bg_lo) & (max_ov < bg_hi)
+        bg_sel, bg_cnt = _sample_budget(bg_cand, b_total - fg_cnt,
+                                        rng2[1], use_random)
+
+        order, count = _compact([(fg_sel, 0.0), (bg_sel, 1.0)], b_total)
+        slot = jnp.arange(b_total)
+        active = slot < count
+        is_fg = slot < fg_cnt
+        sampled = boxes[order]                              # (B, 4)
+        gt_for = gts[gt_arg[order]]
+        labels = jnp.where(
+            is_fg, gt_classes_row(gcls, gt_arg[order]),
+            jnp.where(active, 0, -1))
+        deltas = _box_to_delta(sampled, gt_for, reg_w)
+        deltas = jnp.where(is_fg[:, None], deltas, 0.0)
+        # expand to per-class slots: row i writes its 4 targets at
+        # columns 4*label .. 4*label+3 (fg only)
+        cls_ids = jnp.clip(labels, 0, class_nums - 1)
+        col = jax.nn.one_hot(cls_ids, class_nums,
+                             dtype=jnp.float32)             # (B, C)
+        expanded = (col[:, :, None] * deltas[:, None, :]).reshape(
+            b_total, 4 * class_nums)
+        w = jnp.where(is_fg[:, None], 1.0,
+                      jnp.zeros((b_total, 1))) * col[:, :, None].reshape(
+            b_total, class_nums, 1).repeat(4, axis=2).reshape(
+            b_total, 4 * class_nums)
+        rois_out = jnp.where(active[:, None], sampled * im_scale, 0.0)
+        return (rois_out, labels.astype(jnp.int32),
+                jnp.where(is_fg[:, None], expanded, 0.0),
+                w, w, count)
+
+    def gt_classes_row(gcls, idx):
+        return gcls[idx].astype(jnp.int32)
+
+    (rois, labels, tgts, in_w, out_w, counts) = jax.vmap(per_image)(
+        rois_in, rois_num, gt_classes, is_crowd, gt_boxes, gt_num,
+        im_info, rngs)
+    return {"Rois": [rois], "LabelsInt32": [labels],
+            "BboxTargets": [tgts], "BboxInsideWeights": [in_w],
+            "BboxOutsideWeights": [out_w], "RoisNum": [counts]}
+
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(ctx, ins, attrs):
+    """Hard-negative mining for SSD-style training (reference
+    detection/mine_hard_examples_op.cc): per image, select the
+    highest-loss eligible negatives — min(neg_pos_ratio * positives,
+    eligible) for max_negative, min(sample_size, eligible) for
+    hard_example.
+
+    Static contract: NegIndices (N, P) ascending indices padded with
+    -1, plus NegMask (N, P) 0/1 (divergence: replaces the LoD list) and
+    UpdatedMatchIndices (N, P)."""
+    cls_loss = first(ins, "ClsLoss").astype(jnp.float32)
+    loc_loss = opt_in(ins, "LocLoss")
+    match_idx = first(ins, "MatchIndices")
+    match_dist = first(ins, "MatchDist").astype(jnp.float32)
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    sample_size = int(attrs.get("sample_size", 0))
+    mtype = attrs.get("mining_type", "max_negative")
+    if mtype not in ("max_negative", "hard_example"):
+        raise ValueError(f"unknown mining_type {mtype!r}")
+
+    n, p = cls_loss.shape
+    loss = cls_loss
+    if mtype == "hard_example" and loc_loss is not None:
+        loss = cls_loss + loc_loss.astype(jnp.float32)
+
+    if mtype == "max_negative":
+        eligible = (match_idx == -1) & (match_dist < thresh)
+        num_pos = jnp.sum((match_idx != -1).astype(jnp.int32), axis=1)
+        budget = (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
+    else:
+        eligible = jnp.ones((n, p), jnp.bool_)
+        budget = jnp.full((n,), sample_size, jnp.int32)
+
+    masked = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)
+    rank = jnp.zeros((n, p), jnp.int32)
+    rank = jax.vmap(
+        lambda rk, o: rk.at[o].set(jnp.arange(p, dtype=jnp.int32)))(
+        rank, order)
+    count = jnp.minimum(jnp.sum(eligible.astype(jnp.int32), axis=1),
+                        budget)
+    selected = eligible & (rank < count[:, None])
+
+    if mtype == "hard_example":
+        neg_sel = selected & (match_idx == -1)
+        updated = jnp.where((match_idx > -1) & ~selected, -1, match_idx)
+    else:
+        neg_sel = selected
+        updated = match_idx
+
+    # ascending compaction, pad -1 (reference emits a std::set per image)
+    key = jnp.where(neg_sel, jnp.arange(p, dtype=jnp.float32)[None, :],
+                    jnp.inf)
+    neg_order = jnp.argsort(key, axis=1)
+    neg_count = jnp.sum(neg_sel.astype(jnp.int32), axis=1)
+    neg_idx = jnp.where(jnp.arange(p)[None, :] < neg_count[:, None],
+                        neg_order, -1).astype(jnp.int32)
+    return {"NegIndices": [neg_idx],
+            "NegMask": [neg_sel.astype(jnp.float32)],
+            "UpdatedMatchIndices": [updated]}

@@ -1,0 +1,172 @@
+"""Sharded checkpoint save/load (VERDICT round-2 item 5).
+
+Contract: save writes per-process shard files keyed by each shard's
+global index (no one-host gather of the full state); load reassembles
+directly into the target NamedShardings; training resumed from a
+sharded checkpoint matches uninterrupted training exactly.
+
+reference analog: per-pserver parameter slices,
+transpiler/distribute_transpiler.py:894 (_get_slice_vars_and_attrs).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.parallel import ShardingRules, make_mesh
+
+
+def _build(seed=11):
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=32, act="relu", name="ffn_in")
+    logits = layers.fc(h, size=8, name="ffn_out")
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                      momentum=0.9).minimize(loss)
+    return loss
+
+
+def _rules():
+    # Megatron pairing over mp: column-parallel in, row-parallel out
+    return ShardingRules(rules=[
+        (r"ffn_in\S*\.w", (None, "mp")),
+        (r"ffn_out\S*\.w", ("mp", None)),
+    ])
+
+
+def _compiled(main, loss, mesh):
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = _rules()
+    return fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs, mesh=mesh)
+
+
+def _batches(n, seed=5):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(32, 16).astype(np.float32),
+             rng.randint(0, 8, (32, 1)).astype(np.int64))
+            for _ in range(n)]
+
+
+def test_sharded_resume_parity(tmp_path):
+    """Train 2 steps → save_sharded → fresh program/scope on a fresh
+    mesh → load_sharded → 2 more steps == 4 uninterrupted steps."""
+    mesh = make_mesh({"dp": 2, "mp": 4})
+    batches = _batches(4)
+
+    # uninterrupted run
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    ref_losses = []
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = _build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        prog = _compiled(main, loss, mesh)
+        for xv, yv in batches:
+            (lv,) = exe.run(prog, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])
+            ref_losses.append(float(np.asarray(lv).reshape(-1)[0]))
+
+    ckpt = str(tmp_path / "ckpt")
+    # interrupted run part 1
+    main1, startup1 = fluid.Program(), fluid.Program()
+    main1.random_seed = 3
+    scope1 = fluid.Scope()
+    with fluid.program_guard(main1, startup1), fluid.scope_guard(scope1), \
+            fluid.unique_name.guard():
+        loss1 = _build()
+        exe = fluid.Executor()
+        exe.run(startup1)
+        prog1 = _compiled(main1, loss1, mesh)
+        for xv, yv in batches[:2]:
+            exe.run(prog1, feed={"x": xv, "y": yv}, fetch_list=[loss1])
+        fluid.io.save_sharded(exe, ckpt, main_program=main1)
+
+    # the manifest records true per-shard indices for the mp-sharded fc
+    with open(os.path.join(ckpt, "__shards__.json")) as f:
+        manifest = json.load(f)
+    w_in = next(n for n in manifest["vars"] if "ffn_in" in n
+                and ".w" in n)
+    assert len(manifest["vars"][w_in]["shards"]) == 4  # mp=4 slices
+    # and no shard holds the full (16, 32) array
+    for sh in manifest["vars"][w_in]["shards"]:
+        (a0, b0), (a1, b1) = sh["index"]
+        assert (b0 - a0) * (b1 - a1) < 16 * 32
+
+    # interrupted run part 2: fresh everything, load INTO shardings
+    mesh2 = make_mesh({"dp": 2, "mp": 4})
+    main2, startup2 = fluid.Program(), fluid.Program()
+    main2.random_seed = 3
+    scope2 = fluid.Scope()
+    res_losses = []
+    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2), \
+            fluid.unique_name.guard():
+        loss2 = _build()
+        exe = fluid.Executor()
+        exe.run(startup2)  # init then overwrite: exercises set_var path
+        prog2 = _compiled(main2, loss2, mesh2)
+        fluid.io.load_sharded(exe, ckpt, main_program=main2, mesh=mesh2,
+                              sharding_rules=_rules())
+        # loaded arrays are actually sharded, not replicated
+        val = fluid.global_scope().find_var(w_in)
+        assert val.sharding.num_devices_sharded > 1 if hasattr(
+            val.sharding, "num_devices_sharded") else True
+        shard_shapes = {s.data.shape for s in val.addressable_shards}
+        assert (16, 8) in shard_shapes  # (16, 32) split 4-way on dim 1
+        for xv, yv in batches[2:]:
+            (lv,) = exe.run(prog2, feed={"x": xv, "y": yv},
+                            fetch_list=[loss2])
+            res_losses.append(float(np.asarray(lv).reshape(-1)[0]))
+
+    np.testing.assert_allclose(res_losses, ref_losses[2:], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sharded_roundtrip_host_fallback(tmp_path):
+    """Without a mesh, load_sharded assembles host-side and matches the
+    saved values bit-exactly."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    ckpt = str(tmp_path / "ck")
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        _build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        before = {
+            v.name: np.asarray(fluid.global_scope().find_var(v.name))
+            for v in main.list_vars() if v.persistable
+        }
+        fluid.io.save_sharded(exe, ckpt, main_program=main)
+        # clobber, then reload
+        for name, arr in before.items():
+            fluid.global_scope().set_var(name, np.zeros_like(arr))
+        fluid.io.load_sharded(exe, ckpt, main_program=main)
+        for name, arr in before.items():
+            got = np.asarray(fluid.global_scope().find_var(name))
+            np.testing.assert_array_equal(got, arr, err_msg=name)
+
+
+def test_load_sharded_missing_var_raises(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    ckpt = str(tmp_path / "ck")
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        _build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_sharded(exe, ckpt, main_program=main)
+        os.remove(os.path.join(ckpt, "__shards__.json"))
+        with pytest.raises(FileNotFoundError):
+            fluid.io.load_sharded(exe, ckpt, main_program=main)
