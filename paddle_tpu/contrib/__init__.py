@@ -1,5 +1,6 @@
 """High-level contrib APIs (reference: python/paddle/fluid/contrib/)."""
 
+from . import slim  # noqa: F401
 from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401
                       CheckpointConfig, EndEpochEvent, EndStepEvent,
                       Inferencer, Trainer)

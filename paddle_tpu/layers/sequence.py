@@ -33,6 +33,19 @@ def _propagate_seq_len(src: Variable, dst: Variable):
                     outputs={"Out": [new]})
 
 
+def _emit_companion(out_var: Variable, length_var: Variable,
+                    suffix: str = "seq_len"):
+    """Materialize a length companion (`<out>.seq_len` /
+    `<out>.seq_len2`) from an op's Length output."""
+    block = default_main_program().current_block()
+    sl = block.create_var(name=f"{out_var.name}.{suffix}",
+                          shape=length_var.shape, dtype="int32",
+                          stop_gradient=True)
+    block.append_op(type="assign", inputs={"X": [length_var]},
+                    outputs={"Out": [sl]})
+    return sl
+
+
 def _require_level1(x: Variable, api: str):
     """Layer-level rejection for APIs without nested (lod_level=2)
     support — fails loudly at graph-build time instead of running
@@ -175,11 +188,7 @@ def lod_reset(x, y=None, target_lod=None):
     helper.append_op(type="lod_reset", inputs=ins,
                      outputs={"Out": [out], "Length": [length]},
                      attrs={"target_lod": [int(v) for v in target_lod]})
-    block = default_main_program().current_block()
-    sl = block.create_var(name=f"{out.name}.seq_len", shape=length.shape,
-                          dtype="int32", stop_gradient=True)
-    block.append_op(type="assign", inputs={"X": [length]},
-                    outputs={"Out": [sl]})
+    _emit_companion(out, length)
     return out
 
 
@@ -307,11 +316,38 @@ def sequence_softmax(input, use_cudnn=False, name=None):
 
 
 def sequence_expand(x, y, ref_level=-1, name=None):
+    """reference layers/nn.py sequence_expand.  With a NESTED y
+    (lod_level=2: seq_len + seq_len2 companions), each x sequence
+    broadcasts across y's sub-sequence slots and the output is itself
+    nested (reference sequence_expand_op.h ref_level=0 over a 2-level
+    Y lod)."""
     _require_level1(x, "sequence_expand")
     helper = LayerHelper("sequence_expand", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op(type="sequence_expand",
-                     inputs={"X": [x], "Y": [y]},
+    ins = {"X": [x], "Y": [y]}
+    xl = seq_len_var(x)
+    if xl is not None:
+        ins["SeqLen"] = [xl]
+    yl, yl2 = seq_len_var(y), seq_len2_var(y)
+    if yl2 is not None:
+        if yl is not None:
+            ins["YLen"] = [yl]
+        ins["YLen2"] = [yl2]
+        length = helper.create_variable_for_type_inference("int32")
+        outputs = {"Out": [out], "Length": [length]}
+        # a dense (N, D) x expands to a LEVEL-1 output (S1 repeated
+        # items); a sequence x (N, Tx, ...) expands to a nested one
+        x_is_seq = len(x.shape) >= 3
+        if x_is_seq:
+            length2 = helper.create_variable_for_type_inference("int32")
+            outputs["Length2"] = [length2]
+        helper.append_op(type="sequence_expand", inputs=ins,
+                         outputs=outputs)
+        _emit_companion(out, length)
+        if x_is_seq:
+            _emit_companion(out, length2, "seq_len2")
+        return out
+    helper.append_op(type="sequence_expand", inputs=ins,
                      outputs={"Out": [out]})
     _propagate_seq_len(y, out)
     return out
@@ -329,12 +365,43 @@ def sequence_expand_as(x, y, name=None):
 
 
 def sequence_concat(input, name=None):
-    for item in (input if isinstance(input, (list, tuple)) else [input]):
-        _require_level1(item, "sequence_concat")
+    """reference layers/nn.py sequence_concat: out_i = concat of every
+    input's i-th sequence.  Handles ragged level-1 inputs (valid
+    prefixes pack back-to-back) and NESTED (lod_level=2) inputs, where
+    each row's sub-sequence lists concatenate (reference
+    lod_tensor.h:76-104 multi-level append)."""
+    items = list(input) if isinstance(input, (list, tuple)) else [input]
     helper = LayerHelper("sequence_concat", name=name)
-    out = helper.create_variable_for_type_inference(input[0].dtype)
-    helper.append_op(type="sequence_concat", inputs={"X": input},
-                     outputs={"Out": [out]})
+    out = helper.create_variable_for_type_inference(items[0].dtype)
+    nested = [seq_len2_var(i) is not None for i in items]
+    ins = {"X": items}
+    lens = [seq_len_var(i) for i in items]
+    outputs = {"Out": [out]}
+    length = helper.create_variable_for_type_inference("int32")
+    outputs["Length"] = [length]
+    if any(nested):
+        if not all(nested):
+            raise NotImplementedError(
+                "sequence_concat: mixing nested (lod_level=2) and "
+                "flat inputs is not supported — expand the flat input "
+                "first")
+        ins["SeqLen"] = lens
+        ins["SeqLen2"] = [seq_len2_var(i) for i in items]
+        length2 = helper.create_variable_for_type_inference("int32")
+        outputs["Length2"] = [length2]
+    elif all(l is not None for l in lens):
+        ins["SeqLen"] = lens
+    elif any(l is not None for l in lens):
+        raise ValueError(
+            "sequence_concat: every ragged input needs its .seq_len "
+            "companion (mixing ragged and dense inputs is ambiguous)")
+    helper.append_op(type="sequence_concat", inputs=ins, outputs=outputs)
+    if "SeqLen" in ins:
+        # only ragged/nested outputs carry companions — dense-input
+        # concat stays companion-free as before
+        _emit_companion(out, length)
+    if any(nested):
+        _emit_companion(out, length2, "seq_len2")
     return out
 
 
@@ -484,9 +551,5 @@ def sequence_reshape(input, new_dim, name=None):
     helper.append_op(type="sequence_reshape", inputs=ins,
                      outputs={"Out": [out], "OutLen": [out_len]},
                      attrs={"new_dim": int(new_dim)})
-    block = default_main_program().current_block()
-    alias = block.create_var(name=f"{out.name}.seq_len", shape=(input.shape[0],),
-                             dtype="int32", stop_gradient=True)
-    block.append_op(type="assign", inputs={"X": [out_len]},
-                    outputs={"Out": [alias]})
+    _emit_companion(out, out_len)
     return out

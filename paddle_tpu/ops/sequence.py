@@ -105,8 +105,32 @@ def sequence_softmax(ctx, ins, attrs):
 def sequence_expand(ctx, ins, attrs):
     """Expand each row of X to match Y's per-sequence repetition
     (reference sequence_expand_op).  Padded semantics: X (N, D) or
-    (N, 1, D) broadcast along Y's time axis."""
+    (N, 1, D) broadcast along Y's time axis.
+
+    Nested Y (reference sequence_expand_op.h lod level 2, ref_level 0):
+    when YLen2 is passed (Y is a lod_level=2 batch (N, S1, ...)), each
+    X sequence broadcasts across Y's SUB-SEQUENCE slots → nested output
+    (N, S1, Tx, ...) whose outer companion is Y's sub-sequence count
+    and whose inner companion repeats X's lengths."""
     x, y = first(ins, "X"), first(ins, "Y")
+    y_len = opt_in(ins, "YLen")
+    y_len2 = opt_in(ins, "YLen2")
+    x_len = opt_in(ins, "SeqLen")
+    if y_len2 is not None:
+        n = x.shape[0]
+        s1 = y.shape[1]
+        o = jnp.broadcast_to(x[:, None], (n, s1) + x.shape[1:])
+        outer = (y_len.astype(jnp.int32) if y_len is not None
+                 else jnp.full((n,), s1, jnp.int32))
+        if x.ndim == 2:
+            # dense per-row vector (N, D): output is a LEVEL-1 sequence
+            # of S1 repeated items — no inner level exists
+            return {"Out": [o], "Length": [outer]}
+        inner = (x_len.astype(jnp.int32) if x_len is not None
+                 else jnp.full((n,), x.shape[1], jnp.int32))
+        inner2 = jnp.where(jnp.arange(s1)[None, :] < outer[:, None],
+                           inner[:, None], 0)
+        return {"Out": [o], "Length": [outer], "Length2": [inner2]}
     if x.ndim == y.ndim:
         return out(Out=jnp.broadcast_to(x, y.shape[:2] + x.shape[2:]))
     o = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
@@ -151,8 +175,71 @@ def sequence_reverse(ctx, ins, attrs):
 
 @register_op("sequence_concat")
 def sequence_concat(ctx, ins, attrs):
-    # padded semantics: concat along time
-    return out(Out=jnp.concatenate(ins["X"], axis=1))
+    """Concat CORRESPONDING sequences (reference sequence_concat_op:
+    out_i = x1_i ++ x2_i ++ ...), not padded tensors along time.
+
+    Level 1: inputs (N, Tk, ...) with SeqLen list — each output row
+    packs every input's valid prefix back-to-back; Length output is the
+    summed lengths.  Level 2 (nested): inputs (N, S1k, S2, ...) with
+    SeqLen counting sub-sequences — concat along the SUB-SEQUENCE axis
+    (reference lod_tensor.h level-0 append); the inner (S2) axis pads
+    to the max; Length/Length2 carry the merged companions."""
+    xs = ins["X"]
+    lens = ins.get("SeqLen")
+    lens2 = ins.get("SeqLen2")
+    if lens2:
+        # nested: concat sub-sequence lists per row
+        if lens is None or len(lens) != len(xs):
+            raise ValueError("nested sequence_concat needs SeqLen "
+                             "(sub-sequence counts) for every input")
+        n = xs[0].shape[0]
+        s2 = max(x.shape[2] for x in xs)
+        xs_p = [jnp.pad(x, [(0, 0), (0, 0), (0, s2 - x.shape[2])] +
+                        [(0, 0)] * (x.ndim - 3)) for x in xs]
+        total_s1 = sum(x.shape[1] for x in xs)
+        o = _pack_rows(xs_p, [l.astype(jnp.int32) for l in lens],
+                       total_s1)
+        new_len = sum(l.astype(jnp.int32) for l in lens)
+        l2 = _pack_rows([jnp.asarray(l2_, jnp.int32) for l2_ in lens2],
+                        [l.astype(jnp.int32) for l in lens], total_s1)
+        return {"Out": [o], "Length": [new_len], "Length2": [l2]}
+    if lens is None or not lens:
+        # no ragged info: every row is full length, plain time concat
+        return {"Out": [jnp.concatenate(xs, axis=1)],
+                "Length": [jnp.full((xs[0].shape[0],),
+                                    sum(x.shape[1] for x in xs),
+                                    jnp.int32)]}
+    if len(lens) != len(xs):
+        raise ValueError(
+            f"sequence_concat got {len(xs)} inputs but {len(lens)} "
+            f"SeqLen companions")
+    total_t = sum(x.shape[1] for x in xs)
+    lens = [l.astype(jnp.int32) for l in lens]
+    o = _pack_rows(xs, lens, total_t)
+    return {"Out": [o], "Length": [sum(lens)]}
+
+
+def _pack_rows(xs, lens, total_t):
+    """Per row, place each input's valid prefix back-to-back: output
+    position j of row i maps to input k, offset j - starts_k(i) where
+    starts are the running sums of that row's lengths."""
+    n = xs[0].shape[0]
+    starts = [jnp.zeros((n,), jnp.int32)]
+    for l in lens[:-1]:
+        starts.append(starts[-1] + l)
+    pos = jnp.arange(total_t)                      # (T,)
+    o = jnp.zeros((n, total_t) + xs[0].shape[2:], xs[0].dtype)
+    for k, (x, l, st) in enumerate(zip(xs, lens, starts)):
+        # rows of x scatter into [st, st+l)
+        rel = pos[None, :] - st[:, None]           # (N, T)
+        valid = (rel >= 0) & (rel < l[:, None])
+        rel_c = jnp.clip(rel, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, rel_c.reshape((n, total_t) + (1,) * (x.ndim - 2)),
+            axis=1)
+        o = jnp.where(valid.reshape((n, total_t) + (1,) * (x.ndim - 2)),
+                      gathered, o)
+    return o
 
 
 @register_op("sequence_pad")

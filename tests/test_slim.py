@@ -1,0 +1,156 @@
+"""contrib.slim model compression (VERDICT round-2 item 8): magnitude/
+ratio pruning with retraining (sparsity achieved, accuracy bounded) and
+the distillation loss helper.
+
+reference: python/paddle/fluid/contrib/slim — prune/pruner.py,
+prune/prune_strategy.py, core/compress_pass.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import slim
+
+
+def _toy_data(n=256, seed=0):
+    """Linearly-separable-ish 4-class problem on 16 features."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(16, 4) * 2.0
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x @ w + 0.1 * rng.randn(n, 4)).argmax(1)[:, None].astype(np.int64)
+    return x, y
+
+
+def _build_classifier():
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=32, act="relu")
+    logits = layers.fc(h, size=4)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, y))
+    acc = layers.accuracy(layers.softmax(logits), y)
+    return loss, acc, logits
+
+
+def _accuracy(exe, prog, acc, x, y):
+    av, = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[acc])
+    return float(np.asarray(av).reshape(-1)[0])
+
+
+def test_prune_retrain_keeps_accuracy():
+    """Train → prune 60% per-param magnitudes → retrain under the
+    PruneStrategy → sparsity >= 0.55 with accuracy within 5 points of
+    the dense model (the reference slim demo contract)."""
+    x, y = _toy_data()
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss, acc, _ = _build_classifier()
+        fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        def reader():
+            for i in range(8):
+                sl = slice(i * 32, (i + 1) * 32)
+                yield {"x": x[sl], "y": y[sl]}
+
+        # dense pretrain
+        for _ in range(6):
+            for feed in reader():
+                exe.run(main, feed=feed, fetch_list=[loss])
+        dense_acc = _accuracy(exe, main, acc, x, y)
+        assert dense_acc > 0.8, f"dense model underfit: {dense_acc}"
+        assert slim.sparsity(program=main) < 0.1
+
+        # prune + fine-tune via CompressPass
+        strategy = slim.PruneStrategy(
+            slim.RatioPruner(ratio=0.6),
+            mini_batch_pruning_frequency=4, start_epoch=0, end_epoch=6)
+        compress = slim.CompressPass(exe, main, strategies=[strategy])
+        compress.run(reader, epochs=6, fetch_list=[loss])
+
+        sp = slim.sparsity(program=main)
+        pruned_acc = _accuracy(exe, main, acc, x, y)
+    assert sp >= 0.55, f"sparsity {sp} below target"
+    assert pruned_acc >= dense_acc - 0.05, (dense_acc, pruned_acc)
+
+
+def test_magnitude_pruner_threshold_mask():
+    import jax.numpy as jnp
+
+    p = slim.MagnitudePruner(threshold=0.5)
+    v = jnp.asarray([[0.2, -0.7], [0.5, -0.4]])
+    np.testing.assert_array_equal(np.asarray(p.mask(v)),
+                                  [[0, 1], [1, 0]])
+
+
+def test_ratio_pruner_per_param_override():
+    import jax.numpy as jnp
+
+    p = slim.RatioPruner(ratio=0.5, ratios={"keep_all": 0.0})
+    v = jnp.arange(1.0, 9.0).reshape(2, 4)
+    m_half = np.asarray(p.mask(v, "w"))
+    assert m_half.sum() == 4            # half pruned
+    assert np.asarray(p.mask(v, "keep_all")).sum() == 8
+
+
+def test_distillation_loss_zero_at_match_and_trains():
+    x, y = _toy_data(seed=1)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        xin = layers.data(name="x", shape=[16], dtype="float32")
+        yin = layers.data(name="y", shape=[1], dtype="int64")
+        t_logits = layers.data(name="t_logits", shape=[4],
+                               dtype="float32")
+        h = layers.fc(xin, size=16, act="relu")
+        s_logits = layers.fc(h, size=4)
+        hard = layers.mean(
+            layers.softmax_with_cross_entropy(s_logits, yin))
+        total = slim.distillation_loss(s_logits, t_logits,
+                                       temperature=2.0, hard_loss=hard,
+                                       soft_weight=0.5)
+        fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(total)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        # training against a competent teacher reduces the distill loss
+        teacher_w = np.linalg.lstsq(
+            np.concatenate([x, np.ones((x.shape[0], 1), np.float32)], 1),
+            np.eye(4, dtype=np.float32)[y[:, 0]] * 4 - 2, rcond=None)[0]
+        t_all = (np.concatenate([x, np.ones((x.shape[0], 1),
+                                            np.float32)], 1)
+                 @ teacher_w).astype(np.float32)
+        losses = []
+        for _ in range(30):
+            lv, = exe.run(main,
+                          feed={"x": x[:64], "y": y[:64],
+                                "t_logits": t_all[:64]},
+                          fetch_list=[total])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_distillation_kl_zero_when_logits_match():
+    """KL soft term vanishes when student and teacher logits agree
+    (feed-only program so no optimizer step perturbs the probe)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        s = layers.data(name="s", shape=[4], dtype="float32")
+        t = layers.data(name="t", shape=[4], dtype="float32")
+        soft = slim.distillation_loss(s, t, temperature=3.0)
+        exe = fluid.Executor()
+        exe.run(startup)
+        logits = np.random.RandomState(2).randn(8, 4).astype(np.float32)
+        kv, = exe.run(main, feed={"s": logits, "t": logits},
+                      fetch_list=[soft])
+        assert abs(float(np.asarray(kv).reshape(-1)[0])) < 1e-6
+        # and positive for disagreeing logits
+        kv2, = exe.run(main, feed={"s": logits, "t": -logits},
+                       fetch_list=[soft])
+        assert float(np.asarray(kv2).reshape(-1)[0]) > 0.01
