@@ -39,12 +39,27 @@ class AnalysisConfig:
     def __init__(self, model_dir: Optional[str] = None):
         self.model_dir = model_dir
         self.use_serialized_artifact = True
+        self.use_int8 = False
         self._params_file = None
         self._model_file = None
 
     # -- fluid-style setters (parity) -----------------------------------
     def set_model(self, model_dir: str):
         self.model_dir = model_dir
+
+    def enable_int8(self):
+        """Serve with REAL int8 kernels: trained QAT scales freeze into
+        quantized_conv2d/quantized_matmul ops (int8 MXU path) at load
+        time (quantize.py convert_to_int8).  The model must have been
+        exported from a QAT-transpiled program; models without the QAT
+        pattern load unchanged.  Reference analog:
+        enable_tensorrt_engine(precision=Int8) /
+        enable_mkldnn_quantizer() in paddle_analysis_config.h."""
+        self.use_int8 = True
+        # int8 rewrites happen after load; a serialized float artifact
+        # would silently serve fp — disable it for this predictor
+        self.use_serialized_artifact = False
+        return self
 
     def disable_gpu(self):
         pass
@@ -77,9 +92,15 @@ class Predictor:
         from .core.executor import scope_guard
 
         exe = Executor()
+        self.int8_converted: Dict[int, tuple] = {}
         with scope_guard(self._scope):
             self._program, self._feed_names, fetch_vars = \
                 load_inference_model(config.model_dir, exe)
+            if config.use_int8:
+                from .quantize import convert_to_int8
+
+                self.int8_converted = convert_to_int8(self._program,
+                                                      self._scope)
         self._fetch_names = [v.name for v in fetch_vars]
         import jax
 

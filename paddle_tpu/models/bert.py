@@ -49,7 +49,7 @@ def bert_encoder(src_ids, sent_ids, input_mask_bias, vocab_size, max_len,
 def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
                 d_model=768, d_inner=3072, max_predictions=20,
                 learning_rate=1e-4, warmup_steps=10000, dropout=0.1,
-                with_optimizer=True, use_flash=False):
+                with_optimizer=True, use_flash=False, use_amp=False):
     src_ids = layers.data(name="src_ids", shape=[max_len], dtype="int64")
     sent_ids = layers.data(name="sent_ids", shape=[max_len], dtype="int64")
     seq_len = layers.data(name="seq_len", shape=[], dtype="int32")
@@ -97,6 +97,10 @@ def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
             layers.polynomial_decay(learning_rate, 1000000, 0.0, 1.0),
             warmup_steps, 0.0, learning_rate)
         opt = optimizer.AdamOptimizer(learning_rate=lr)
+        if use_amp:
+            from .. import amp as amp_mod
+
+            opt = amp_mod.decorate(opt)
         opt.minimize(loss)
     feeds = ["src_ids", "sent_ids", "seq_len", "mask_pos", "mask_label",
              "mask_weight", "nsp_label"]

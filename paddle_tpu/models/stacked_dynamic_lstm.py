@@ -13,7 +13,8 @@ from .. import layers, optimizer
 
 def build_model(vocab_size=5147, emb_dim=512, hidden_dim=512,
                 stacked_num=3, class_num=2, max_len=128,
-                learning_rate=1e-3, with_optimizer=True):
+                learning_rate=1e-3, with_optimizer=True,
+                use_amp=False):
     data = layers.data(name="words", shape=[max_len], dtype="int64",
                        lod_level=1, append_batch_size=True)
     label = layers.data(name="label", shape=[1], dtype="int64")
@@ -43,6 +44,10 @@ def build_model(vocab_size=5147, emb_dim=512, hidden_dim=512,
     acc = layers.accuracy(input=logit, label=label)
     if with_optimizer:
         opt = optimizer.AdamOptimizer(learning_rate=learning_rate)
+        if use_amp:
+            from .. import amp as amp_mod
+
+            opt = amp_mod.decorate(opt)
         opt.minimize(avg_cost)
     return {"loss": avg_cost, "accuracy": acc,
             "feeds": ["words", "words.seq_len", "label"]}

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
-from .common import first, opt_in, out
+from .common import first, opt_in, out, pair
 
 
 def _qdq(x, scale, bits: int):
@@ -81,3 +81,73 @@ def fake_qdq_moving_average(ctx, ins, attrs):
         scale = jnp.where(in_scale > 0,
                           rate * in_scale + (1 - rate) * cur, cur)
     return out(Out=_qdq(x, scale, bits), OutScale=scale.reshape((1,)))
+
+
+# ---------------------------------------------------------------------------
+# Real int8 execution (serving): quantized conv / matmul
+# ---------------------------------------------------------------------------
+#
+# reference precedent: the fake_quantize family only SIMULATES int8 in
+# float; real int8 execution lived in the inference engines (MKLDNN
+# quantize_mkldnn_op.cc, TensorRT int8 via inference/tensorrt/engine.h).
+# TPU analog: int8 x int8 dot_general/conv with int32 accumulation —
+# XLA lowers it onto the MXU's int8 path — with fixed trained scales
+# from QAT (quantize.py convert_to_int8 rewrites the program).
+
+def _quantize_in(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.clip(jnp.round(x.astype(jnp.float32) / s * qmax),
+                    -qmax, qmax).astype(jnp.int8)
+
+
+@register_op("quantized_conv2d")
+def quantized_conv2d(ctx, ins, attrs):
+    """int8 conv: activation quantized on the trained fixed scale,
+    int8 filter from convert_to_int8, int32 accumulation, float
+    dequantized output (scale_x * scale_w / qmax^2)."""
+    x = first(ins, "Input")
+    w = first(ins, "Filter")          # int8
+    bits = int(attrs.get("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    in_scale = float(attrs["in_scale"])
+    w_scale = float(attrs["weight_scale"])
+    from .nn import _conv_padding
+
+    xq = _quantize_in(x, in_scale, qmax)
+    acc = lax.conv_general_dilated(
+        xq, w.astype(jnp.int8),
+        window_strides=pair(attrs.get("strides", 1)),
+        padding=_conv_padding(attrs.get("paddings", 0), 2),
+        rhs_dilation=pair(attrs.get("dilations", 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+        preferred_element_type=jnp.int32,
+    )
+    o = acc.astype(jnp.float32) * (in_scale * w_scale / (qmax * qmax))
+    return {"Output": [o.astype(x.dtype)]}
+
+
+@register_op("quantized_matmul")
+def quantized_matmul(ctx, ins, attrs):
+    """int8 matmul/mul (X float activation, Y int8 weight) — honors the
+    mul op's x_num_col_dims/y_num_col_dims flattening contract
+    (operators/mul_op.cc) so it can drop in where a fc's mul was."""
+    import numpy as np
+
+    x = first(ins, "X")
+    y = first(ins, "Y")               # int8
+    bits = int(attrs.get("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    in_scale = float(attrs["in_scale"])
+    w_scale = float(attrs["weight_scale"])
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    xq = _quantize_in(x, in_scale, qmax).reshape(
+        (int(np.prod(xs[:xnc])), int(np.prod(xs[xnc:]))))
+    y2 = y.astype(jnp.int8).reshape(
+        (int(np.prod(ys[:ync])), int(np.prod(ys[ync:]))))
+    acc = lax.dot_general(xq, y2, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    o = acc.astype(jnp.float32) * (in_scale * w_scale / (qmax * qmax))
+    return out(Out=o.reshape(xs[:xnc] + ys[ync:]).astype(x.dtype))

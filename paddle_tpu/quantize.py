@@ -139,3 +139,141 @@ class QuantizeTranspiler:
                 outputs={"Out": [qvar.name], "OutScale": [scale_out.name]},
                 attrs={"bit_length": bits})
         return qvar.name, [Operator(block, desc)]
+
+
+# ---------------------------------------------------------------------------
+# Post-training int8 conversion (serving)
+# ---------------------------------------------------------------------------
+
+def convert_to_int8(program: Program, scope=None):
+    """Freeze trained QAT scales into a REALLY-quantized serving program
+    (the reference shipped this capability in its int8 engines — MKLDNN
+    quantize_mkldnn_op.cc, TensorRT int8 via inference/tensorrt/
+    engine.h; the TPU analog is int8 dot_general/conv on the MXU).
+
+    For every quantizable op whose activation and weight both pass
+    through fake-quantize simulation ops:
+    - the weight tensor in `scope` converts to int8 on its trained
+      abs-max grid (the var's dtype flips to int8),
+    - the op rewrites to quantized_conv2d/quantized_matmul with the
+      frozen in/weight scales as attrs,
+    - the now-unconsumed simulation ops are dropped.
+
+    Returns {op_index: (type, in_scale, weight_scale)} for converted
+    ops (empty when the program has no QAT pattern)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from .core.executor import global_scope
+
+    scope = scope or global_scope()
+    block = program.global_block()
+
+    producers = {}
+    for op in block.ops:
+        for names in op.desc.outputs.values():
+            for n in names:
+                producers[n] = op
+
+    _QDQ_TYPES = {"fake_quantize_dequantize_abs_max",
+                  "fake_quantize_dequantize_moving_average_abs_max"}
+
+    def qdq_source_and_scale(name, is_weight):
+        """If `name` is produced by a simulation op, return (source
+        name, frozen scale) else None."""
+        op = producers.get(name)
+        if op is None or op.desc.type not in _QDQ_TYPES:
+            return None
+        src = op.desc.inputs["X"][0]
+        if op.desc.type.endswith("moving_average_abs_max"):
+            state = scope.find_var(op.desc.inputs["InScale"][0])
+            if state is None:
+                return None
+            scale = float(np.asarray(state).reshape(-1)[0])
+            if scale <= 0:
+                return None  # untrained scale state
+        else:
+            val = scope.find_var(src)
+            if val is None:
+                return None
+            scale = float(np.max(np.abs(np.asarray(val))))
+        return src, scale
+
+    converted = {}
+    new_ops = []
+    for idx, op in enumerate(block.ops):
+        t = op.desc.type
+        if t not in QUANTIZABLE_OPS:
+            new_ops.append(op)
+            continue
+        w_slot = _WEIGHT_SLOTS[t]
+        a_slot = "Input" if t in ("conv2d", "depthwise_conv2d") else "X"
+        act = qdq_source_and_scale(op.desc.inputs[a_slot][0], False)
+        wgt = qdq_source_and_scale(op.desc.inputs[w_slot][0], True)
+        if act is None or wgt is None:
+            new_ops.append(op)
+            continue
+        (act_src, in_scale), (w_src, w_scale) = act, wgt
+        attrs = dict(op.desc.attrs)
+        if t == "matmul":
+            # quantized_matmul implements the mul flattening contract;
+            # matmul variants it cannot express stay in float QDQ form
+            wv_shape = tuple(block.var(w_src).shape)
+            if (attrs.get("transpose_X") or attrs.get("transpose_x")
+                    or float(attrs.get("alpha", 1.0) or 1.0) != 1.0
+                    or len(wv_shape) != 2):
+                new_ops.append(op)
+                continue
+            act_rank = len(block.var(act_src).shape)
+            attrs["x_num_col_dims"] = max(act_rank - 1, 1)
+            attrs["y_num_col_dims"] = 1
+        bits = 8
+        qmax = float(2 ** (bits - 1) - 1)
+        wv = jnp.asarray(scope.find_var(w_src), jnp.float32)
+        if t == "matmul" and (attrs.get("transpose_Y")
+                              or attrs.get("transpose_y")):
+            # the weight is static: bake the transpose into the stored
+            # int8 tensor instead of teaching the kernel about it
+            wv = wv.T
+            block.var(w_src).desc.shape = tuple(wv.shape)
+            attrs.pop("transpose_Y", None)
+            attrs.pop("transpose_y", None)
+        wq = jnp.clip(jnp.round(wv / max(w_scale, 1e-8) * qmax),
+                      -qmax, qmax).astype(jnp.int8)
+        scope.set_var(w_src, wq)
+        block.var(w_src).desc.dtype = "int8"
+
+        attrs.update({"in_scale": in_scale, "weight_scale": w_scale,
+                      "bit_length": bits})
+        if t in ("conv2d", "depthwise_conv2d"):
+            if t == "depthwise_conv2d":
+                # the float impl injects groups = C_in at execution
+                # time (ops/nn.py depthwise_conv2d); freeze it here
+                attrs["groups"] = int(block.var(act_src).shape[1])
+            new_type = "quantized_conv2d"
+            inputs = {"Input": [act_src], "Filter": [w_src]}
+            outputs = {"Output": op.desc.outputs["Output"]}
+        else:
+            new_type = "quantized_matmul"
+            inputs = {"X": [act_src], "Y": [w_src]}
+            outputs = {"Out": op.desc.outputs["Out"]}
+        desc = OpDesc(type=new_type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        new_ops.append(Operator(block, desc))
+        converted[idx] = (new_type, in_scale, w_scale)
+
+    # drop simulation ops whose outputs nothing consumes anymore
+    used = set()
+    for op in new_ops:
+        if op.desc.type in _QDQ_TYPES:
+            continue
+        for names in op.desc.inputs.values():
+            used.update(names)
+    block.ops = [
+        op for op in new_ops
+        if op.desc.type not in _QDQ_TYPES
+        or any(n in used for n in op.desc.outputs.get("Out", []))
+    ]
+    program._bump()
+    return converted
