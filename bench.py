@@ -88,7 +88,8 @@ def _mfu_result(step_flops, steps, elapsed, extra):
 
 
 def bench_resnet50(batch_size: int, steps: int, warmup: int,
-                   use_amp: bool = True, data_mode: str = "synthetic"):
+                   use_amp: bool = True, data_mode: str = "synthetic",
+                   data_format: str = "NCHW"):
     """data_mode:
     - "synthetic" (default): FRESH random batch generated on device
       every step (random ops prepended to the program)
@@ -111,7 +112,8 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
     with fluid.program_guard(main, startup), fluid.scope_guard(scope):
         model = resnet.build_model(dataset="flowers", depth=50,
                                    class_dim=1000, learning_rate=0.1,
-                                   use_amp=use_amp)
+                                   use_amp=use_amp,
+                                   data_format=data_format)
         exe = fluid.Executor()
 
         if data_mode == "synthetic":
@@ -177,7 +179,8 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
         float(cost.get("flops", 0.0)), steps, elapsed,
         {"imgs_per_sec": round(imgs_per_sec, 2),
          "batch_size": batch_size, "amp": use_amp,
-         "data_mode": data_mode, "last_loss": last_loss,
+         "data_mode": data_mode, "data_format": data_format,
+         "last_loss": last_loss,
          "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)})
 
 
@@ -201,18 +204,18 @@ def _dense_equiv_flops(feed, build_no_flash):
 
 def bench_transformer(batch_size: int, steps: int, warmup: int,
                       max_length: int = 256, use_amp: bool = True,
-                      use_flash: bool = True):
+                      use_flash: bool = True, use_fused_ce: bool = False):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    def build(flash):
+    def build(flash, fused_ce=use_fused_ce):
         return transformer.build_model(
             src_vocab_size=32000, trg_vocab_size=32000,
             max_length=max_length, n_layer=6, n_head=8, d_model=512,
             d_inner_hid=2048, dropout=0.1, use_flash=flash,
-            use_amp=use_amp)
+            use_amp=use_amp, use_fused_ce=fused_ce)
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -223,9 +226,11 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         feed = {k: jnp.asarray(v) for k, v in
                 transformer.make_fake_batch(batch_size, max_length,
                                             32000, 32000).items()}
-        if use_flash:
-            step_flops = _dense_equiv_flops(feed,
-                                            lambda: build(False))
+        if use_flash or use_fused_ce:
+            # dense-equivalent numerator whenever any Pallas kernel is
+            # active (custom calls report zero flops to XLA)
+            step_flops = _dense_equiv_flops(
+                feed, lambda: build(False, fused_ce=False))
         else:
             cost = exe.cost_analysis(main, feed=feed,
                                      fetch_list=[model["loss"]])
@@ -237,8 +242,9 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         {"tokens_per_sec": round(batch_size * max_length * steps
                                  / elapsed, 1),
          "batch_size": batch_size, "max_length": max_length,
-         "amp": use_amp, "flash": use_flash,
-         "flop_count": "dense-equivalent" if use_flash else "xla",
+         "amp": use_amp, "flash": use_flash, "fused_ce": use_fused_ce,
+         "flop_count": ("dense-equivalent"
+                        if (use_flash or use_fused_ce) else "xla"),
          "last_loss": last_loss})
 
 
@@ -419,6 +425,12 @@ def bench_serving(batch_size: int, iters: int = 50):
                 results["int8"] = pred_q.benchmark(feed, iters=iters,
                                                    warmup=5)
                 results["int8"]["converted_ops"] = len(pred_q.int8_converted)
+            else:
+                # an expected-but-missing int8 path must be VISIBLE in
+                # the report, not silently absent
+                results["int8"] = {
+                    "error": "convert_to_int8 converted no ops (QAT "
+                             "pattern or calibrated scales missing)"}
         except Exception as e:  # noqa: BLE001
             import traceback
 
@@ -459,6 +471,13 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--no-amp", action="store_true")
     p.add_argument("--no-flash", action="store_true")
+    p.add_argument("--layout", default="NCHW",
+                   choices=["NCHW", "NHWC"],
+                   help="resnet50 conv stack layout (NHWC = TPU "
+                        "channels-last)")
+    p.add_argument("--fused-ce", action="store_true",
+                   help="transformer: fused vocab projection+CE Pallas "
+                        "kernel (ops/pallas/vocab_ce.py)")
     p.add_argument("--data", default="synthetic",
                    choices=["synthetic", "frozen", "host"],
                    help="resnet50 input mode: fresh on-device synthetic "
@@ -486,16 +505,19 @@ def main():
 
     if args.model in ("all", "resnet50"):
         _run("resnet50", bench_resnet50, args.batch or 128, args.steps,
-             args.warmup, use_amp=amp, data_mode=args.data)
+             args.warmup, use_amp=amp, data_mode=args.data,
+             data_format=args.layout)
         if args.model == "all" and args.data == "synthetic":
-            # record the frozen-feed ceiling alongside the honest number
+            # record the frozen-feed ceiling alongside the honest
+            # number — same layout, or the "ceiling" is a different
+            # program
             _run("resnet50_frozen", bench_resnet50, args.batch or 128,
                  args.steps, args.warmup, use_amp=amp,
-                 data_mode="frozen")
+                 data_mode="frozen", data_format=args.layout)
     if args.model in ("all", "transformer"):
         _run("transformer", bench_transformer, args.batch or 64,
              args.steps, args.warmup, use_amp=amp,
-             use_flash=not args.no_flash)
+             use_flash=not args.no_flash, use_fused_ce=args.fused_ce)
     if args.model in ("all", "bert"):
         _run("bert", bench_bert, args.batch or 32, args.steps,
              args.warmup, use_amp=amp, use_flash=not args.no_flash)

@@ -266,6 +266,28 @@ class Predictor:
         return (time.perf_counter() - t0) * 1e3 / k
 
 
+    def clone(self) -> "Predictor":
+        """Thread-safe sibling predictor SHARING device-resident weights
+        and compiled executables (reference AnalysisPredictor::Clone,
+        analysis_predictor.cc:56 — per-thread predictors over one
+        parameter scope).  XLA executions are internally thread-safe and
+        parameters are immutable at serving time, so clones share
+        `_params`, `_compiled`, and the program; each clone only carries
+        its own handle.  Typical use: one clone per serving thread."""
+        twin = object.__new__(Predictor)
+        twin.config = self.config
+        twin.int8_converted = self.int8_converted
+        twin._scope = self._scope
+        twin._program = self._program
+        twin._feed_names = self._feed_names
+        twin._fetch_names = self._fetch_names
+        twin._params = self._params          # shared device weights
+        twin._compiled = self._compiled      # shared executable cache
+        twin._exported = self._exported
+        twin._export_sig = self._export_sig
+        return twin
+
+
 def create_paddle_predictor(config: AnalysisConfig) -> Predictor:
     """reference: CreatePaddlePredictor<AnalysisConfig>
     (analysis_predictor.cc:359)."""

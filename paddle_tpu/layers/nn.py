@@ -197,12 +197,14 @@ def clip_by_norm(x, max_norm, name=None):
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
-    """reference layers/nn.py conv2d — NCHW."""
+           act=None, name=None, data_format="NCHW"):
+    """reference layers/nn.py conv2d — NCHW; data_format="NHWC" runs
+    channels-last (TPU-preferred layout; filters stay OIHW)."""
     helper = LayerHelper("conv2d", name=name, act=act, bias_attr=bias_attr)
     dtype = input.dtype
     groups = groups or 1
-    num_channels = input.shape[1]
+    c_axis = 1 if data_format == "NCHW" else 3
+    num_channels = input.shape[c_axis]
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
     filter_shape = [num_filters, num_channels // groups] + list(filter_size)
@@ -215,8 +217,9 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         type="conv2d", inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [pre_bias]},
         attrs={"strides": _pair(stride), "paddings": _pair(padding),
-               "dilations": _pair(dilation), "groups": groups})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1)
+               "dilations": _pair(dilation), "groups": groups,
+               "data_format": data_format})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=c_axis)
     return helper.append_activation(pre_act)
 
 
@@ -267,14 +270,16 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, exclusive=True, name=None):
+           ceil_mode=False, exclusive=True, name=None,
+           data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
         type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
         attrs={"pooling_type": pool_type, "ksize": _pair(pool_size),
                "strides": _pair(pool_stride), "paddings": _pair(pool_padding),
-               "global_pooling": global_pooling, "exclusive": exclusive})
+               "global_pooling": global_pooling, "exclusive": exclusive,
+               "data_format": data_format})
     return out
 
 
@@ -1375,3 +1380,21 @@ def positive_negative_pair(score, label, query_id, weight=None, column=-1,
                               "NeutralPair": [neu]},
                      attrs={"column": int(column)})
     return pos, neg, neu
+
+
+def fused_vocab_softmax_ce(hidden, weight, label, epsilon=0.0,
+                           use_pallas=False, block_t=1024, block_v=2048,
+                           name=None):
+    """Per-token label-smoothed CE of `hidden @ weight` computed WITHOUT
+    materializing the (tokens, vocab) logits (ops/pallas/vocab_ce.py) —
+    the fused big-vocab loss for NMT/LM heads.  hidden (..., D), weight
+    (D, V) parameter, label int ids with hidden's leading shape."""
+    helper = LayerHelper("fused_vocab_softmax_ce", name=name)
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="fused_vocab_softmax_ce",
+        inputs={"Hidden": [hidden], "W": [weight], "Label": [label]},
+        outputs={"Loss": [loss]},
+        attrs={"epsilon": float(epsilon), "use_pallas": bool(use_pallas),
+               "block_t": int(block_t), "block_v": int(block_v)})
+    return loss

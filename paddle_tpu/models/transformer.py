@@ -153,7 +153,7 @@ def _causal_bias(max_len):
 def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
-                use_flash=False):
+                use_flash=False, use_fused_ce=False):
     """Build the full training graph; returns (avg_cost, logits, feeds)."""
     src_word = layers.data(name="src_word", shape=[max_length],
                            dtype="int64")
@@ -188,6 +188,30 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                           use_flash=use_flash)
     dec_out = pre_post_process(None, y, "n")
 
+    if use_fused_ce:
+        # fused projection+CE (ops/pallas/vocab_ce.py): the (tokens,
+        # vocab) logits never hit HBM.  The weight is created directly
+        # so the fused op owns the projection; a logits var is still
+        # produced for the API (decode paths) via the same weight.
+        from ..layer_helper import LayerHelper
+
+        helper = LayerHelper("vocab_proj")
+        proj_w = helper.create_parameter(
+            None, shape=[d_model, trg_vocab_size], dtype="float32")
+        cost_tok = layers.fused_vocab_softmax_ce(
+            dec_out, proj_w, lbl_word, epsilon=label_smooth_eps,
+            use_pallas=True)
+        logits = layers.matmul(dec_out, proj_w)
+        tmask = layers.sequence_mask(trg_len, maxlen=max_length,
+                                     dtype="float32")
+        cost = layers.elementwise_mul(cost_tok, tmask)
+        sum_cost = layers.reduce_sum(cost)
+        token_num = layers.reduce_sum(tmask)
+        avg_cost = layers.elementwise_div(sum_cost, token_num)
+        feeds = ["src_word", "trg_word", "lbl_word", "src_len",
+                 "trg_len"]
+        return avg_cost, logits, feeds
+
     logits = layers.fc(dec_out, size=trg_vocab_size, num_flatten_dims=2,
                        bias_attr=False)
 
@@ -220,11 +244,12 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_model=512, d_inner_hid=2048,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
-                use_amp=False):
+                use_amp=False, use_fused_ce=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
-        dropout, label_smooth_eps, use_flash=use_flash)
+        dropout, label_smooth_eps, use_flash=use_flash,
+        use_fused_ce=use_fused_ce)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

@@ -47,3 +47,31 @@ def flash_attention(ctx, ins, attrs):
     else:
         o = _xla_attention(q, k, v, bias, scale, causal)
     return out(Out=o)
+
+
+@register_op("fused_vocab_softmax_ce")
+def fused_vocab_softmax_ce(ctx, ins, attrs):
+    """Final vocab projection + label-smoothed softmax-CE in one fused
+    op (ops/pallas/vocab_ce.py): Hidden (..., D) @ W (D, V) logits are
+    never materialized in HBM.  With use_pallas unset (or on CPU) runs
+    an XLA chunked-equivalent composition for numerics parity."""
+    hidden = first(ins, "Hidden")
+    w = first(ins, "W")
+    labels = first(ins, "Label")
+    eps = float(attrs.get("epsilon", 0.0))
+    if attrs.get("use_pallas", False):
+        from .pallas.vocab_ce import fused_vocab_ce
+
+        loss = fused_vocab_ce(
+            hidden, w, labels, eps,
+            int(attrs.get("block_t", 1024)),
+            int(attrs.get("block_v", 2048)))
+    else:
+        v = w.shape[1]
+        z = (hidden @ w).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(z, axis=-1)
+        zt = jnp.take_along_axis(
+            z, labels.reshape(labels.shape + (1,)).astype(jnp.int32),
+            axis=-1)[..., 0]
+        loss = lse - (1.0 - eps) * zt - (eps / v) * jnp.sum(z, axis=-1)
+    return out(Loss=loss)

@@ -116,17 +116,26 @@ def _conv_padding(padding, spatial):
 @register_op("conv2d")
 def conv2d(ctx, ins, attrs):
     """reference: operators/conv_op.cc (+cudnn variant).  Input NCHW,
-    Filter OIHW, groups supported (depthwise = groups == C_in)."""
+    Filter OIHW, groups supported (depthwise = groups == C_in).
+
+    data_format="NHWC" runs the conv channels-last (filters stay OIHW
+    in storage; XLA relayouts) — on TPU the lane dimension wants the
+    feature axis minor, so NHWC avoids the relayout transposes XLA
+    otherwise inserts around NCHW convs."""
     x, w = first(ins, "Input"), first(ins, "Filter")
     strides = pair(attrs.get("strides", 1))
     dilations = pair(attrs.get("dilations", 1))
     groups = attrs.get("groups", 1) or 1
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d data_format must be NCHW or NHWC, "
+                         f"got {fmt!r}")
     o = lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=_conv_padding(attrs.get("paddings", 0), 2),
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         feature_group_count=groups,
         # no preferred_element_type: the MXU accumulates bf16 convs in
         # f32 internally, and a widened output dtype breaks the conv
@@ -195,29 +204,39 @@ def conv2d_transpose(ctx, ins, attrs):
 @register_op("pool2d")
 def pool2d(ctx, ins, attrs):
     """reference: operators/pool_op.cc — max/avg, global option,
-    exclusive avg-count semantics."""
+    exclusive avg-count semantics.  data_format NCHW (default) or
+    NHWC (spatial axes (1, 2))."""
     x = first(ins, "X")
     ptype = attrs.get("pooling_type", "max")
+    fmt = attrs.get("data_format", "NCHW")
+    sp = (2, 3) if fmt == "NCHW" else (1, 2)
     if attrs.get("global_pooling", False):
-        o = (jnp.max(x, axis=(2, 3), keepdims=True) if ptype == "max"
-             else jnp.mean(x, axis=(2, 3), keepdims=True))
+        o = (jnp.max(x, axis=sp, keepdims=True) if ptype == "max"
+             else jnp.mean(x, axis=sp, keepdims=True))
         return out(Out=o)
     ksize = pair(attrs["ksize"])
     strides = pair(attrs.get("strides", 1))
     pads = pair(attrs.get("paddings", 0))
-    window = (1, 1) + ksize
-    stride = (1, 1) + strides
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if fmt == "NCHW":
+        window = (1, 1) + ksize
+        stride = (1, 1) + strides
+        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    else:
+        window = (1,) + ksize + (1,)
+        stride = (1,) + strides + (1,)
+        padding = ((0, 0),) + tuple((p, p) for p in pads) + ((0, 0),)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         o = lax.reduce_window(x, init, lax.max, window, stride, padding)
     else:
         s = lax.reduce_window(x, 0.0, lax.add, window, stride, padding)
         if attrs.get("exclusive", True) and any(p > 0 for p in pads):
-            ones = jnp.ones(x.shape[2:], x.dtype)
+            ones = jnp.ones(x.shape[sp[0]:sp[1] + 1], x.dtype)
             cnt = lax.reduce_window(ones, 0.0, lax.add, ksize, strides,
                                     tuple((p, p) for p in pads))
-            o = s / cnt[None, None]
+            cnt = (cnt[None, None] if fmt == "NCHW"
+                   else cnt[None, :, :, None])
+            o = s / cnt
         else:
             o = s / float(ksize[0] * ksize[1])
     return out(Out=o.astype(x.dtype))

@@ -113,13 +113,17 @@ def quantized_conv2d(ctx, ins, attrs):
     w_scale = float(attrs["weight_scale"])
     from .nn import _conv_padding
 
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt not in ("NCHW", "NHWC"):
+        raise ValueError(f"quantized_conv2d data_format must be NCHW "
+                         f"or NHWC, got {fmt!r}")
     xq = _quantize_in(x, in_scale, qmax)
     acc = lax.conv_general_dilated(
         xq, w.astype(jnp.int8),
         window_strides=pair(attrs.get("strides", 1)),
         padding=_conv_padding(attrs.get("paddings", 0), 2),
         rhs_dilation=pair(attrs.get("dilations", 1)),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         feature_group_count=attrs.get("groups", 1) or 1,
         preferred_element_type=jnp.int32,
     )

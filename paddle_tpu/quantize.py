@@ -200,22 +200,31 @@ def convert_to_int8(program: Program, scope=None):
             scale = float(np.max(np.abs(np.asarray(val))))
         return src, scale
 
-    converted = {}
-    new_ops = []
+    # ---- pass 1: plan (no mutation).  A weight converts to int8 only
+    # if EVERY op touching it can run the int8 form with one stored
+    # orientation — a mixed outcome would leave a float consumer (or a
+    # second quantizable op, or anything reading the raw weight) seeing
+    # int8 codes where it expects floats.
+    def _wants_transpose(t, attrs):
+        return bool(t == "matmul" and (attrs.get("transpose_Y")
+                                       or attrs.get("transpose_y")))
+
+    plans = {}          # op idx -> plan dict
+    weight_users = {}   # w_src -> list of (idx, convertible, transpose)
+    raw_weight_readers = {}  # w_src -> # non-qdq ops reading it
     for idx, op in enumerate(block.ops):
         t = op.desc.type
         if t not in QUANTIZABLE_OPS:
-            new_ops.append(op)
             continue
         w_slot = _WEIGHT_SLOTS[t]
         a_slot = "Input" if t in ("conv2d", "depthwise_conv2d") else "X"
         act = qdq_source_and_scale(op.desc.inputs[a_slot][0], False)
         wgt = qdq_source_and_scale(op.desc.inputs[w_slot][0], True)
         if act is None or wgt is None:
-            new_ops.append(op)
             continue
         (act_src, in_scale), (w_src, w_scale) = act, wgt
         attrs = dict(op.desc.attrs)
+        convertible = True
         if t == "matmul":
             # quantized_matmul implements the mul flattening contract;
             # matmul variants it cannot express stay in float QDQ form
@@ -223,26 +232,65 @@ def convert_to_int8(program: Program, scope=None):
             if (attrs.get("transpose_X") or attrs.get("transpose_x")
                     or float(attrs.get("alpha", 1.0) or 1.0) != 1.0
                     or len(wv_shape) != 2):
-                new_ops.append(op)
-                continue
-            act_rank = len(block.var(act_src).shape)
-            attrs["x_num_col_dims"] = max(act_rank - 1, 1)
-            attrs["y_num_col_dims"] = 1
+                convertible = False
+            else:
+                act_rank = len(block.var(act_src).shape)
+                attrs["x_num_col_dims"] = max(act_rank - 1, 1)
+                attrs["y_num_col_dims"] = 1
+        transpose = _wants_transpose(t, attrs)
+        plans[idx] = dict(t=t, act_src=act_src, in_scale=in_scale,
+                          w_src=w_src, w_scale=w_scale, attrs=attrs,
+                          transpose=transpose)
+        weight_users.setdefault(w_src, []).append(
+            (idx, convertible, transpose))
+    for op in block.ops:
+        if op.desc.type in _QDQ_TYPES:
+            continue
+        for names in op.desc.inputs.values():
+            for n in names:
+                if n in weight_users:
+                    raw_weight_readers[n] = \
+                        raw_weight_readers.get(n, 0) + 1
+
+    ok_weights = {}
+    for w_src, users in weight_users.items():
+        transposes = {tr for _, conv, tr in users}
+        if (all(conv for _, conv, _ in users)
+                and len(transposes) == 1
+                and raw_weight_readers.get(w_src, 0) == 0):
+            ok_weights[w_src] = transposes.pop()
+
+    # ---- pass 2: apply.
+    weight_done = set()
+    converted = {}
+    new_ops = []
+    for idx, op in enumerate(block.ops):
+        plan = plans.get(idx)
+        if plan is None or plan["w_src"] not in ok_weights:
+            new_ops.append(op)
+            continue
+        t = plan["t"]
+        act_src, in_scale = plan["act_src"], plan["in_scale"]
+        w_src, w_scale = plan["w_src"], plan["w_scale"]
+        attrs = plan["attrs"]
+        transpose = plan["transpose"]
         bits = 8
         qmax = float(2 ** (bits - 1) - 1)
-        wv = jnp.asarray(scope.find_var(w_src), jnp.float32)
-        if t == "matmul" and (attrs.get("transpose_Y")
-                              or attrs.get("transpose_y")):
-            # the weight is static: bake the transpose into the stored
-            # int8 tensor instead of teaching the kernel about it
-            wv = wv.T
-            block.var(w_src).desc.shape = tuple(wv.shape)
+        if w_src not in weight_done:
+            wv = jnp.asarray(scope.find_var(w_src), jnp.float32)
+            if transpose:
+                # the weight is static: bake the transpose into the
+                # stored int8 tensor instead of teaching the kernel
+                wv = wv.T
+                block.var(w_src).desc.shape = tuple(wv.shape)
+            wq = jnp.clip(jnp.round(wv / max(w_scale, 1e-8) * qmax),
+                          -qmax, qmax).astype(jnp.int8)
+            scope.set_var(w_src, wq)
+            block.var(w_src).desc.dtype = "int8"
+            weight_done.add(w_src)
+        if transpose:
             attrs.pop("transpose_Y", None)
             attrs.pop("transpose_y", None)
-        wq = jnp.clip(jnp.round(wv / max(w_scale, 1e-8) * qmax),
-                      -qmax, qmax).astype(jnp.int8)
-        scope.set_var(w_src, wq)
-        block.var(w_src).desc.dtype = "int8"
 
         attrs.update({"in_scale": in_scale, "weight_scale": w_scale,
                       "bit_length": bits})
@@ -250,7 +298,9 @@ def convert_to_int8(program: Program, scope=None):
             if t == "depthwise_conv2d":
                 # the float impl injects groups = C_in at execution
                 # time (ops/nn.py depthwise_conv2d); freeze it here
-                attrs["groups"] = int(block.var(act_src).shape[1])
+                c_axis = (3 if attrs.get("data_format") == "NHWC"
+                          else 1)
+                attrs["groups"] = int(block.var(act_src).shape[c_axis])
             new_type = "quantized_conv2d"
             inputs = {"Input": [act_src], "Filter": [w_src]}
             outputs = {"Output": op.desc.outputs["Output"]}

@@ -152,3 +152,42 @@ def test_convert_skips_inexpressible_matmul_variants(tmp_path):
         got, = exe.run(infer, feed={"x": xv}, fetch_list=[pred])
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    atol=0.02)
+
+
+def test_shared_weight_converts_once_with_true_scale(tmp_path):
+    """A weight feeding two quantizable ops quantizes ONCE from its
+    float value (re-reading after conversion would fabricate a ~127
+    scale) and both consumers carry the same true scale."""
+    import paddle_tpu.quantize as pq
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(5)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        a = layers.data(name="a", shape=[6], dtype="float32")
+        b = layers.data(name="b", shape=[6], dtype="float32")
+        w = LayerHelper("sw").create_parameter(
+            ParamAttr(name="shared_w"), shape=[6, 3], dtype="float32")
+        out_sum = layers.elementwise_add(layers.matmul(a, w),
+                                         layers.matmul(b, w))
+        fluid.QuantizeTranspiler().training_transpile(main, startup)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"a": rng.rand(8, 6).astype(np.float32),
+                "b": rng.rand(8, 6).astype(np.float32)}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[out_sum])
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed=feed, fetch_list=[out_sum])
+
+        true_scale = float(np.abs(np.asarray(
+            fluid.global_scope().find_var("shared_w"))).max())
+        converted = pq.convert_to_int8(infer, fluid.global_scope())
+        assert len(converted) == 2
+        scales = {round(ws, 6) for (_t, _i, ws) in converted.values()}
+        assert scales == {round(true_scale, 6)}
+        got, = exe.run(infer, feed=feed, fetch_list=[out_sum])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0.05, atol=0.05)

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from paddle_tpu import metrics
+from paddle_tpu import layers, metrics
 from tests.op_test import run_op
 
 
@@ -165,3 +165,88 @@ def test_chunk_eval_layer_in_program():
     assert res[0][0] == pytest.approx(1.0)
     assert res[1][0] == pytest.approx(1.0)
     assert res[3][0] == res[4][0] == res[5][0]
+
+
+# -- round 3: in-graph evaluator + multi-session serving ---------------------
+
+def test_in_graph_chunk_evaluator_accumulates_on_device():
+    """fluid.evaluator.ChunkEvaluator (reference evaluator.py:251):
+    counters are persistable graph state updated inside the step; P/R/F1
+    come from the accumulated device totals."""
+    import paddle_tpu.evaluator as evaluator
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        pred = layers.data(name="pred", shape=[6], dtype="int64")
+        lab = layers.data(name="lab", shape=[6], dtype="int64")
+        slen = layers.data(name="slen", shape=[], dtype="int32")
+        ev = evaluator.ChunkEvaluator(pred, lab, chunk_scheme="IOB",
+                                      num_chunk_types=2, seq_len=slen)
+        exe = fluid.Executor()
+        exe.run(startup)
+        # IOB with 2 types: tag 0 = B-0, 1 = I-0, 2 = B-1, 3 = I-1,
+        # 4 = O.  Perfect batch then a half-right batch.
+        perfect = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+        noisy = np.array([[0, 4, 4, 2, 3, 4]], np.int64)
+        slen_v = np.array([6], np.int32)
+        exe.run(main, feed={"pred": perfect, "lab": perfect,
+                            "slen": slen_v},
+                fetch_list=[ev.batch_metrics[0]])
+        p1, r1, f1 = ev.eval()
+        assert (p1, r1) == (1.0, 1.0)
+        exe.run(main, feed={"pred": noisy, "lab": perfect,
+                            "slen": slen_v},
+                fetch_list=[ev.batch_metrics[0]])
+        p2, r2, _ = ev.eval()
+        # accumulated: infer 2+2=4... noisy has chunks [0],[2,3] → 2
+        # infer chunks, 1 correct ([2,3]); totals: infer 4, label 4,
+        # correct 3
+        assert abs(p2 - 0.75) < 1e-6 and abs(r2 - 0.75) < 1e-6
+        ev.reset()
+        assert ev.eval() == (0.0, 0.0, 0.0)
+
+
+def test_predictor_clone_shares_weights_and_serves(tmp_path):
+    """Predictor.clone (reference AnalysisPredictor::Clone): clones
+    share device params + executable cache and serve concurrently."""
+    import threading
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        out_v = layers.fc(x, size=4, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "m")
+        fluid.io.save_inference_model(d, ["x"], [out_v], exe,
+                                      main_program=main)
+    base = fluid.Predictor(d)
+    feed = {"x": rng.rand(8, 8).astype(np.float32)}
+    (ref,) = base.run(feed)
+    clones = [base.clone() for _ in range(4)]
+    assert all(c._params is base._params for c in clones)
+    assert all(c._compiled is base._compiled for c in clones)
+
+    results = {}
+    errors = []
+
+    def serve(i, c):
+        try:
+            for _ in range(5):
+                (o,) = c.run(feed)
+            results[i] = o
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=serve, args=(i, c))
+               for i, c in enumerate(clones)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for o in results.values():
+        np.testing.assert_allclose(o, ref, rtol=1e-6)

@@ -160,3 +160,32 @@ def test_recommender_system_trains():
         ]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" (TPU-preferred channels-last) is numerically
+    the same network: identical init (seeded), loss trajectories match
+    within conv reduction-order noise."""
+    from paddle_tpu.models import resnet
+
+    def run(fmt):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 9
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            m = resnet.build_model(dataset="cifar10", learning_rate=0.1,
+                                   data_format=fmt)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feed = {"data": rng.rand(4, 3, 32, 32).astype(np.float32),
+                    "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+            losses = []
+            for _ in range(3):
+                lv, = exe.run(main, feed=feed, fetch_list=[m["loss"]])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        return losses
+
+    np.testing.assert_allclose(run("NCHW"), run("NHWC"), rtol=2e-3,
+                               atol=1e-4)
