@@ -17,6 +17,15 @@ The Baidu-pslib distributed-KV path (async_executor.cc init_server/
 init_worker) is obsolete on TPU: sharded embedding tables over the mesh
 (parallel/, SparseGrad) replace the parameter server — documented
 divergence, same capability.
+
+Shard dispatch goes through a lease queue (data/task_queue.py — the
+in-process analog of the Go master's task service,
+go/master/service.go:106,341): a parser thread that dies or stalls
+returns its shard for another worker, with at-least-once re-delivery
+and max_failures retirement.  Multi-host dispatch (the Go master served
+leases over RPC to many trainers) is a documented non-goal: synchronous
+SPMD steps over jax.distributed make per-host dataset partitioning
+static (dist.py shard_filelist-by-process) rather than work-stolen.
 """
 
 from __future__ import annotations
@@ -42,7 +51,9 @@ class AsyncExecutor:
             filelist: Sequence[str], thread_num: Optional[int] = None,
             fetch: Sequence = (), mode: str = "", debug: bool = False,
             scope: Optional[Scope] = None,
-            report_every: int = 100) -> Dict[str, float]:
+            report_every: int = 100,
+            shard_lease_timeout: float = 300.0,
+            shard_max_failures: int = 3) -> Dict[str, float]:
         """Train over `filelist` once.  thread_num parser threads split
         the shards (reference async_executor.cc: files round-robin over
         threads; default FLAGS.paddle_num_threads); fetch vars are
@@ -60,18 +71,25 @@ class AsyncExecutor:
         feed_parser = MultiSlotDataFeed(data_feed)
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch]
 
-        # shard files over parser threads; each thread's batches merge
-        # into one bounded device queue
-        shards: List[List[str]] = [list(filelist[i::thread_num])
-                                   for i in range(thread_num)]
-        shards = [s for s in shards if s]
-
         import queue as queue_mod
         import threading
+        import time as time_mod
 
         from .data.decorator import _ReaderError
+        from .data.task_queue import TaskQueue
 
-        merged: "queue_mod.Queue" = queue_mod.Queue(maxsize=4 * len(shards))
+        # Shards dispatch through a lease queue instead of a static
+        # round-robin split (reference analog: the Go master's task
+        # service, go/master/service.go:106,341): a parser thread that
+        # dies or stalls past its lease returns its shard for another
+        # worker, so one bad thread no longer strands a slice of the
+        # dataset.  Delivery is AT-LEAST-ONCE — a retried shard can
+        # re-emit batches that already reached the device queue.
+        n_workers = min(thread_num, len(filelist))
+        tq = TaskQueue(list(filelist), lease_timeout=shard_lease_timeout,
+                       max_failures=shard_max_failures)
+
+        merged: "queue_mod.Queue" = queue_mod.Queue(maxsize=4 * n_workers)
         _STOP = object()
         abort = threading.Event()
 
@@ -84,27 +102,60 @@ class AsyncExecutor:
                     continue
             return False
 
-        def worker(paths):
-            # shard failures surface on the consumer (reference: the
-            # ExecutorThreadWorker aborts the run on reader errors) —
-            # never silently truncate the dataset
+        def worker(widx):
             try:
-                for batch in feed_parser.batches(paths):
-                    if not _put(batch):
-                        return
+                while not abort.is_set():
+                    task = tq.acquire(f"parser-{widx}")
+                    if task is None:
+                        if tq.all_done():
+                            break
+                        time_mod.sleep(0.02)
+                        continue
+                    try:
+                        lost = False
+                        for batch in feed_parser.batches([task.shard]):
+                            if not _put(batch):
+                                tq.fail(task.task_id, task.lease)
+                                return
+                            # heartbeat per batch: the lease measures
+                            # parser progress, not consumer backpressure
+                            if not tq.renew(task.task_id, task.lease):
+                                lost = True  # re-leased elsewhere
+                                break
+                        if not lost:
+                            tq.complete(task.task_id, task.lease)
+                    except BaseException as e:  # noqa: BLE001
+                        if not tq.fail(task.task_id, task.lease):
+                            # retired after max_failures: surface on the
+                            # consumer (reference: ExecutorThreadWorker
+                            # aborts on reader errors) — never silently
+                            # truncate the dataset
+                            _put(_ReaderError(e))
+                            return
                 _put(_STOP)
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001
                 _put(_ReaderError(e))
 
-        threads = [threading.Thread(target=worker, args=(s,), daemon=True)
-                   for s in shards]
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(n_workers)]
         for t in threads:
             t.start()
 
         def reader():
+            # termination must NOT require a _STOP from every worker: a
+            # truly stalled thread never sends one (its shard re-leases
+            # to others) — exit once the queue has drained after every
+            # shard completed or retired; retired shards raise in the
+            # end-of-run failed_tasks() check even if their in-flight
+            # _ReaderError loses this race
             done = 0
             while done < len(threads):
-                item = merged.get()
+                try:
+                    item = merged.get(timeout=0.2)
+                except queue_mod.Empty:
+                    if tq.all_done() and merged.empty():
+                        return
+                    continue
                 if item is _STOP:
                     done += 1
                     continue
@@ -150,6 +201,12 @@ class AsyncExecutor:
             feeder.reset()
             for t in threads:
                 t.join(timeout=5)
+        dead = tq.failed_tasks()
+        if dead:
+            raise RuntimeError(
+                "async_executor: shards retired after "
+                f"{tq.max_failures} failed leases (data NOT fully "
+                f"consumed): {[t.shard for t in dead]}")
         if steps == 0:
             raise RuntimeError(
                 "no batches produced — check filelist contents and the "

@@ -286,3 +286,194 @@ def test_trainer_events_checkpoint_resume(tmp_path):
     xv = np.random.RandomState(4).rand(4, 4).astype(np.float32)
     (pv,) = inferencer.infer({"x": xv})
     np.testing.assert_allclose(pv, xv @ trained_w, rtol=1e-5)
+
+
+# -- round 3: shard-lease task queue (reference go/master/service.go) --------
+
+def test_task_queue_lease_expiry_requeues():
+    from paddle_tpu.data.task_queue import TaskQueue
+
+    clock = [0.0]
+    tq = TaskQueue(["a", "b"], lease_timeout=10.0, max_failures=3,
+                   clock=lambda: clock[0])
+    t1 = tq.acquire("w1")
+    t2 = tq.acquire("w1")
+    assert {t1.shard, t2.shard} == {"a", "b"}
+    assert tq.acquire("w2") is None and not tq.all_done()
+    tq.complete(t1.task_id, t1.lease)
+    # w1 dies holding t2: after the lease expires another worker gets it
+    clock[0] = 11.0
+    t3 = tq.acquire("w2")
+    assert t3 is not None and t3.shard == t2.shard
+    assert t3.failures == 1
+    tq.complete(t3.task_id, t3.lease)
+    assert tq.all_done() and not tq.failed_tasks()
+
+
+def test_task_queue_retires_after_max_failures():
+    from paddle_tpu.data.task_queue import TaskQueue
+
+    tq = TaskQueue(["x"], lease_timeout=100.0, max_failures=2)
+    t = tq.acquire("w")
+    assert tq.fail(t.task_id, t.lease)          # retry 1 allowed
+    t = tq.acquire("w")
+    assert not tq.fail(t.task_id, t.lease)      # retired
+    assert tq.all_done()
+    assert [d.shard for d in tq.failed_tasks()] == ["x"]
+
+
+def test_task_queue_stale_lease_reports_are_ignored():
+    """A worker whose lease expired must not complete/fail/renew the
+    task out from under the new owner (service.go lease semantics)."""
+    from paddle_tpu.data.task_queue import TaskQueue
+
+    clock = [0.0]
+    tq = TaskQueue(["x"], lease_timeout=10.0, max_failures=3,
+                   clock=lambda: clock[0])
+    t_old = tq.acquire("w1")
+    clock[0] = 11.0                      # w1's lease expires
+    t_new = tq.acquire("w2")
+    assert t_new is not None and t_new.lease != t_old.lease
+    # stale complete: must NOT retire w2's live lease
+    tq.complete(t_old.task_id, t_old.lease)
+    assert not tq.all_done()
+    # stale fail: reported as "not your problem", no failure counted
+    assert tq.fail(t_old.task_id, t_old.lease)
+    assert tq.stats()["pending"] == 1
+    assert not tq.renew(t_old.task_id, t_old.lease)
+    assert tq.renew(t_new.task_id, t_new.lease)
+    tq.complete(t_new.task_id, t_new.lease)
+    assert tq.all_done() and not tq.failed_tasks()
+
+
+def test_task_queue_renew_extends_lease():
+    from paddle_tpu.data.task_queue import TaskQueue
+
+    clock = [0.0]
+    tq = TaskQueue(["x"], lease_timeout=10.0,
+                   clock=lambda: clock[0])
+    t = tq.acquire("w")
+    clock[0] = 8.0
+    assert tq.renew(t.task_id, t.lease)
+    clock[0] = 16.0                      # past original deadline
+    assert tq.acquire("w2") is None      # still leased (renewed)
+    tq.complete(t.task_id, t.lease)
+    assert tq.all_done()
+
+
+def test_async_executor_does_not_hang_on_stalled_worker(tmp_path):
+    """A parser thread stalled forever: its shard re-leases, the run
+    completes, no deadlock waiting for the stalled thread's _STOP."""
+    import threading
+
+    rng = np.random.RandomState(8)
+    files = []
+    for i in range(3):
+        p = os.path.join(tmp_path, f"part-{i}")
+        _write_multislot(p, rng, 16)
+        files.append(p)
+
+    B = 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[B, 5], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        dense = layers.data("dense", shape=[B, 3],
+                            append_batch_size=False)
+        label = layers.data("label", shape=[B, 1], dtype="int64",
+                            append_batch_size=False)
+        emb = layers.embedding(ids, size=[50, 8], is_sparse=True)
+        pooled = layers.sequence_pool(emb, "sum")
+        feat = layers.concat([pooled, dense], axis=1)
+        pred = layers.fc(feat, size=2)
+        loss = layers.reduce_mean(layers.softmax_with_cross_entropy(
+            pred, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+
+    release = threading.Event()
+    stalled = {"hit": False}
+    orig = MultiSlotDataFeed.batches
+
+    def stalling_batches(self, paths):
+        # first thread to grab a shard stalls until the run finishes
+        if not stalled["hit"]:
+            stalled["hit"] = True
+            release.wait(timeout=60)
+        return orig(self, paths)
+
+    aexe = fluid.AsyncExecutor()
+    MultiSlotDataFeed.batches = stalling_batches
+    try:
+        stats = aexe.run(main, _desc(B), files, thread_num=2,
+                         fetch=[loss], scope=scope,
+                         shard_lease_timeout=1.0,
+                         shard_max_failures=10)
+    finally:
+        release.set()
+        MultiSlotDataFeed.batches = orig
+    assert np.isfinite(stats[loss.name])
+    assert stalled["hit"]
+
+
+def test_async_executor_survives_worker_crash(tmp_path):
+    """A shard whose parse fails transiently re-leases and retries; the
+    run still covers every file (at-least-once re-delivery, the Go
+    master's contract)."""
+    import threading
+
+    rng = np.random.RandomState(7)
+    files = []
+    for i in range(4):
+        p = os.path.join(tmp_path, f"part-{i}")
+        _write_multislot(p, rng, 16)
+        files.append(p)
+
+    B = 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[B, 5], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        dense = layers.data("dense", shape=[B, 3],
+                            append_batch_size=False)
+        label = layers.data("label", shape=[B, 1], dtype="int64",
+                            append_batch_size=False)
+        emb = layers.embedding(ids, size=[50, 8], is_sparse=True)
+        pooled = layers.sequence_pool(emb, "sum")
+        feat = layers.concat([pooled, dense], axis=1)
+        pred = layers.fc(feat, size=2)
+        loss = layers.reduce_mean(layers.softmax_with_cross_entropy(
+            pred, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+
+    flaky = {"left": 2}
+    flaky_lock = threading.Lock()
+    orig = MultiSlotDataFeed.batches
+
+    def flaky_batches(self, paths):
+        with flaky_lock:
+            crash = flaky["left"] > 0
+            if crash:
+                flaky["left"] -= 1
+        if crash:
+            raise OSError(f"simulated shard read failure for {paths}")
+        return orig(self, paths)
+
+    aexe = fluid.AsyncExecutor()
+    MultiSlotDataFeed.batches = flaky_batches
+    try:
+        stats = aexe.run(main, _desc(B), files, thread_num=2,
+                         fetch=[loss], scope=scope,
+                         shard_lease_timeout=30.0,
+                         shard_max_failures=3)
+    finally:
+        MultiSlotDataFeed.batches = orig
+    assert np.isfinite(stats[loss.name])
+    assert flaky["left"] == 0  # the failures actually happened
