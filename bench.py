@@ -204,18 +204,20 @@ def _dense_equiv_flops(feed, build_no_flash):
 
 def bench_transformer(batch_size: int, steps: int, warmup: int,
                       max_length: int = 256, use_amp: bool = True,
-                      use_flash: bool = True, use_fused_ce: bool = False):
+                      use_flash: bool = True, use_fused_ce: bool = False,
+                      fused_qkv: bool = False):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    def build(flash, fused_ce=use_fused_ce):
+    def build(flash, fused_ce=use_fused_ce, fq=None):
         return transformer.build_model(
             src_vocab_size=32000, trg_vocab_size=32000,
             max_length=max_length, n_layer=6, n_head=8, d_model=512,
             d_inner_hid=2048, dropout=0.1, use_flash=flash,
-            use_amp=use_amp, use_fused_ce=fused_ce)
+            use_amp=use_amp, use_fused_ce=fused_ce,
+            fused_qkv=fused_qkv if fq is None else fq)
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -230,7 +232,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
             # dense-equivalent numerator whenever any Pallas kernel is
             # active (custom calls report zero flops to XLA)
             step_flops = _dense_equiv_flops(
-                feed, lambda: build(False, fused_ce=False))
+                feed, lambda: build(False, fused_ce=False, fq=False))
         else:
             cost = exe.cost_analysis(main, feed=feed,
                                      fetch_list=[model["loss"]])
@@ -243,6 +245,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                                  / elapsed, 1),
          "batch_size": batch_size, "max_length": max_length,
          "amp": use_amp, "flash": use_flash, "fused_ce": use_fused_ce,
+         "fused_qkv": fused_qkv,
          "flop_count": ("dense-equivalent"
                         if (use_flash or use_fused_ce) else "xla"),
          "last_loss": last_loss})
@@ -478,6 +481,9 @@ def main():
     p.add_argument("--fused-ce", action="store_true",
                    help="transformer: fused vocab projection+CE Pallas "
                         "kernel (ops/pallas/vocab_ce.py)")
+    p.add_argument("--fused-qkv", action="store_true",
+                   help="transformer: Megatron-style single fused QKV "
+                        "projection in self-attention")
     p.add_argument("--data", default="synthetic",
                    choices=["synthetic", "frozen", "host"],
                    help="resnet50 input mode: fresh on-device synthetic "
@@ -517,7 +523,8 @@ def main():
     if args.model in ("all", "transformer"):
         _run("transformer", bench_transformer, args.batch or 64,
              args.steps, args.warmup, use_amp=amp,
-             use_flash=not args.no_flash, use_fused_ce=args.fused_ce)
+             use_flash=not args.no_flash, use_fused_ce=args.fused_ce,
+             fused_qkv=args.fused_qkv)
     if args.model in ("all", "bert"):
         _run("bert", bench_bert, args.batch or 32, args.steps,
              args.warmup, use_amp=amp, use_flash=not args.no_flash)

@@ -93,8 +93,17 @@ class AsyncExecutor:
         _STOP = object()
         abort = threading.Event()
 
-        def _put(item) -> bool:
+        _LOST = object()
+
+        def _put(item, keepalive=None):
+            """Returns True when enqueued, False when aborting, _LOST
+            when the keepalive reports the lease is gone.  keepalive
+            runs every wait iteration so consumer BACKPRESSURE (full
+            queue during a long compile/step) keeps the lease alive —
+            lease time measures a dead parser, not a slow consumer."""
             while not abort.is_set():
+                if keepalive is not None and not keepalive():
+                    return _LOST
                 try:
                     merged.put(item, timeout=0.1)
                     return True
@@ -113,14 +122,20 @@ class AsyncExecutor:
                         continue
                     try:
                         lost = False
+                        keepalive = (lambda t=task:
+                                     tq.renew(t.task_id, t.lease))
                         for batch in feed_parser.batches([task.shard]):
-                            if not _put(batch):
+                            r = _put(batch, keepalive=keepalive)
+                            if r is _LOST:
+                                lost = True  # re-leased elsewhere
+                                break
+                            if r is False:
                                 tq.fail(task.task_id, task.lease)
                                 return
-                            # heartbeat per batch: the lease measures
-                            # parser progress, not consumer backpressure
+                            # heartbeat per batch too (fast consumers
+                            # never hit the _put wait loop)
                             if not tq.renew(task.task_id, task.lease):
-                                lost = True  # re-leased elsewhere
+                                lost = True
                                 break
                         if not lost:
                             tq.complete(task.task_id, task.lease)

@@ -426,3 +426,32 @@ def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist,
                "sample_size": int(sample_size),
                "mining_type": mining_type})
     return neg_idx, neg_mask, updated
+
+
+def polygon_box_transform(input, name=None):
+    """EAST quad-geometry decode (reference layers/detection.py
+    polygon_box_transform)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """Perspective-warp quad ROIs to a fixed grid (reference
+    layers/detection.py roi_perspective_transform); rois (R, 9) with a
+    leading batch index."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"transformed_height": int(transformed_height),
+               "transformed_width": int(transformed_width),
+               "spatial_scale": float(spatial_scale)})
+    return out

@@ -21,19 +21,41 @@ from ..initializer import Normal
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head, dropout_rate=0.0,
-                         use_flash=False):
-    if keys is None:  # self-attention
-        keys, values = queries, queries
-    # layer names drive the Megatron row/col sharding rules
-    # (parallel/strategies.py): attn_qkv_* weights shard column-parallel
-    # (output heads over mp), attn_out_* row-parallel (input heads over
-    # mp) — one all-reduce per attention block instead of three.
-    q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False, name="attn_qkv")
-    k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False, name="attn_qkv")
-    v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
-                  bias_attr=False, name="attn_qkv")
+                         use_flash=False, fused_qkv=False):
+    if keys is None and fused_qkv:
+        # Megatron-style fused QKV: ONE (D, 3·H·d) matmul instead of
+        # three (D, H·d) ones — a 3× wider MXU tile per layer.  The
+        # layer name keeps the attn_qkv prefix so the column-parallel
+        # rule still applies; note the q/k/v slice boundaries are NOT
+        # aligned with an mp split of the 3·H·d dim unless mp divides
+        # 3, so under tensor parallelism GSPMD may insert reshards at
+        # the slices (correct — test_vocab_ce.py proves it — but the
+        # one-allreduce-per-block Megatron property can degrade).  The
+        # flag targets single-chip/dp throughput; prefer unfused with
+        # large mp.
+        qkv = layers.fc(queries, size=(2 * d_key + d_value) * n_head,
+                        num_flatten_dims=2, bias_attr=False,
+                        name="attn_qkv")
+        q = layers.slice(qkv, axes=[2], starts=[0],
+                         ends=[d_key * n_head])
+        k = layers.slice(qkv, axes=[2], starts=[d_key * n_head],
+                         ends=[2 * d_key * n_head])
+        v = layers.slice(qkv, axes=[2], starts=[2 * d_key * n_head],
+                         ends=[(2 * d_key + d_value) * n_head])
+    else:
+        if keys is None:  # self-attention
+            keys, values = queries, queries
+        # layer names drive the Megatron row/col sharding rules
+        # (parallel/strategies.py): attn_qkv_* weights shard
+        # column-parallel (output heads over mp), attn_out_*
+        # row-parallel (input heads over mp) — one all-reduce per
+        # attention block instead of three.
+        q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
+                      bias_attr=False, name="attn_qkv")
+        k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
+                      bias_attr=False, name="attn_qkv")
+        v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
+                      bias_attr=False, name="attn_qkv")
 
     def split_heads(x, d):
         # (N, T, H*d) -> (N, H, T, d)
@@ -90,10 +112,11 @@ def pre_post_process(prev_out, out, process_cmd, dropout_rate=0.0):
 
 
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
-                  dropout, use_flash=False):
+                  dropout, use_flash=False, fused_qkv=False):
     attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, attn_bias, d_key,
-        d_value, d_model, n_head, dropout, use_flash=use_flash)
+        d_value, d_model, n_head, dropout, use_flash=use_flash,
+        fused_qkv=fused_qkv)
     attn = pre_post_process(x, attn, "ad", dropout)
     ff = positionwise_feed_forward(pre_post_process(None, attn, "n"),
                                    d_inner, d_model)
@@ -101,10 +124,12 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
 
 
 def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
-                  d_model, d_inner, dropout, use_flash=False):
+                  d_model, d_inner, dropout, use_flash=False,
+                  fused_qkv=False):
     self_attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, self_bias, d_key,
-        d_value, d_model, n_head, dropout, use_flash=use_flash)
+        d_value, d_model, n_head, dropout, use_flash=use_flash,
+        fused_qkv=fused_qkv)
     self_attn = pre_post_process(x, self_attn, "ad", dropout)
     q = pre_post_process(None, self_attn, "n")
     cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
@@ -153,7 +178,7 @@ def _causal_bias(max_len):
 def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
-                use_flash=False, use_fused_ce=False):
+                use_flash=False, use_fused_ce=False, fused_qkv=False):
     """Build the full training graph; returns (avg_cost, logits, feeds)."""
     src_word = layers.data(name="src_word", shape=[max_length],
                            dtype="int64")
@@ -175,7 +200,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     x = enc_in
     for _ in range(n_layer):
         x = encoder_layer(x, src_bias, n_head, d_key, d_value, d_model,
-                          d_inner_hid, dropout, use_flash=use_flash)
+                          d_inner_hid, dropout, use_flash=use_flash,
+                          fused_qkv=fused_qkv)
     enc_out = pre_post_process(None, x, "n")
 
     # decoder
@@ -185,7 +211,7 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     for _ in range(n_layer):
         y = decoder_layer(y, enc_out, self_bias, src_bias, n_head, d_key,
                           d_value, d_model, d_inner_hid, dropout,
-                          use_flash=use_flash)
+                          use_flash=use_flash, fused_qkv=fused_qkv)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -244,12 +270,12 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_model=512, d_inner_hid=2048,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
-                use_amp=False, use_fused_ce=False):
+                use_amp=False, use_fused_ce=False, fused_qkv=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
         dropout, label_smooth_eps, use_flash=use_flash,
-        use_fused_ce=use_fused_ce)
+        use_fused_ce=use_fused_ce, fused_qkv=fused_qkv)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

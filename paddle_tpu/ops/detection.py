@@ -1042,3 +1042,115 @@ def mine_hard_examples(ctx, ins, attrs):
     return {"NegIndices": [neg_idx],
             "NegMask": [neg_sel.astype(jnp.float32)],
             "UpdatedMatchIndices": [updated]}
+
+
+@register_op("polygon_box_transform")
+def polygon_box_transform(ctx, ins, attrs):
+    """EAST-style geometry decode (reference
+    detection/polygon_box_transform_op.cc): input (N, 2n, H, W) holds
+    per-pixel offsets to n polygon corners; even channels decode as
+    4*x_pixel - offset, odd channels as 4*y_pixel - offset (the
+    reference's quad geometry maps run at 1/4 resolution)."""
+    x = first(ins, "Input")
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=jnp.float32)[None, None, None, :] * 4.0
+    ys = jnp.arange(h, dtype=jnp.float32)[None, None, :, None] * 4.0
+    even = (jnp.arange(c) % 2 == 0).reshape(1, c, 1, 1)
+    o = jnp.where(even, xs - x.astype(jnp.float32),
+                  ys - x.astype(jnp.float32))
+    return {"Output": [o.astype(x.dtype)]}
+
+
+@register_op("roi_perspective_transform")
+def roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp quadrilateral ROIs to a fixed grid (reference
+    detection/roi_perspective_transform_op.cc, OCR text rectification):
+    each ROI is 4 corners (x0,y0..x3,y3); a homography maps the output
+    grid back into the input, sampled bilinearly, zero outside the quad.
+
+    ROIs are (R, 9): [batch_idx, x0, y0, x1, y1, x2, y2, x3, y3]
+    (batch-in-box replaces the reference's LoD mapping)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs").astype(jnp.float32)
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    _n, c, ih, iw = x.shape
+    bix = rois[:, 0].astype(jnp.int32)
+    quad = rois[:, 1:].reshape(-1, 4, 2) * scale   # (R, 4, [x, y])
+
+    def transform_matrix(rx, ry):
+        # reference get_transform_matrix: estimated quad size fixes the
+        # normalized grid; the homography maps (out_w, out_h, 1) to
+        # input coords
+        len1 = jnp.hypot(rx[0] - rx[1], ry[0] - ry[1])
+        len2 = jnp.hypot(rx[1] - rx[2], ry[1] - ry[2])
+        len3 = jnp.hypot(rx[2] - rx[3], ry[2] - ry[3])
+        len4 = jnp.hypot(rx[3] - rx[0], ry[3] - ry[0])
+        est_h = jnp.maximum((len2 + len4) / 2.0, 1e-6)
+        est_w = jnp.maximum((len1 + len3) / 2.0, 1e-6)
+        norm_h = float(th)
+        norm_w = jnp.minimum(
+            jnp.round(est_w * (norm_h - 1) / est_h) + 1, float(tw))
+        nw1 = jnp.maximum(norm_w - 1.0, 1e-6)
+        nh1 = float(th - 1) if th > 1 else 1e-6
+        dx1, dx2 = rx[1] - rx[2], rx[3] - rx[2]
+        dx3 = rx[0] - rx[1] + rx[2] - rx[3]
+        dy1, dy2 = ry[1] - ry[2], ry[3] - ry[2]
+        dy3 = ry[0] - ry[1] + ry[2] - ry[3]
+        den = dx1 * dy2 - dx2 * dy1
+        den = jnp.where(jnp.abs(den) < 1e-9, 1e-9, den)
+        a31 = (dx3 * dy2 - dx2 * dy3) / den / nw1
+        a32 = (dx1 * dy3 - dx3 * dy1) / den / nh1
+        a11 = (rx[1] - rx[0] + a31 * nw1 * rx[1]) / nw1
+        a12 = (rx[3] - rx[0] + a32 * nh1 * rx[3]) / nh1
+        a21 = (ry[1] - ry[0] + a31 * nw1 * ry[1]) / nw1
+        a22 = (ry[3] - ry[0] + a32 * nh1 * ry[3]) / nh1
+        return jnp.array([[a11, a12, rx[0]],
+                          [a21, a22, ry[0]],
+                          [a31, a32, 1.0]])
+
+    def in_quad(px, py, rx, ry):
+        # point-in-quad via consistent edge cross-product signs
+        crosses = []
+        for k in range(4):
+            x1, y1 = rx[k], ry[k]
+            x2, y2 = rx[(k + 1) % 4], ry[(k + 1) % 4]
+            crosses.append((x2 - x1) * (py - y1) - (y2 - y1) * (px - x1))
+        cr = jnp.stack(crosses)
+        eps = 1e-4
+        inside = (jnp.all(cr >= -eps, axis=0) |
+                  jnp.all(cr <= eps, axis=0))
+        return inside
+
+    def one(bi, q):
+        rx, ry = q[:, 0], q[:, 1]
+        m = transform_matrix(rx, ry)
+        ow = jnp.arange(tw, dtype=jnp.float32)[None, :]
+        oh = jnp.arange(th, dtype=jnp.float32)[:, None]
+        u = m[0, 0] * ow + m[0, 1] * oh + m[0, 2]
+        v = m[1, 0] * ow + m[1, 1] * oh + m[1, 2]
+        wgt = m[2, 0] * ow + m[2, 1] * oh + m[2, 2]
+        wgt = jnp.where(jnp.abs(wgt) < 1e-9, 1e-9, wgt)
+        src_x = u / wgt
+        src_y = v / wgt
+        valid = (in_quad(src_x, src_y, rx, ry)
+                 & (src_x >= -0.5) & (src_x <= iw - 0.5)
+                 & (src_y >= -0.5) & (src_y <= ih - 0.5))
+        sx = jnp.clip(src_x, 0.0, iw - 1.0)
+        sy = jnp.clip(src_y, 0.0, ih - 1.0)
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x1 = jnp.minimum(x0 + 1, iw - 1)
+        y1 = jnp.minimum(y0 + 1, ih - 1)
+        lx = sx - x0
+        ly = sy - y0
+        fm = x[bi]                                    # (C, H, W)
+        val = (fm[:, y0, x0] * (1 - ly) * (1 - lx)
+               + fm[:, y1, x0] * ly * (1 - lx)
+               + fm[:, y0, x1] * (1 - ly) * lx
+               + fm[:, y1, x1] * ly * lx)             # (C, th, tw)
+        return jnp.where(valid[None], val, 0.0)
+
+    o = jax.vmap(one)(bix, quad)
+    return {"Out": [o.astype(x.dtype)]}

@@ -338,3 +338,49 @@ def test_generate_proposal_labels_no_gt_image_all_background():
                     out_slot="LabelsInt32")
     assert rois_num[0] == 4
     assert (labels[0] == 0).all()
+
+
+# -- polygon_box_transform + roi_perspective_transform (round-3 tail) --------
+
+def test_polygon_box_transform_decodes_offsets():
+    x = np.zeros((1, 4, 2, 3), np.float32)
+    x[0, 0, 1, 2] = 1.5    # even channel: 4*w - in
+    x[0, 1, 1, 2] = 2.5    # odd channel:  4*h - in
+    o = run_op("polygon_box_transform", {"Input": x}, {},
+               out_slot="Output")
+    assert o.shape == x.shape
+    np.testing.assert_allclose(o[0, 0, 1, 2], 4 * 2 - 1.5)
+    np.testing.assert_allclose(o[0, 1, 1, 2], 4 * 1 - 2.5)
+    # zero offsets decode to the pixel grid itself
+    np.testing.assert_allclose(o[0, 0, 0], [0, 4, 8])
+    np.testing.assert_allclose(o[0, 3, :, 0], [0, 4])
+
+
+def test_roi_perspective_transform_axis_aligned_identity():
+    """An axis-aligned square ROI whose size matches the output grid
+    reduces the homography to identity: the crop comes back exactly."""
+    rng = np.random.RandomState(11)
+    x = rng.rand(1, 2, 8, 8).astype(np.float32)
+    # corners clockwise from top-left: (1,1) (4,1) (4,4) (1,4) → 4x4
+    rois = np.array([[0, 1, 1, 4, 1, 4, 4, 1, 4]], np.float32)
+    o = run_op("roi_perspective_transform",
+               {"X": x, "ROIs": rois},
+               {"transformed_height": 4, "transformed_width": 4,
+                "spatial_scale": 1.0})
+    assert o.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(o[0, :, :, :], x[0, :, 1:5, 1:5],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roi_perspective_transform_outside_quad_is_zero():
+    x = np.ones((1, 1, 8, 8), np.float32)
+    # a quad much narrower than the output grid: the normalized width
+    # clamps and columns beyond it fall outside the quad → zero
+    rois = np.array([[0, 1, 1, 2, 1, 2, 6, 1, 6]], np.float32)
+    o = run_op("roi_perspective_transform",
+               {"X": x, "ROIs": rois},
+               {"transformed_height": 6, "transformed_width": 6,
+                "spatial_scale": 1.0})
+    assert o.shape == (1, 1, 6, 6)
+    assert (o[0, 0, :, -1] == 0).all()   # far columns outside the quad
+    assert o[0, 0, 0, 0] == 1.0          # inside samples the map

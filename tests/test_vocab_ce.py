@@ -112,3 +112,35 @@ def test_transformer_fused_ce_trains_and_matches_unfused():
     # trajectories track closely
     np.testing.assert_allclose(fused, base, rtol=2e-2, atol=2e-2)
     assert fused[-1] < fused[0]
+
+
+def test_transformer_fused_options_shard_over_mp_mesh():
+    """fused_qkv + fused CE compile and run under a dp×mp mesh (GSPMD
+    re-propagates shardings through the qkv slices and the fused-CE
+    custom-vjp)."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.strategies import megatron_transformer_rules
+
+    mesh = make_mesh({"dp": 2, "mp": 4})
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        model = transformer.build_model(
+            src_vocab_size=64, trg_vocab_size=64, max_length=8,
+            n_layer=1, n_head=4, d_model=32, d_inner_hid=64,
+            dropout=0.0, use_fused_ce=True, fused_qkv=True)
+        exe = fluid.Executor()
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        bs.sharding_rules = megatron_transformer_rules()
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=model["loss"].name, build_strategy=bs, mesh=mesh)
+        feed = transformer.make_fake_batch(8, 8, 64, 64)
+        l1, = exe.run(prog, feed=feed, fetch_list=[model["loss"]])
+        l2, = exe.run(prog, feed=feed, fetch_list=[model["loss"]])
+    assert np.isfinite(float(np.asarray(l1).reshape(-1)[0]))
+    assert (float(np.asarray(l2).reshape(-1)[0])
+            < float(np.asarray(l1).reshape(-1)[0]))
