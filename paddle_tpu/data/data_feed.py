@@ -95,11 +95,32 @@ class MultiSlotDataFeed:
         return sample
 
     def read_file(self, path: str) -> Iterable[dict]:
+        # RecordIO shards (sniffed by chunk magic) carry one MultiSlot
+        # line per record — the reference's recordio DataFeed variant
+        # (data_feed.cc MultiSlotType over recordio chunks); plain files
+        # are newline-separated text
+        if self._is_recordio(path):
+            from . import recordio
+
+            for rec in recordio.Scanner(path):
+                line = rec.decode("utf-8").strip()
+                if line:
+                    yield self._parse_line(line)
+            return
         with open(path) as f:
             for line in f:
                 line = line.strip()
                 if line:
                     yield self._parse_line(line)
+
+    @staticmethod
+    def _is_recordio(path: str) -> bool:
+        from . import recordio
+
+        with open(path, "rb") as f:
+            head = f.read(4)
+        return (len(head) == 4 and
+                int.from_bytes(head, "little") == recordio.MAGIC)
 
     def batches(self, paths: Sequence[str]) -> Iterable[Dict[str, np.ndarray]]:
         buf: List[dict] = []

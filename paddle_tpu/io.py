@@ -38,6 +38,20 @@ def _is_parameter(var: Variable) -> bool:
     return isinstance(var, Parameter)
 
 
+def _reinterpret(piece: np.ndarray, dtype_str: str) -> np.ndarray:
+    """np.savez stores custom dtypes (bfloat16/fp8 from ml_dtypes) as raw
+    void records ('|V2'); reinterpret them back to the dtype recorded in
+    the manifest.  Same-size native dtypes pass through untouched."""
+    dt = np.dtype(dtype_str)
+    if piece.dtype == dt:
+        return piece
+    if piece.dtype.kind == "V" and piece.dtype.itemsize == dt.itemsize:
+        return piece.view(dt)
+    raise RuntimeError(
+        f"checkpoint dtype mismatch: stored {piece.dtype} cannot be "
+        f"reinterpreted as manifest dtype {dt}")
+
+
 def _collect(program: Program, predicate) -> List[Variable]:
     return [v for v in program.list_vars() if predicate(v)]
 
@@ -68,6 +82,7 @@ def save_vars(executor: Executor, dirname: str,
         "version": PROGRAM_FORMAT_VERSION,
         "file": fname,
         "vars": names,
+        "dtypes": {n: str(arrays[n].dtype) for n in names},
     }
     with open(os.path.join(dirname, MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)
@@ -104,6 +119,9 @@ def load_vars(executor: Executor, dirname: str,
         if v.name not in data:
             raise RuntimeError(f"checkpoint missing variable {v.name!r}")
         arr = data[v.name]
+        want = manifest.get("dtypes", {}).get(v.name)
+        if want is not None:
+            arr = _reinterpret(arr, want)
         if tuple(arr.shape) != tuple(v.shape) and -1 not in v.shape:
             raise RuntimeError(
                 f"shape mismatch for {v.name!r}: checkpoint "
@@ -241,7 +259,7 @@ def _assemble_index(meta, files, dirname, index):
             continue
         if sh["file"] not in files:
             files[sh["file"]] = np.load(os.path.join(dirname, sh["file"]))
-        piece = files[sh["file"]][sh["key"]]
+        piece = _reinterpret(files[sh["file"]][sh["key"]], meta["dtype"])
         src = tuple(slice(a - sa, b - sa) for a, b, (sa, _) in
                     zip(inter_a, inter_b, s_idx))
         dst = tuple(slice(a - oa, b - oa) for a, b, oa in

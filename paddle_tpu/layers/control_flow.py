@@ -122,6 +122,18 @@ def logical_not(x, out=None):
     return out
 
 
+def is_empty(x, cond=None, **ignored):
+    """reference: layers/control_flow.py:1807 — scalar bool, true iff x
+    has zero elements (folds to a constant under XLA's static shapes)."""
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [cond]})
+    cond.desc.shape = (1,)
+    return cond
+
+
 # ---------------------------------------------------------------------------
 # Tensor arrays
 # ---------------------------------------------------------------------------

@@ -57,6 +57,26 @@ def iou_similarity(x, y, box_normalized=True, name=None):
     return output
 
 
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """reference layers/detection.py detection_map — in-graph per-batch
+    mAP (padded-dense contract; cross-batch accumulation lives in
+    metrics.DetectionMAP, see ops/detection.py)."""
+    helper = LayerHelper("detection_map", name=name)
+    map_out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label]},
+        outputs={"MAP": [map_out]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version})
+    return map_out
+
+
 def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
                    keep_top_k, nms_threshold=0.3, normalized=True,
                    nms_eta=1.0, background_label=0, name=None):

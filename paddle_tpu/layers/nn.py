@@ -249,6 +249,71 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     return helper.append_activation(pre_act)
 
 
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference layers/nn.py conv3d_transpose — NCDHW, filter
+    (C_in, C_out/groups, kD, kH, kW)."""
+    helper = LayerHelper("conv3d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    if filter_size is None:
+        raise ValueError("filter_size required (output_size-only inference "
+                         "not yet supported)")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    num_channels = input.shape[1]
+    filter_shape = [num_channels, num_filters // (groups or 1)] + \
+        list(filter_size)
+    w = helper.create_parameter(param_attr, shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+               "dilations": _triple(dilation), "groups": groups or 1})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def cos_sim(X, Y):
+    """reference layers/nn.py:1187 — row-wise cosine similarity,
+    Y's batch dim broadcastable."""
+    helper = LayerHelper("cos_sim")
+    o = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [o], "XNorm": [xn], "YNorm": [yn]})
+    return o
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference layers/nn.py pad_constant_like — pad y up to x's shape
+    at the high edges."""
+    helper = LayerHelper("pad_constant_like", name=name)
+    o = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [o]},
+                     attrs={"pad_value": float(pad_value)})
+    return o
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation CTR loss (public Paddle op; absent from the 1.2
+    reference tree — see ops/nn.py for the label encoding)."""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    o = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="teacher_student_sigmoid_loss",
+        inputs={"X": [input], "Label": [label]}, outputs={"Y": [o]},
+        attrs={"soft_max_up_bound": float(soft_max_up_bound),
+               "soft_max_lower_bound": float(soft_max_lower_bound)})
+    return o
+
+
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, act=None, name=None):
     helper = LayerHelper("conv3d", name=name, act=act, bias_attr=bias_attr)

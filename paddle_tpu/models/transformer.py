@@ -23,25 +23,26 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head, dropout_rate=0.0,
                          use_flash=False, fused_qkv=False):
     if keys is None and fused_qkv:
-        # Megatron-style fused QKV: ONE (D, 3·H·d) matmul instead of
-        # three (D, H·d) ones — a 3× wider MXU tile per layer.  The
-        # layer name keeps the attn_qkv prefix so the column-parallel
-        # rule still applies; note the q/k/v slice boundaries are NOT
-        # aligned with an mp split of the 3·H·d dim unless mp divides
-        # 3, so under tensor parallelism GSPMD may insert reshards at
-        # the slices (correct — test_vocab_ce.py proves it — but the
-        # one-allreduce-per-block Megatron property can degrade).  The
-        # flag targets single-chip/dp throughput; prefer unfused with
-        # large mp.
-        qkv = layers.fc(queries, size=(2 * d_key + d_value) * n_head,
+        # Megatron-style fused QKV: ONE (D, (2dk+dv)·H) matmul instead
+        # of three — a 3× wider MXU tile per layer.  The fused output
+        # dim is HEAD-GROUPED ([q_h|k_h|v_h] per head h), so an mp
+        # split of the fused dim lands on whole heads whenever mp
+        # divides n_head — exactly the unfused column-parallel layout.
+        # The reshape below then maps the mp shards onto the H axis and
+        # the per-head q/k/v slices are shard-local: one allreduce per
+        # attention block is preserved at any mp | n_head.  The layer
+        # name keeps the attn_qkv prefix so the column-parallel rule
+        # applies unchanged.
+        group = 2 * d_key + d_value
+        qkv = layers.fc(queries, size=group * n_head,
                         num_flatten_dims=2, bias_attr=False,
                         name="attn_qkv")
-        q = layers.slice(qkv, axes=[2], starts=[0],
-                         ends=[d_key * n_head])
-        k = layers.slice(qkv, axes=[2], starts=[d_key * n_head],
-                         ends=[2 * d_key * n_head])
-        v = layers.slice(qkv, axes=[2], starts=[2 * d_key * n_head],
-                         ends=[(2 * d_key + d_value) * n_head])
+        r = layers.reshape(qkv, shape=[0, 0, n_head, group])
+        r = layers.transpose(r, perm=[0, 2, 1, 3])  # (N, H, T, group)
+        q = layers.slice(r, axes=[3], starts=[0], ends=[d_key])
+        k = layers.slice(r, axes=[3], starts=[d_key], ends=[2 * d_key])
+        v = layers.slice(r, axes=[3], starts=[2 * d_key],
+                         ends=[group])
     else:
         if keys is None:  # self-attention
             keys, values = queries, queries
@@ -57,14 +58,14 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
                       bias_attr=False, name="attn_qkv")
 
-    def split_heads(x, d):
-        # (N, T, H*d) -> (N, H, T, d)
-        r = layers.reshape(x, shape=[0, 0, n_head, d])
-        return layers.transpose(r, perm=[0, 2, 1, 3])
+        def split_heads(x, d):
+            # (N, T, H*d) -> (N, H, T, d)
+            rr = layers.reshape(x, shape=[0, 0, n_head, d])
+            return layers.transpose(rr, perm=[0, 2, 1, 3])
 
-    q = split_heads(q, d_key)
-    k = split_heads(k, d_key)
-    v = split_heads(v, d_value)
+        q = split_heads(q, d_key)
+        k = split_heads(k, d_key)
+        v = split_heads(v, d_value)
 
     if use_flash:
         ctx = layers.flash_attention(q, k, v, attn_bias,

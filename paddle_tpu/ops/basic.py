@@ -431,6 +431,58 @@ def scatter(ctx, ins, attrs):
     return out(Out=o)
 
 
+@register_op("minus")
+def minus(ctx, ins, attrs):
+    """reference: operators/minus_op.cc — Out = X - Y (no broadcast
+    axis; the reference grad maker is scale(-1), jax AD matches)."""
+    return out(Out=first(ins, "X") - first(ins, "Y"))
+
+
+@register_op("is_empty")
+def is_empty(ctx, ins, attrs):
+    """reference: operators/is_empty_op.cc — (1,) bool, true iff the
+    tensor has zero elements (same (1,) scalar convention as
+    array_length / max_sequence_len).  Shapes are static under XLA so
+    this folds to a constant at trace time."""
+    x = first(ins, "X")
+    return out(Out=jnp.asarray([x.size == 0], dtype=jnp.bool_))
+
+
+@register_op("cos_sim")
+def cos_sim(ctx, ins, attrs):
+    """reference: operators/cos_sim_op.cc — row-wise cosine similarity
+    over all non-batch dims; Y's batch dim may be 1 (broadcast).
+    Outputs Out (N, 1) plus the XNorm/YNorm intermediates the reference
+    exposes for its grad kernel (jax AD doesn't need them, but parity
+    tests read them)."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    xf = x.reshape(x.shape[0], -1)
+    yf = y.reshape(y.shape[0], -1)
+    eps = 1e-12
+    xn = jnp.sqrt(jnp.sum(xf * xf, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(yf * yf, axis=1, keepdims=True))
+    dot = jnp.sum(xf * yf, axis=1, keepdims=True)
+    o = dot / jnp.maximum(xn * yn, eps)
+    return out(Out=o, XNorm=xn, YNorm=yn)
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ctx, ins, attrs):
+    """reference: operators/pad_constant_like_op.cc — pad Y at the HIGH
+    edge of every axis up to X's shape; Out.shape == X.shape."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.ndim != y.ndim:
+        raise ValueError(
+            f"pad_constant_like: rank mismatch {x.ndim} vs {y.ndim}")
+    cfg = [(0, xd - yd) for xd, yd in zip(x.shape, y.shape)]
+    if any(b < 0 for _, b in cfg):
+        raise ValueError(
+            f"pad_constant_like: X dims {x.shape} must be >= Y dims "
+            f"{y.shape}")
+    o = jnp.pad(y, cfg, constant_values=attrs.get("pad_value", 0.0))
+    return out(Out=o)
+
+
 @register_op("pad")
 def pad(ctx, ins, attrs):
     x = first(ins, "X")

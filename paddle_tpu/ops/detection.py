@@ -1044,6 +1044,128 @@ def mine_hard_examples(ctx, ins, attrs):
             "UpdatedMatchIndices": [updated]}
 
 
+@register_op("detection_map")
+def detection_map(ctx, ins, attrs):
+    """In-graph mean Average Precision (reference:
+    operators/detection_map_op.cc — 11point / integral AP per SSD eval).
+
+    Padded-dense redesign of the reference's LoD contract: DetectRes is
+    (N, M, 6) rows [label, score, xmin, ymin, xmax, ymax] (label < 0 =
+    padding), Label is (N, G, 6) rows [label, xmin, ymin, xmax, ymax,
+    difficult] (or 5 cols = no difficult flags).  Matching follows the
+    reference: per image/class, detections in descending score order
+    are each assigned their highest-IoU gt (match iff strictly IoU >
+    overlap_threshold); a det whose gt was already claimed is an FP,
+    and a difficult-gt match is ignored when evaluate_difficult is
+    false.  The reference op's cross-batch accumulation state
+    (PosCount/TruePos/FalsePos) is deliberately NOT in-graph — state
+    lives host-side in metrics.DetectionMAP, keeping the op pure for
+    jit (divergence documented, SURVEY.md §5.7 segment style).
+    Output MAP is a scalar fraction."""
+    det = first(ins, "DetectRes")
+    gt = first(ins, "Label")
+    class_num = int(attrs["class_num"])
+    bg = attrs.get("background_label", 0)
+    thr = attrs.get("overlap_threshold", 0.3)
+    eval_difficult = attrs.get("evaluate_difficult", True)
+    ap_type = attrs.get("ap_type", "integral")
+
+    n, m, _ = det.shape
+    g = gt.shape[1]
+    det_lbl = det[:, :, 0].astype(jnp.int32)
+    det_score = det[:, :, 1]
+    # reference ClipBBox (detection_map_op.h:152): detections clamp to
+    # the normalized [0, 1] frame before IoU
+    det_box = jnp.clip(det[:, :, 2:6], 0.0, 1.0)
+    gt_lbl = gt[:, :, 0].astype(jnp.int32)
+    gt_box = gt[:, :, 1:5]
+    gt_diff = (gt[:, :, 5] > 0.5 if gt.shape[2] > 5
+               else jnp.zeros((n, g), bool))
+    det_valid = det_lbl >= 0
+    gt_valid = gt_lbl >= 0
+
+    def per_image(dl, ds, db, gl, gb, gd, dv, gv):
+        iou = _iou_matrix(db, gb)  # (M, G)
+        order = jnp.argsort(-jnp.where(dv, ds, -jnp.inf))
+
+        # reference loop (detection_map_op.h:378-414): each detection is
+        # assigned to its max-overlap same-class gt REGARDLESS of
+        # visited state; if max_overlap > thr (strict) and that gt was
+        # already claimed by a higher-scored det, the det is a plain FP.
+        # A difficult gt match with evaluate_difficult=False contributes
+        # neither tp nor fp and does not mark the gt visited.
+        def step(visited, di):
+            same = (gl == dl[di]) & gv
+            iou_i = jnp.where(same, iou[di], -1.0)
+            j = jnp.argmax(iou_i)
+            hit = (iou_i[j] > thr) & dv[di]
+            med = bool(eval_difficult) | ~gd[j]
+            tp = hit & med & ~visited[j]
+            fp = dv[di] & (~hit | (hit & med & visited[j]))
+            return visited | jnp.zeros_like(visited).at[j].set(tp), \
+                (di, tp, fp)
+
+        _, (idx, tp, fp) = lax.scan(step, jnp.zeros((g,), bool), order)
+        # scatter flags back to original det positions
+        tp_o = jnp.zeros((m,), bool).at[idx].set(tp)
+        fp_o = jnp.zeros((m,), bool).at[idx].set(fp)
+        return tp_o, fp_o
+
+    tp, fp = jax.vmap(per_image)(det_lbl, det_score, det_box, gt_lbl,
+                                 gt_box, gt_diff, det_valid, gt_valid)
+
+    # per-class AP over the flattened batch
+    flat_lbl = det_lbl.reshape(-1)
+    flat_score = det_score.reshape(-1)
+    flat_tp = tp.reshape(-1)
+    flat_fp = fp.reshape(-1)
+    order = jnp.argsort(-flat_score)
+    flat_lbl, flat_tp, flat_fp = (flat_lbl[order], flat_tp[order],
+                                  flat_fp[order])
+
+    counts_gt = gt_lbl.reshape(-1)
+    counts_diff = gt_diff.reshape(-1)
+    counts_valid = gt_valid.reshape(-1)
+
+    def class_ap(c):
+        npos = jnp.sum(counts_valid & (counts_gt == c)
+                       & (eval_difficult | ~counts_diff))
+        # only counted dets of this class (ignored difficult-matches
+        # have tp=fp=False and drop out of precision's denominator,
+        # matching the reference's unrecorded pairs)
+        mine = (flat_lbl == c) & (flat_tp | flat_fp)
+        ctp = jnp.cumsum(jnp.where(mine, flat_tp, 0))
+        cfp = jnp.cumsum(jnp.where(mine, flat_fp, 0))
+        denom = jnp.maximum(ctp + cfp, 1)
+        prec = ctp / denom
+        rec = ctp / jnp.maximum(npos, 1)
+        if ap_type == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jnp.max(
+                jnp.where(mine[None, :] & (rec[None, :] >= pts[:, None]),
+                          prec[None, :], 0.0), axis=1)
+            ap = jnp.mean(pmax)
+        else:
+            # integral: precision * delta-recall summed — delta-recall
+            # is 1/npos exactly at each new TP (detection_map_op.h:459)
+            new_tp = jnp.where(mine, flat_tp, False)
+            ap = jnp.sum(jnp.where(new_tp, prec, 0.0)) / jnp.maximum(
+                npos, 1)
+        # the reference averages over classes that have BOTH gt
+        # positives and at least one recorded detection
+        # (detection_map_op.h:423-427; its `label_num_pos ==
+        # background_label` count-vs-id comparison is a quirk we do not
+        # replicate beyond its bg=0 no-op effect)
+        return ap, (npos > 0) & jnp.any(mine)
+
+    classes = jnp.array([c for c in range(class_num) if c != bg],
+                        dtype=jnp.int32)
+    aps, has = jax.vmap(class_ap)(classes)
+    n_eval = jnp.maximum(jnp.sum(has), 1)
+    mean_ap = jnp.sum(jnp.where(has, aps, 0.0)) / n_eval
+    return {"MAP": [mean_ap]}
+
+
 @register_op("polygon_box_transform")
 def polygon_box_transform(ctx, ins, attrs):
     """EAST-style geometry decode (reference

@@ -286,6 +286,12 @@ def fused_vocab_ce(hidden, weight, labels, epsilon=0.0,
         raise ValueError(
             f"fused_vocab_ce: {h2.shape[0]} tokens but "
             f"{lbl.shape[0]} labels")
+    # out-of-range labels clamp into [0, V) exactly like the non-fused
+    # path's take_along_axis (mode='clip'); without this an invalid id
+    # would leave the running target-logit at NEG and surface as a ~1e30
+    # loss only on the fused path — a data bug must not look like a
+    # backend bug
+    lbl = jnp.clip(lbl, 0, weight.shape[1] - 1)
     loss = _fused_ce(h2, weight, lbl, float(epsilon), int(block_t),
                      int(block_v))
     return loss.reshape(lead)

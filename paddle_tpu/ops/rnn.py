@@ -287,6 +287,85 @@ def lstmp(ctx, ins, attrs):
     }
 
 
+@register_op("attention_lstm")
+def attention_lstm(ctx, ins, attrs):
+    """Fused attention-LSTM (reference: operators/attention_lstm_op.cc,
+    a jit-fused CPU kernel).  Per step, an additive attention over the
+    sequence's OWN inputs conditioned on the previous cell produces the
+    LSTM input:
+      score_j = relu(x_j @ aw[:M] + ab + c_{t-1} @ aw[M:])
+      [score = relu(scalar * score + scalar_bias)]   (optional)
+      p = softmax over valid j;   lstm_x = sum_j p_j x_j
+    then one standard LSTM step.  LSTMWeight is (D+M, 4D) with rows
+    [hidden; input] and gate order [forget, input, output, candidate]
+    (the reference's concat order).  X is padded (N, T, M) with the
+    SeqLen companion instead of LoD; Hidden/Cell are (N, T, D)."""
+    from .sequence import _reject_nested
+
+    _reject_nested(ins, "attention_lstm")
+    x = first(ins, "X")
+    c0 = first(ins, "C0")
+    h0 = opt_in(ins, "H0")
+    aw = first(ins, "AttentionWeight")
+    ab = opt_in(ins, "AttentionBias")
+    a_scalar = opt_in(ins, "AttentionScalar")
+    a_scalar_b = opt_in(ins, "AttentionScalarBias")
+    lw = first(ins, "LSTMWeight")
+    lb = first(ins, "LSTMBias")
+    seq_len = opt_in(ins, "SeqLen")
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+
+    n, t, m = x.shape
+    d = lw.shape[1] // 4
+    w_h, w_x = lw[:d], lw[d:]
+    aw = aw.reshape(-1)
+    aw_x, aw_c = aw[:m], aw[m:]
+    if seq_len is None:
+        valid = jnp.ones((n, t), bool)
+    else:
+        valid = jnp.arange(t)[None, :] < seq_len[:, None]
+
+    # attention's x-projection is step-invariant: hoist out of the scan
+    att_x = x @ aw_x  # (N, T)
+    if ab is not None:
+        att_x = att_x + ab.reshape(-1)[0]
+
+    h_prev = h0 if h0 is not None else jnp.zeros((n, d), x.dtype)
+    c_prev = c0
+
+    def step(carry, _):
+        h, c = carry
+        score = jnp.maximum(att_x + (c @ aw_c)[:, None], 0.0)
+        if a_scalar is not None:
+            score = score * a_scalar.reshape(-1)[0]
+            if a_scalar_b is not None:
+                score = score + a_scalar_b.reshape(-1)[0]
+            score = jnp.maximum(score, 0.0)
+        # finite mask value, NOT -inf: a seq_len==0 row would make the
+        # softmax all-(-inf) -> NaN, and the NaN survives into weight
+        # grads through the backward even though the forward output is
+        # masked.  With -1e30 the row softmaxes to uniform, then p is
+        # zeroed so the row contributes nothing either way.
+        score = jnp.where(valid, score, -1e30)
+        p = jnp.where(valid, jax.nn.softmax(score, axis=1), 0.0)
+        lstm_x = jnp.einsum("nt,ntm->nm", p, x)
+        gates = lstm_x @ w_x + h @ w_h + lb.reshape(-1)
+        f, i, o, cand = jnp.split(gates, 4, axis=-1)
+        c_new = gate_act(f) * c + gate_act(i) * cand_act(cand)
+        h_new = gate_act(o) * cell_act(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h_prev, c_prev), None, length=t)
+    hs = jnp.swapaxes(hs, 0, 1)  # (N, T, D)
+    cs = jnp.swapaxes(cs, 0, 1)
+    # zero padded steps so downstream sequence pools see clean tails
+    hs = jnp.where(valid[..., None], hs, 0.0)
+    cs = jnp.where(valid[..., None], cs, 0.0)
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
 @register_op("row_conv")
 def row_conv(ctx, ins, attrs):
     """Lookahead row convolution (reference row_conv_op.cc): X (N, T, D),

@@ -212,6 +212,8 @@ def convert_to_int8(program: Program, scope=None):
     plans = {}          # op idx -> plan dict
     weight_users = {}   # w_src -> list of (idx, convertible, transpose)
     raw_weight_readers = {}  # w_src -> # non-qdq ops reading it
+    weight_qdq_outs = {}     # w_src -> QDQ output names carrying it
+    qdq_out_consumers = {}   # QDQ output name -> op idxs consuming it
     for idx, op in enumerate(block.ops):
         t = op.desc.type
         if t not in QUANTIZABLE_OPS:
@@ -238,12 +240,15 @@ def convert_to_int8(program: Program, scope=None):
                 attrs["x_num_col_dims"] = max(act_rank - 1, 1)
                 attrs["y_num_col_dims"] = 1
         transpose = _wants_transpose(t, attrs)
+        qdq_out = op.desc.inputs[w_slot][0]
         plans[idx] = dict(t=t, act_src=act_src, in_scale=in_scale,
                           w_src=w_src, w_scale=w_scale, attrs=attrs,
                           transpose=transpose)
         weight_users.setdefault(w_src, []).append(
             (idx, convertible, transpose))
-    for op in block.ops:
+        weight_qdq_outs.setdefault(w_src, set()).add(qdq_out)
+    qdq_out_names = {n for outs in weight_qdq_outs.values() for n in outs}
+    for idx, op in enumerate(block.ops):
         if op.desc.type in _QDQ_TYPES:
             continue
         for names in op.desc.inputs.values():
@@ -251,13 +256,24 @@ def convert_to_int8(program: Program, scope=None):
                 if n in weight_users:
                     raw_weight_readers[n] = \
                         raw_weight_readers.get(n, 0) + 1
+                if n in qdq_out_names:
+                    qdq_out_consumers.setdefault(n, set()).add(idx)
 
     ok_weights = {}
     for w_src, users in weight_users.items():
         transposes = {tr for _, conv, tr in users}
+        planned = {i for i, conv, _ in users if conv}
+        # the weight's fake-QDQ OUTPUT must be consumed ONLY by the
+        # convertible quantizable ops — any other consumer would, after
+        # conversion, see the retained QDQ op dequantize the int8 codes
+        # as floats (values off by ~scale/qmax)
+        qdq_clean = all(
+            qdq_out_consumers.get(n, set()) <= planned
+            for n in weight_qdq_outs.get(w_src, ()))
         if (all(conv for _, conv, _ in users)
                 and len(transposes) == 1
-                and raw_weight_readers.get(w_src, 0) == 0):
+                and raw_weight_readers.get(w_src, 0) == 0
+                and qdq_clean):
             ok_weights[w_src] = transposes.pop()
 
     # ---- pass 2: apply.

@@ -360,3 +360,90 @@ def test_generate_proposals_smoke():
     assert (v[:, 2] > v[:, 0]).all() and (v[:, 3] > v[:, 1]).all()
     # padding is zeros
     np.testing.assert_allclose(rois[0, n_valid:], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# detection_map (reference: operators/detection_map_op.h CalcTrueAndFalse
+# Positive + CalcMAP — hand-computed parity cases)
+# ---------------------------------------------------------------------------
+
+def _dm(det, gt, **attrs):
+    a = dict(class_num=2, background_label=0, overlap_threshold=0.5,
+             evaluate_difficult=True, ap_type="integral")
+    a.update(attrs)
+    return float(run_op("detection_map",
+                        {"DetectRes": np.asarray(det, np.float32),
+                         "Label": np.asarray(gt, np.float32)},
+                        attrs=a, out_slot="MAP"))
+
+
+def test_detection_map_visited_gt_is_fp():
+    """A detection whose max-overlap gt was already claimed by a
+    higher-scored det is an FP — it does NOT fall through to the
+    next-best gt (detection_map_op.h:393-404 assigns argmax regardless
+    of visited state).  det2's argmax is gt A (IoU .68 > .47 for B);
+    A is visited, so FP even though B clears the threshold."""
+    det = [[[1, 0.9, 0.00, 0.00, 0.50, 0.50],    # TP on A (IoU 1.0)
+            [1, 0.8, 0.05, 0.05, 0.55, 0.55]]]   # argmax A -> visited FP
+    gt = [[[1, 0.00, 0.00, 0.50, 0.50, 0],       # A
+           [1, 0.15, 0.15, 0.65, 0.65, 0]]]      # B
+    # npos=2; sorted [TP, FP]: integral AP = 1.0 * (1/2) = 0.5
+    np.testing.assert_allclose(_dm(det, gt, overlap_threshold=0.4), 0.5,
+                               atol=1e-6)
+
+
+def test_detection_map_difficult_gt_ignored():
+    """evaluate_difficult=False: a det matching a difficult gt counts
+    neither tp nor fp, and the gt is excluded from npos."""
+    det = [[[1, 0.9, 0.00, 0.00, 0.50, 0.50],    # matches difficult A
+            [1, 0.8, 0.60, 0.60, 0.90, 0.90]]]   # TP on B
+    gt = [[[1, 0.00, 0.00, 0.50, 0.50, 1],       # A difficult
+           [1, 0.62, 0.62, 0.88, 0.88, 0]]]      # B
+    # npos=1 (B); only det2 recorded: TP -> AP = 1.0
+    np.testing.assert_allclose(
+        _dm(det, gt, evaluate_difficult=False), 1.0, atol=1e-6)
+    # with evaluate_difficult=True both count: 2 TPs, npos=2 -> 1.0
+    np.testing.assert_allclose(
+        _dm(det, gt, evaluate_difficult=True), 1.0, atol=1e-6)
+
+
+def test_detection_map_strict_threshold_and_clip():
+    """IoU exactly == threshold is NOT a match (strict >); detection
+    boxes clip to [0,1] before IoU like the reference's ClipBBox."""
+    det = [[[1, 0.9, 0.0, 0.0, 0.5, 1.0]]]
+    gt = [[[1, 0.0, 0.0, 1.0, 1.0, 0]]]          # IoU = 0.5 exactly
+    assert _dm(det, gt, overlap_threshold=0.5) == 0.0
+    # det spills outside the frame: clipped to [0,1] it IS the gt box
+    det2 = [[[1, 0.9, -0.5, -0.5, 1.5, 1.5]]]
+    np.testing.assert_allclose(
+        _dm(det2, gt, overlap_threshold=0.5), 1.0, atol=1e-6)
+
+
+def test_detection_map_11point():
+    """11-point AP: TP then FP with npos=2 -> recall tops at 0.5, max
+    precision 1.0 for the 6 points r<=0.5, 0 beyond -> 6/11."""
+    det = [[[1, 0.9, 0.00, 0.00, 0.50, 0.50],
+            [1, 0.8, 0.05, 0.05, 0.55, 0.55]]]
+    gt = [[[1, 0.00, 0.00, 0.50, 0.50, 0],
+           [1, 0.15, 0.15, 0.65, 0.65, 0]]]
+    np.testing.assert_allclose(
+        _dm(det, gt, overlap_threshold=0.4, ap_type="11point"), 6 / 11,
+        atol=1e-6)
+
+
+def test_detection_map_layer_in_program():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        d = layers.data("d", shape=[1, 2, 6], append_batch_size=False)
+        g = layers.data("g", shape=[1, 2, 6], append_batch_size=False)
+        m = layers.detection.detection_map(d, g, class_num=2,
+                                           overlap_threshold=0.4)
+        exe = fluid.Executor()
+        exe.run(startup)
+        det = np.array([[[1, 0.9, 0.0, 0.0, 0.5, 0.5],
+                         [1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+        gt = np.array([[[1, 0.05, 0.05, 0.45, 0.45, 0],
+                        [1, 0.62, 0.62, 0.88, 0.88, 0]]], np.float32)
+        (v,) = exe.run(main, feed={"d": det, "g": gt}, fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(v), 1.0, atol=1e-6)

@@ -154,6 +154,49 @@ def test_convert_skips_inexpressible_matmul_variants(tmp_path):
                                    atol=0.02)
 
 
+def test_weight_with_foreign_qdq_consumer_stays_float(tmp_path):
+    """ADVICE r3 (low): a weight whose fake-QDQ OUTPUT also feeds an op
+    that won't convert must stay float — converting it would leave that
+    consumer dequantizing int8 codes as floats.  Here `w` is the weight
+    of matmul(a, w) but ALSO the activation of matmul(w, v); the shared
+    QDQ output disqualifies `w` while `v` still converts."""
+    import paddle_tpu.quantize as pq
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(7)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        a = layers.data(name="a", shape=[6], dtype="float32")
+        w = LayerHelper("fw").create_parameter(
+            ParamAttr(name="mixed_w"), shape=[6, 3], dtype="float32")
+        v = LayerHelper("fv").create_parameter(
+            ParamAttr(name="pure_v"), shape=[3, 4], dtype="float32")
+        out1 = layers.matmul(a, w)          # w as weight (convertible)
+        out2 = layers.matmul(w, v)          # w as activation of another op
+        both = layers.elementwise_add(
+            layers.reduce_sum(out1), layers.reduce_sum(out2))
+        fluid.QuantizeTranspiler().training_transpile(main, startup)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"a": rng.rand(8, 6).astype(np.float32)}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[both])
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed=feed, fetch_list=[both])
+
+        converted = pq.convert_to_int8(infer, fluid.global_scope())
+        wv = np.asarray(fluid.global_scope().find_var("mixed_w"))
+        vv = np.asarray(fluid.global_scope().find_var("pure_v"))
+        assert wv.dtype == np.float32, "mixed-consumer weight must stay float"
+        assert vv.dtype == np.int8, "clean weight should still convert"
+        assert len(converted) == 1
+        got, = exe.run(infer, feed=feed, fetch_list=[both])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0.05, atol=0.05)
+
+
 def test_shared_weight_converts_once_with_true_scale(tmp_path):
     """A weight feeding two quantizable ops quantizes ONCE from its
     float value (re-reading after conversion would fabricate a ~127

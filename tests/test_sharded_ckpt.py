@@ -157,6 +157,44 @@ def test_sharded_roundtrip_host_fallback(tmp_path):
             np.testing.assert_array_equal(got, arr, err_msg=name)
 
 
+def test_bf16_state_roundtrip(tmp_path):
+    """ADVICE r3 (medium): np.savez stores ml_dtypes arrays as void
+    ('|V2'); save must stay loadable for bf16 persistables — both the
+    sharded and the plain paths reinterpret via the manifest dtype."""
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        _build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        gs = fluid.global_scope()
+        names = [v.name for v in main.list_vars() if v.persistable]
+        target = names[0]
+        bf = jnp.asarray(np.asarray(gs.find_var(target)), jnp.bfloat16)
+        gs.set_var(target, bf)
+        want = np.asarray(bf)
+
+        ck1 = str(tmp_path / "sharded")
+        fluid.io.save_sharded(exe, ck1, main_program=main)
+        gs.set_var(target, jnp.zeros_like(bf))
+        fluid.io.load_sharded(exe, ck1, main_program=main)
+        got = np.asarray(gs.find_var(target))
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(got, want)
+
+        ck2 = str(tmp_path / "plain")
+        gs.set_var(target, bf)
+        fluid.io.save_persistables(exe, ck2, main_program=main)
+        gs.set_var(target, jnp.zeros_like(bf))
+        fluid.io.load_persistables(exe, ck2, main_program=main)
+        got = np.asarray(gs.find_var(target))
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(got, want)
+
+
 def test_load_sharded_missing_var_raises(tmp_path):
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()

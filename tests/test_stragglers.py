@@ -551,3 +551,61 @@ def test_nested_expand_then_pool_roundtrip_in_graph():
     assert pv.shape == (2, 4, 2)
     np.testing.assert_allclose(pv[0, 0], [3.0, 3.0])
     np.testing.assert_allclose(pv[1, 0], [2.0, 2.0])
+
+
+# ---------------------------------------------------------------------------
+# round-4 op tail regressions (code-review findings)
+# ---------------------------------------------------------------------------
+
+def test_attention_lstm_zero_length_row_finite_grads():
+    """A seq_len==0 row must not NaN the weight grads: the attention
+    softmax masks with a finite -1e30 (not -inf) and zeroes p, so the
+    empty row contributes nothing anywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import OpContext, get_op_impl
+
+    impl = get_op_impl("attention_lstm")
+    rng = np.random.RandomState(0)
+    n, t, m, d = 2, 3, 4, 2
+    x = jnp.asarray(rng.randn(n, t, m), jnp.float32)
+    c0 = jnp.asarray(rng.randn(n, d), jnp.float32)
+    aw = jnp.asarray(rng.randn(m + d, 1), jnp.float32)
+    lw = jnp.asarray(rng.randn(d + m, 4 * d) * 0.3, jnp.float32)
+    lb = jnp.zeros((1, 4 * d), jnp.float32)
+    seq = jnp.asarray([2, 0], jnp.int32)  # second row EMPTY
+
+    def loss(lw_, aw_, x_):
+        outs = impl(OpContext(jax.random.PRNGKey(0), 0),
+                    {"X": [x_], "C0": [c0], "AttentionWeight": [aw_],
+                     "LSTMWeight": [lw_], "LSTMBias": [lb],
+                     "SeqLen": [seq]}, {})
+        return jnp.sum(outs["Hidden"][0])
+
+    g_lw, g_aw, g_x = jax.grad(loss, argnums=(0, 1, 2))(lw, aw, x)
+    for g in (g_lw, g_aw, g_x):
+        assert np.isfinite(np.asarray(g)).all(), "NaN grad from empty row"
+    # the empty row's inputs get exactly zero gradient
+    np.testing.assert_allclose(np.asarray(g_x)[1], 0.0)
+
+
+def test_teacher_student_sigmoid_loss_label_boundaries():
+    """Branch boundaries match the public op (label <-1 / <0 / <1 /
+    else): label==1.0 is clk=1 with teacher score 0."""
+    from tests.op_test import run_op
+
+    x = np.array([[0.3], [0.3], [0.3], [0.3]], np.float32)
+    lbl = np.array([[-2.0], [-1.0], [0.0], [1.0]], np.float32)
+    y = run_op("teacher_student_sigmoid_loss", {"X": x, "Label": lbl},
+               out_slot="Y")
+
+    def bce(z, t):
+        return max(z, 0) - z * t + np.log1p(np.exp(-abs(z)))
+
+    z = 0.3
+    expect = [bce(z, 0),                 # -2: clk0, no teacher
+              bce(z, 1),                 # -1: clk1, no teacher
+              bce(z, 0) + bce(z, 0.0),   # 0: clk0, teacher 0
+              bce(z, 1) + bce(z, 0.0)]   # 1: clk1, teacher 1-1=0
+    np.testing.assert_allclose(y.reshape(-1), expect, rtol=1e-5)

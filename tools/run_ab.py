@@ -1,0 +1,95 @@
+"""A/B benchmark driver (VERDICT r3 item 1b): run bench.py once per
+perf-feature configuration on the real chip and write a combined
+AB_r04.json artifact with the winners, so every bench default reflects a
+measured win.
+
+Usage: python tools/run_ab.py [--steps N] [--out AB_r04.json]
+Each variant is a separate bench.py subprocess (fresh backend, no cache
+cross-talk); the probe inside bench.py keeps a dead backend from
+burning the timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANTS = [
+    # (key, argv fragment)
+    ("resnet50_nchw", ["--model", "resnet50", "--layout", "NCHW"]),
+    ("resnet50_nhwc", ["--model", "resnet50", "--layout", "NHWC"]),
+    ("transformer_base", ["--model", "transformer"]),
+    ("transformer_fused_ce", ["--model", "transformer", "--fused-ce"]),
+    ("transformer_fused_qkv", ["--model", "transformer", "--fused-qkv"]),
+    ("transformer_fused_both", ["--model", "transformer", "--fused-ce",
+                                "--fused-qkv"]),
+]
+
+
+def run_variant(args, extra):
+    cmd = [sys.executable, "bench.py", "--steps", str(args.steps)] + extra
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=args.timeout,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+    except subprocess.TimeoutExpired:
+        return {"error": f"variant timed out after {args.timeout}s"}
+    line = None
+    for ln in reversed(r.stdout.strip().splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+            break
+    if line is None:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+        return {"error": "no JSON line: " + " | ".join(tail)}
+    out = json.loads(line)
+    out["wall_s"] = round(time.time() - t0, 1)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--timeout", type=int, default=1200)
+    p.add_argument("--out", default="AB_r04.json")
+    p.add_argument("--only", default=None,
+                   help="comma-separated variant keys to run")
+    args = p.parse_args()
+
+    results = {}
+    for key, extra in VARIANTS:
+        if args.only and key not in args.only.split(","):
+            continue
+        print(f"=== {key}: bench.py {' '.join(extra)}", file=sys.stderr)
+        results[key] = run_variant(args, extra)
+        print(json.dumps({key: results[key]}), file=sys.stderr)
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
+    def mfu(k):
+        d = results.get(k, {})
+        return d.get("value") if "error" not in d else None
+
+    summary = {
+        "nhwc_wins": (mfu("resnet50_nhwc") or 0)
+        > (mfu("resnet50_nchw") or 0),
+        "fused_ce_wins": (mfu("transformer_fused_ce") or 0)
+        > (mfu("transformer_base") or 0),
+        "fused_qkv_wins": (mfu("transformer_fused_qkv") or 0)
+        > (mfu("transformer_base") or 0),
+    }
+    results["summary"] = summary
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
