@@ -1,16 +1,38 @@
 """Built-in datasets.
 
 reference: python/paddle/dataset/ — mnist, cifar, uci_housing, imdb,
-imikolov, movielens, wmt14/16 auto-download readers.  This environment is
-zero-egress, so each dataset is a deterministic synthetic generator with
-the REAL dataset's shapes, dtypes, and label spaces (documented
-divergence); plug a download-backed reader in by replacing the generator
-while keeping the reader contract (zero-arg callable yielding samples).
+imikolov, movielens, wmt14/16 auto-download readers.  This environment
+is zero-egress, so downloading is impossible; instead each dataset has
+BOTH:
+
+- a real-format file parser (`reader_creator` / `data_dir=` arg) that
+  ingests the dataset's actual on-disk format — MNIST idx-ubyte .gz
+  (dataset/mnist.py:43 reader_creator), CIFAR python-pickle tar
+  (dataset/cifar.py reader_creator), UCI housing whitespace table with
+  the reference's avg/min-max normalization (uci_housing.py:68
+  load_data) — used whenever files are present (point `data_dir` or
+  $PADDLE_DATASET_HOME at them), and
+- a deterministic synthetic generator with the real shapes/dtypes/label
+  spaces as the zero-egress fallback.
+
+The reader contract is the reference's: zero-arg callable yielding
+samples.
 """
 
 from __future__ import annotations
 
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
 import numpy as np
+
+
+def _dataset_home(sub):
+    home = os.environ.get("PADDLE_DATASET_HOME")
+    return os.path.join(home, sub) if home else None
 
 
 def _synthetic_classification(n, feature_shape, num_classes, seed,
@@ -31,28 +53,122 @@ def _synthetic_classification(n, feature_shape, num_classes, seed,
 
 
 class mnist:
-    """28x28 grayscale digits, labels 0-9 (dataset/mnist.py shapes)."""
+    """28x28 grayscale digits, labels 0-9 (dataset/mnist.py)."""
+
+    TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+    TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+    TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+    TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
 
     @staticmethod
-    def train(n=60000, seed=0):
+    def reader_creator(image_filename, label_filename):
+        """Parse the REAL idx-ubyte format (dataset/mnist.py:43): gzip'd
+        big-endian headers (magic 2051 images / 2049 labels), raw u8
+        pixels scaled to [-1, 1) exactly like the reference
+        (`images / 255.0 * 2.0 - 1.0`); yields (flat f32 784, int)."""
+
+        def reader():
+            with gzip.open(image_filename, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                if magic != 2051:
+                    raise IOError(
+                        f"bad idx3 magic {magic} in {image_filename}")
+                images = np.frombuffer(f.read(n * rows * cols),
+                                       np.uint8).reshape(n, rows * cols)
+            with gzip.open(label_filename, "rb") as f:
+                magic, ln = struct.unpack(">II", f.read(8))
+                if magic != 2049:
+                    raise IOError(
+                        f"bad idx1 magic {magic} in {label_filename}")
+                labels = np.frombuffer(f.read(ln), np.uint8)
+            if ln != n:
+                raise IOError(f"mnist: {n} images but {ln} labels")
+            imgs = images.astype(np.float32) / 255.0 * 2.0 - 1.0
+            for i in range(n):
+                yield imgs[i], int(labels[i])
+
+        return reader
+
+    @staticmethod
+    def _files_in(data_dir, img, lbl):
+        if data_dir is None:
+            data_dir = _dataset_home("mnist")
+        if data_dir is None:
+            return None
+        pi, pl = os.path.join(data_dir, img), os.path.join(data_dir, lbl)
+        return (pi, pl) if (os.path.exists(pi)
+                            and os.path.exists(pl)) else None
+
+    @staticmethod
+    def train(n=60000, seed=0, data_dir=None):
+        real = mnist._files_in(data_dir, mnist.TRAIN_IMAGES,
+                               mnist.TRAIN_LABELS)
+        if real:
+            return mnist.reader_creator(*real)
         return _synthetic_classification(n, (1, 28, 28), 10, seed)
 
     @staticmethod
-    def test(n=10000, seed=7):
+    def test(n=10000, seed=7, data_dir=None):
+        real = mnist._files_in(data_dir, mnist.TEST_IMAGES,
+                               mnist.TEST_LABELS)
+        if real:
+            return mnist.reader_creator(*real)
         return _synthetic_classification(n, (1, 28, 28), 10, seed)
 
 
 class cifar:
     @staticmethod
-    def train10(n=50000, seed=1):
+    def reader_creator(filename, sub_name):
+        """Parse the REAL python-pickle tar format (dataset/cifar.py
+        reader_creator): members whose name contains `sub_name` hold
+        dicts with b'data' (N, 3072 u8) and b'labels'/b'fine_labels';
+        pixels scale to [0, 1] f32 like the reference."""
+
+        def reader():
+            with tarfile.open(filename, mode="r") as f:
+                names = [m.name for m in f if sub_name in m.name]
+                for name in sorted(names):
+                    batch = pickle.load(f.extractfile(name),
+                                        encoding="bytes")
+                    data = batch[b"data"]
+                    labels = batch.get(b"labels",
+                                       batch.get(b"fine_labels"))
+                    if labels is None:
+                        raise IOError(f"no labels in {name}")
+                    for row, label in zip(data, labels):
+                        yield ((np.asarray(row, np.uint8) / 255.0)
+                               .astype(np.float32), int(label))
+
+        return reader
+
+    @staticmethod
+    def _tar(data_dir, fname):
+        if data_dir is None:
+            data_dir = _dataset_home("cifar")
+        if data_dir is None:
+            return None
+        p = os.path.join(data_dir, fname)
+        return p if os.path.exists(p) else None
+
+    @staticmethod
+    def train10(n=50000, seed=1, data_dir=None):
+        p = cifar._tar(data_dir, "cifar-10-python.tar.gz")
+        if p:
+            return cifar.reader_creator(p, "data_batch")
         return _synthetic_classification(n, (3, 32, 32), 10, seed)
 
     @staticmethod
-    def test10(n=10000, seed=8):
+    def test10(n=10000, seed=8, data_dir=None):
+        p = cifar._tar(data_dir, "cifar-10-python.tar.gz")
+        if p:
+            return cifar.reader_creator(p, "test_batch")
         return _synthetic_classification(n, (3, 32, 32), 10, seed)
 
     @staticmethod
-    def train100(n=50000, seed=2):
+    def train100(n=50000, seed=2, data_dir=None):
+        p = cifar._tar(data_dir, "cifar-100-python.tar.gz")
+        if p:
+            return cifar.reader_creator(p, "train")
         return _synthetic_classification(n, (3, 32, 32), 100, seed)
 
 
@@ -69,8 +185,46 @@ class flowers:
 class uci_housing:
     """13 features → scalar price (dataset/uci_housing.py)."""
 
+    FEATURE_NUM = 14
+
     @staticmethod
-    def train(n=404, seed=4):
+    def load_data(filename, feature_num=14, ratio=0.8):
+        """Parse the REAL whitespace table and normalize exactly like
+        the reference (uci_housing.py:68): per-feature
+        (x - avg) / (max - min) on the 13 inputs, 80/20 split."""
+        data = np.fromfile(filename, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        return data[:offset], data[offset:]
+
+    @staticmethod
+    def _real_reader(data_dir, part):
+        if data_dir is None:
+            data_dir = _dataset_home("uci_housing")
+        if data_dir is None:
+            return None
+        p = os.path.join(data_dir, "housing.data")
+        if not os.path.exists(p):
+            return None
+        tr, te = uci_housing.load_data(p)
+        rows = tr if part == "train" else te
+
+        def reader():
+            for row in rows:
+                yield (row[:-1].astype(np.float32),
+                       np.asarray([row[-1]], np.float32))
+
+        return reader
+
+    @staticmethod
+    def train(n=404, seed=4, data_dir=None):
+        real = uci_housing._real_reader(data_dir, "train")
+        if real:
+            return real
         rng = np.random.RandomState(seed)
         w = rng.randn(13).astype(np.float32)
 
@@ -83,7 +237,14 @@ class uci_housing:
 
         return reader
 
-    test = train
+    @staticmethod
+    def test(n=404, seed=4, data_dir=None):
+        real = uci_housing._real_reader(data_dir, "test")
+        if real:
+            return real
+        # forward the SAME data_dir: a typo'd explicit dir must not
+        # re-resolve the env home and hand back real train data
+        return uci_housing.train(n, seed, data_dir=data_dir)
 
 
 class imdb:
@@ -91,13 +252,78 @@ class imdb:
     (dataset/imdb.py)."""
 
     word_dict_size = 5147
+    TAR = "aclImdb_v1.tar.gz"
+
+    # -- real-format path (dataset/imdb.py tokenize/build_dict/
+    # reader_creator over the aclImdb tar: pos label 0, neg label 1) --
+    @staticmethod
+    def tokenize(tar_path, pattern):
+        import re
+        import string
+
+        rx = re.compile(pattern)
+        with tarfile.open(tar_path) as tarf:
+            for tf in tarf:
+                if rx.match(tf.name):
+                    text = tarf.extractfile(tf).read().rstrip(b"\n\r")
+                    text = text.translate(
+                        None, string.punctuation.encode("latin-1"))
+                    yield text.lower().split()
+
+    # the reference's corpus pattern/cutoff (dataset/imdb.py word_dict):
+    # labeled train+test docs only (unsup/ and urls_*.txt excluded),
+    # words kept above 150 occurrences
+    DICT_PATTERN = r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"
 
     @staticmethod
-    def word_dict():
+    def build_dict(tar_path, pattern=DICT_PATTERN, cutoff=150):
+        freq: dict = {}
+        for doc in imdb.tokenize(tar_path, pattern):
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted((w for w, c in freq.items() if c > cutoff),
+                       key=lambda w: (-freq[w], w))
+        idx = {w: i for i, w in enumerate(words)}
+        idx[b"<unk>"] = len(idx)
+        return idx
+
+    @staticmethod
+    def reader_creator(tar_path, pos_pattern, neg_pattern, word_idx):
+        unk = word_idx[b"<unk>"]
+
+        def reader():
+            for pattern, label in ((pos_pattern, 0), (neg_pattern, 1)):
+                for doc in imdb.tokenize(tar_path, pattern):
+                    yield [word_idx.get(w, unk) for w in doc], label
+
+        return reader
+
+    @staticmethod
+    def _tar(data_dir):
+        if data_dir is None:
+            data_dir = _dataset_home("imdb")
+        if data_dir is None:
+            return None
+        p = os.path.join(data_dir, imdb.TAR)
+        return p if os.path.exists(p) else None
+
+    @staticmethod
+    def word_dict(data_dir=None):
+        p = imdb._tar(data_dir)
+        if p:
+            return imdb.build_dict(p)
         return {i: i for i in range(imdb.word_dict_size)}
 
     @staticmethod
-    def train(word_dict=None, n=25000, seed=5, max_len=200):
+    def train(word_dict=None, n=25000, seed=5, max_len=200,
+              data_dir=None):
+        p = imdb._tar(data_dir)
+        if p:
+            if word_dict is None:
+                word_dict = imdb.build_dict(p)
+            return imdb.reader_creator(
+                p, r"aclImdb/train/pos/.*\.txt$",
+                r"aclImdb/train/neg/.*\.txt$", word_dict)
         vocab = imdb.word_dict_size
 
         def reader():
@@ -114,8 +340,20 @@ class imdb:
         return reader
 
     @staticmethod
-    def test(word_dict=None, n=25000, seed=11, max_len=200):
-        return imdb.train(word_dict, n, seed, max_len)
+    def test(word_dict=None, n=25000, seed=11, max_len=200,
+             data_dir=None):
+        p = imdb._tar(data_dir)
+        if p:
+            if word_dict is None:
+                word_dict = imdb.build_dict(p)
+            return imdb.reader_creator(
+                p, r"aclImdb/test/pos/.*\.txt$",
+                r"aclImdb/test/neg/.*\.txt$", word_dict)
+        # no real tar found for THIS data_dir: fall back to synthetic
+        # without re-resolving the env home (a typo'd explicit dir must
+        # not silently hand back real train data as the test set)
+        return imdb.train(word_dict, n, seed, max_len,
+                          data_dir=data_dir)
 
 
 class imikolov:

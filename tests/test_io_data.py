@@ -203,3 +203,137 @@ def test_lod_level3_rejected():
         with pytest.raises(NotImplementedError):
             layers.data("deep", shape=[2, 3, 4, 5],
                         append_batch_size=False, lod_level=3)
+
+
+# ---------------------------------------------------------------------------
+# Real-format dataset ingestion (VERDICT r3 §2.4 dataset row): parsers
+# read the datasets' ACTUAL on-disk formats; fixtures below are
+# format-faithful files written locally (zero-egress stand-in for the
+# reference's downloads).
+# ---------------------------------------------------------------------------
+
+def _write_mnist_fixture(d, n=20, seed=3):
+    import gzip
+    import struct
+
+    rng = np.random.RandomState(seed)
+    imgs = rng.randint(0, 256, (n, 28, 28)).astype(np.uint8)
+    lbls = rng.randint(0, 10, (n,)).astype(np.uint8)
+    with gzip.open(os.path.join(d, "train-images-idx3-ubyte.gz"),
+                   "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(os.path.join(d, "train-labels-idx1-ubyte.gz"),
+                   "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    return imgs, lbls
+
+
+def test_mnist_idx_format_parses(tmp_path):
+    from paddle_tpu.data import dataset
+
+    imgs, lbls = _write_mnist_fixture(str(tmp_path))
+    samples = list(dataset.mnist.train(data_dir=str(tmp_path))())
+    assert len(samples) == 20
+    x0, y0 = samples[0]
+    assert x0.shape == (784,) and x0.dtype == np.float32
+    np.testing.assert_allclose(
+        x0, imgs[0].reshape(-1).astype(np.float32) / 255.0 * 2.0 - 1.0)
+    assert y0 == int(lbls[0])
+    # corrupt magic fails loudly
+    import gzip
+    import struct
+
+    with gzip.open(os.path.join(str(tmp_path),
+                                "train-images-idx3-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">IIII", 1234, 1, 28, 28))
+    with pytest.raises(IOError, match="magic"):
+        list(dataset.mnist.train(data_dir=str(tmp_path))())
+
+
+def test_cifar_pickle_tar_parses(tmp_path):
+    import io as _io
+    import pickle
+    import tarfile
+
+    from paddle_tpu.data import dataset
+
+    rng = np.random.RandomState(4)
+    data = rng.randint(0, 256, (8, 3072)).astype(np.uint8)
+    labels = rng.randint(0, 10, (8,)).tolist()
+    tar_path = os.path.join(str(tmp_path), "cifar-10-python.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as t:
+        for name, sl in (("cifar-10-batches-py/data_batch_1",
+                          slice(0, 5)),
+                         ("cifar-10-batches-py/test_batch",
+                          slice(5, 8))):
+            payload = pickle.dumps({b"data": data[sl],
+                                    b"labels": labels[sl]})
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            t.addfile(info, _io.BytesIO(payload))
+    train = list(dataset.cifar.train10(data_dir=str(tmp_path))())
+    test = list(dataset.cifar.test10(data_dir=str(tmp_path))())
+    assert len(train) == 5 and len(test) == 3
+    np.testing.assert_allclose(train[0][0],
+                               data[0].astype(np.float32) / 255.0)
+    assert train[0][1] == labels[0]
+
+
+def test_uci_housing_table_parses(tmp_path):
+    from paddle_tpu.data import dataset
+
+    rng = np.random.RandomState(5)
+    table = rng.rand(10, 14) * 10
+    p = os.path.join(str(tmp_path), "housing.data")
+    with open(p, "w") as f:
+        for row in table:
+            f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    train = list(dataset.uci_housing.train(data_dir=str(tmp_path))())
+    test = list(dataset.uci_housing.test(data_dir=str(tmp_path))())
+    assert len(train) == 8 and len(test) == 2  # 0.8 split
+    # reference normalization: (x - avg) / (max - min) per feature
+    maxs, mins = table.max(0), table.min(0)
+    avgs = table.mean(0)
+    want = (table[0, :13] - avgs[:13]) / (maxs[:13] - mins[:13])
+    np.testing.assert_allclose(train[0][0], want.astype(np.float32),
+                               rtol=1e-5)
+    np.testing.assert_allclose(train[0][1],
+                               [np.float32(table[0, 13])], rtol=1e-5)
+
+
+def test_imdb_aclimdb_tar_parses(tmp_path):
+    import io as _io
+    import tarfile
+
+    from paddle_tpu.data import dataset
+
+    docs = {
+        "aclImdb/train/pos/0_9.txt": b"a great great movie!",
+        "aclImdb/train/neg/0_2.txt": b"a terrible movie.",
+        "aclImdb/test/pos/0_8.txt": b"great fun",
+    }
+    tar_path = os.path.join(str(tmp_path), "aclImdb_v1.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as t:
+        for name, text in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(text)
+            t.addfile(info, _io.BytesIO(text))
+    # reference defaults (labeled-docs pattern, cutoff=150) would drop
+    # every word of this tiny fixture; build explicitly with cutoff=0
+    wd = dataset.imdb.build_dict(tar_path, cutoff=0)
+    # the dict pattern spans train+test pos/neg: 'great' freq 3 -> id 0;
+    # '<unk>' is always last, like the reference's build_dict
+    assert wd[b"great"] == 0 and wd[b"<unk>"] == len(wd) - 1
+    # the default pattern excludes unsup/ and urls_*.txt members
+    assert "unsup" not in dataset.imdb.DICT_PATTERN
+    assert dataset.imdb.build_dict.__defaults__[1] == 150
+    samples = list(dataset.imdb.train(wd, data_dir=str(tmp_path))())
+    assert len(samples) == 2
+    (pos_ids, pos_lbl), (neg_ids, neg_lbl) = samples
+    assert pos_lbl == 0 and neg_lbl == 1      # reference: pos=0, neg=1
+    assert pos_ids == [wd[b"a"], wd[b"great"], wd[b"great"],
+                       wd[b"movie"]]          # punctuation stripped
+    test_s = list(dataset.imdb.test(wd, data_dir=str(tmp_path))())
+    assert len(test_s) == 1 and test_s[0][1] == 0

@@ -1,0 +1,153 @@
+"""Roofline analysis for the headline models (VERDICT r3 item 1c).
+
+Builds the SAME amp/bf16 train step bench.py times, compiles it, and
+reads XLA's own cost analysis of the optimized program (flops, bytes
+accessed — Executor.cost_analysis).  The roofline lower bound on step
+time is
+
+    t_lb = max(flops / peak_flops, bytes / hbm_bw)
+
+and the implied MFU ceiling is t_compute / t_lb — what fraction of peak
+the chip could reach with perfect compute/HBM overlap.  Measured MFU vs
+this ceiling separates "overhead we can still close" from "the program
+is HBM-bound at this shape and N% is the roof".
+
+Run on the real chip: `python tools/roofline.py [--model all|resnet50|
+transformer] [--out ROOFLINE_r04.json]`.  Flash attention is analyzed
+through its dense twin (Pallas custom calls are invisible to the cost
+model — same convention as bench.py); pass --flash to analyze the
+actual flash program's residual byte traffic instead.  On CPU
+(BENCH_PLATFORM=cpu) fusion decisions differ — the JSON records the
+producing backend so approximate numbers are never mistaken for chip
+numbers.
+
+v5e: 197 bf16 TFLOP/s (MXU), 819 GB/s HBM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HBM_BW = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+}
+_DEFAULT_BW = 819e9
+
+
+def _roofline(cost, peak, bw):
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    t_compute = flops / peak
+    t_memory = bytes_accessed / bw
+    t_lb = max(t_compute, t_memory)
+    return {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "arith_intensity_flops_per_byte":
+            round(flops / bytes_accessed, 2) if bytes_accessed else None,
+        "t_compute_ms": round(t_compute * 1e3, 3),
+        "t_memory_ms": round(t_memory * 1e3, 3),
+        "bound": "compute" if t_compute >= t_memory else "memory",
+        "mfu_ceiling": round(t_compute / t_lb, 4) if t_lb else None,
+        "roofline_step_time_ms": round(t_lb * 1e3, 3),
+    }
+
+
+def _resnet_cost(batch_size, data_format, use_amp=True):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = resnet.build_model(dataset="flowers", depth=50,
+                                   class_dim=1000, learning_rate=0.1,
+                                   use_amp=use_amp,
+                                   data_format=data_format)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"data": rng.rand(batch_size, 3, 224, 224)
+                .astype(np.float32),
+                "label": rng.randint(0, 1000, (batch_size, 1))
+                .astype(np.int32)}
+        return exe.cost_analysis(main, feed=feed,
+                                 fetch_list=[model["loss"]])
+
+
+def _transformer_cost(batch_size, max_length, use_flash, use_amp=True,
+                      use_fused_ce=False, fused_qkv=False):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = transformer.build_model(
+            src_vocab_size=32000, trg_vocab_size=32000,
+            max_length=max_length, n_layer=6, n_head=8, d_model=512,
+            d_inner_hid=2048, dropout=0.1, use_amp=use_amp,
+            use_flash=use_flash, use_fused_ce=use_fused_ce,
+            fused_qkv=fused_qkv)
+        exe = fluid.Executor()
+        exe.run(startup)
+        batch = transformer.make_fake_batch(batch_size, max_length,
+                                            32000, 32000)
+        feed = {k: np.asarray(v) for k, v in batch.items()}
+        return exe.cost_analysis(main, feed=feed,
+                                 fetch_list=[model["loss"]])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="all",
+                   choices=["all", "resnet50", "transformer"])
+    p.add_argument("--batch", type=int, default=0)
+    p.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
+    p.add_argument("--flash", action="store_true",
+                   help="analyze the flash program itself (bytes are "
+                        "real; flops exclude the Pallas kernel)")
+    p.add_argument("--out", default="ROOFLINE_r04.json")
+    args = p.parse_args()
+
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _peak_flops
+
+    peak, kind = _peak_flops()
+    bw = next((v for k, v in _HBM_BW.items() if kind.startswith(k)),
+              _DEFAULT_BW)
+
+    results = {"device": kind, "peak_flops": peak, "hbm_bw": bw}
+    if args.model in ("all", "resnet50"):
+        cost = _resnet_cost(args.batch or 128, args.layout)
+        results[f"resnet50_{args.layout.lower()}_bs"
+                f"{args.batch or 128}"] = _roofline(cost, peak, bw)
+    if args.model in ("all", "transformer"):
+        cost = _transformer_cost(args.batch or 64, 256, args.flash)
+        results[f"transformer_bs{args.batch or 64}_len256"
+                + ("_flash" if args.flash else "_dense")] = _roofline(
+                    cost, peak, bw)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
