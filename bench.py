@@ -587,6 +587,37 @@ def main():
 
     detail = {}
 
+    # a stale snapshot from a PREVIOUS run must not masquerade as this
+    # run's evidence if we die before the first model completes
+    try:
+        os.remove("bench_partial.json")
+    except OSError:
+        pass
+
+    def _headline_of(v):
+        for k in ("mfu", "examples_per_sec", "imgs_per_sec", "error"):
+            if k in v:
+                return v[k]
+        return "?"
+
+    def _snapshot():
+        # a driver-timeout kill must never again leave ZERO evidence
+        # (r03: rc=124, nothing printed): after every model the
+        # cumulative detail lands in bench_partial.json on disk and a
+        # snapshot line on stderr; the one-line stdout contract is
+        # untouched (final line only)
+        import sys
+
+        try:
+            with open("bench_partial.json", "w") as f:
+                json.dump({"partial": True, "detail": detail}, f,
+                          indent=1)
+        except OSError:
+            pass
+        print("bench snapshot: " + json.dumps(
+            {k: _headline_of(v) for k, v in detail.items()}),
+            file=sys.stderr)
+
     def _run(name, fn, *fn_args, **fn_kwargs):
         # one failing config must not take down the whole report — the
         # driver consumes the single JSON line either way
@@ -606,6 +637,7 @@ def main():
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"warning: {name} bench failed, continuing",
                   file=sys.stderr)
+        _snapshot()
 
     if args.model in ("all", "resnet50"):
         _run("resnet50", bench_resnet50, args.batch or 128, args.steps,

@@ -65,6 +65,53 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     return result
 
 
+def switch_moe(input, num_experts, d_inner, top_k=1,
+               capacity_factor=1.25, act="relu", param_attr=None,
+               name=None):
+    """Mixture-of-Experts FFN block (ops/moe.py) with expert
+    parallelism: per-expert weights are (E, D, H)/(E, H, D) with the E
+    axis sharded over the mesh's mp/ep axis (the `moe_expert` name
+    matches the expert sharding rule in parallel/strategies.py; GSPMD
+    inserts the GShard all-to-alls).  Returns (out, aux_loss) — add
+    `aux_weight * aux_loss` to the objective for load balancing.
+
+    Not in the 1.2 reference (predates MoE); first-class here because
+    ep is a primary TPU scale axis."""
+    d = int(input.shape[-1])
+    # user names APPEND to the moe_gate/moe_expert prefixes — the
+    # prefixes are what the ep sharding rules key on, so a named layer
+    # must still match them
+    gate_h = LayerHelper("moe_gate",
+                         name=name and f"moe_gate_{name}")
+    dtype = input.dtype
+    gate_w = gate_h.create_parameter(param_attr, shape=[d, num_experts],
+                                     dtype=dtype)
+    eh = LayerHelper("moe_expert",
+                     name=name and f"moe_expert_{name}")
+    w1 = eh.create_parameter(param_attr, shape=[num_experts, d, d_inner],
+                             dtype=dtype)
+    b1 = eh.create_parameter(param_attr, shape=[num_experts, d_inner],
+                             dtype=dtype, is_bias=True)
+    w2 = eh.create_parameter(param_attr, shape=[num_experts, d_inner, d],
+                             dtype=dtype)
+    b2 = eh.create_parameter(param_attr, shape=[num_experts, d],
+                             dtype=dtype, is_bias=True)
+    out_v = eh.create_variable_for_type_inference(dtype)
+    aux = eh.create_variable_for_type_inference("float32")
+    frac = eh.create_variable_for_type_inference("float32")
+    eh.append_op(
+        type="moe_ffn",
+        inputs={"X": [input], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out_v], "AuxLoss": [aux], "Fraction": [frac]},
+        attrs={"top_k": top_k, "capacity_factor": capacity_factor,
+               "act": act})
+    out_v.desc.shape = tuple(input.shape)
+    aux.desc.shape = (1,)
+    frac.desc.shape = (num_experts,)
+    return out_v, aux
+
+
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
     """reference layers/nn.py embedding → lookup_table op.  is_sparse /

@@ -11,6 +11,7 @@ from . import basic  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import detection  # noqa: F401
 from . import misc  # noqa: F401
+from . import moe  # noqa: F401
 from . import nn  # noqa: F401
 from . import optim  # noqa: F401
 from . import quantize  # noqa: F401

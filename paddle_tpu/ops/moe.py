@@ -1,0 +1,121 @@
+"""Mixture-of-Experts FFN with expert parallelism (GShard / Switch
+Transformer routing).
+
+The reference tree (Fluid 1.2) predates MoE; this op exists because
+expert parallelism is a first-class scale axis on TPU meshes (ep in
+dp/tp/pp/sp/ep).  TPU-first design, not a port: routing, dispatch and
+combine are dense einsums over a static expert-capacity buffer — no
+dynamic shapes, no scatter — so GSPMD shards the expert dimension over
+the mesh's `ep`/`mp` axis and inserts the all-to-alls itself (the
+standard GShard lowering; see PAPERS.md GShard/Switch entries for the
+published formulation).
+
+Routing (top-1 "switch" or top-2):
+- gate logits (B, E) from X @ GateW; probs = softmax
+- per-expert capacity C = ceil(B * top_k / E * capacity_factor);
+  tokens beyond an expert's capacity are DROPPED (their combine weight
+  is zero and the residual path carries them — the Switch convention);
+  top-2 combine weights are the GShard normalization p_i / (p1 + p2)
+- position of each token in its expert's buffer = exclusive cumsum of
+  the dispatch mask (deterministic, order-preserving)
+- dispatch: (B, E, C) one-hot plan; expert_in = dispatchᵀ @ X
+- experts: per-expert 2-layer FFN as batched einsums (E in the batch
+  dim -> one MXU matmul per projection across ALL experts)
+- combine: out = Σ_ec gate_prob * dispatch * expert_out
+
+AuxLoss is the Switch load-balancing loss: E * Σ_e (fraction of tokens
+routed to e) * (mean router prob of e); add `aux_weight * AuxLoss` to
+the training objective to keep routing balanced.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, opt_in, out
+
+
+def _act(name):
+    return {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "tanh": jnp.tanh, "identity": lambda v: v,
+            None: jax.nn.relu}[name]
+
+
+@register_op("moe_ffn")
+def moe_ffn(ctx, ins, attrs):
+    """X (..., D); GateW (D, E); W1 (E, D, H); B1 (E, H); W2 (E, H, D);
+    B2 (E, D).  Outputs Out (..., D), AuxLoss (1,), plus router stats
+    (Fraction (E,) tokens-per-expert) for observability."""
+    x = first(ins, "X")
+    gate_w = first(ins, "GateW")
+    w1, b1 = first(ins, "W1"), opt_in(ins, "B1")
+    w2, b2 = first(ins, "W2"), opt_in(ins, "B2")
+    top_k = int(attrs.get("top_k", 1))
+    cap_factor = float(attrs.get("capacity_factor", 1.25))
+    act = _act(attrs.get("act", "relu"))
+    if top_k not in (1, 2):
+        raise ValueError(f"moe_ffn: top_k must be 1 or 2, got {top_k}")
+
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    e = gate_w.shape[1]
+    xf = x.reshape(-1, d)
+    b = xf.shape[0]
+    # C = ceil(B * top_k / E * capacity_factor), the documented formula
+    import math
+
+    cap = max(1, int(math.ceil(b * top_k / e * cap_factor)))
+
+    logits = (xf @ gate_w).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)          # (B, E)
+
+    combine = jnp.zeros((b, e, cap), xf.dtype)
+    used = jnp.zeros((b, e), bool)
+    fill = jnp.zeros((e,), jnp.float32)  # slots taken by earlier k's
+    for k in range(top_k):
+        masked = jnp.where(used, -jnp.inf, logits)
+        idx = jnp.argmax(masked, axis=-1)            # (B,)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        # deterministic position in the expert buffer (token order),
+        # offset by the slots previous routing passes already filled
+        pos = (jnp.cumsum(onehot, axis=0) - onehot)  # exclusive
+        pos = jnp.sum((pos + fill[None, :]) * onehot, axis=-1)  # (B,)
+        fill = fill + jnp.sum(onehot, axis=0)
+        fits = pos < cap
+        gate = jnp.sum(probs * onehot, axis=-1)      # (B,)
+        pos_oh = jax.nn.one_hot(jnp.where(fits, pos, 0), cap,
+                                dtype=jnp.float32)
+        plan = (onehot[:, :, None] * pos_oh[:, None, :]
+                * jnp.where(fits, gate, 0.0)[:, None, None])
+        combine = combine + plan.astype(xf.dtype)
+        used = used | (onehot > 0)
+
+    if top_k == 2:
+        # GShard top-2 normalization: divide by the prob mass of the
+        # CHOSEN experts (p1 + p2) so the pair's weights sum to 1; a
+        # capacity-dropped choice simply vanishes, leaving the kept
+        # expert at p_kept/(p1+p2) — never amplified
+        chosen = jnp.sum(probs * used, axis=-1)[:, None, None]
+        combine = combine / jnp.maximum(chosen, 1e-9).astype(
+            combine.dtype)
+
+    dispatch = (combine > 0).astype(xf.dtype)        # (B, E, C)
+    expert_in = jnp.einsum("bec,bd->ecd", dispatch, xf)
+    h = act(jnp.einsum("ecd,edh->ech", expert_in, w1)
+            + (b1[:, None, :] if b1 is not None else 0.0))
+    expert_out = (jnp.einsum("ech,ehd->ecd", h, w2)
+                  + (b2[:, None, :] if b2 is not None else 0.0))
+    yf = jnp.einsum("bec,ecd->bd", combine, expert_out)
+
+    # Switch load-balancing loss on the top-1 assignment
+    top1 = jax.nn.one_hot(jnp.argmax(logits, axis=-1), e,
+                          dtype=jnp.float32)
+    fraction = jnp.mean(top1, axis=0)                # (E,)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(fraction * mean_prob)
+
+    return {"Out": [yf.reshape(lead + (d,))],
+            "AuxLoss": [aux.reshape(1)],
+            "Fraction": [fraction]}

@@ -17,5 +17,6 @@ from .dist import (global_batch, init_distributed,  # noqa: F401
                    make_multihost_mesh, shutdown_distributed)
 from .mesh import get_default_mesh, make_mesh, set_default_mesh  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
+from .pipeline import gpipe, gpipe_loss_and_grad  # noqa: F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 from .strategies import ShardingRules  # noqa: F401

@@ -70,6 +70,11 @@ def megatron_transformer_rules(fsdp: bool = False) -> ShardingRules:
              ("mp", None)),
             (r"(attn_qkv|ffn_in)\S*\.w", (None, "mp")),
             (r"(attn_out|ffn_out)\S*\.w", ("mp", None)),
+            # expert parallelism: the E axis of per-expert MoE weights
+            # shards over mp (GShard dispatch/combine all-to-alls are
+            # GSPMD-inserted); the router gate stays replicated
+            (r"moe_expert\S*\.w", ("mp", None, None)),
+            (r"moe_expert\S*\.b", ("mp", None)),
             # any remaining fc (e.g. the softmax projection): column
             (r"fc_\d+\.w_\d+", (None, "mp")),
         ],
