@@ -67,16 +67,27 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def switch_moe(input, num_experts, d_inner, top_k=1,
                capacity_factor=1.25, act="relu", param_attr=None,
-               name=None):
+               bias_attr=None, name=None):
     """Mixture-of-Experts FFN block (ops/moe.py) with expert
     parallelism: per-expert weights are (E, D, H)/(E, H, D) with the E
     axis sharded over the mesh's mp/ep axis (the `moe_expert` name
     matches the expert sharding rule in parallel/strategies.py; GSPMD
-    inserts the GShard all-to-alls).  Returns (out, aux_loss) — add
-    `aux_weight * aux_loss` to the objective for load balancing.
+    inserts the GShard all-to-alls).  Returns (out, aux_loss,
+    fraction): add `aux_weight * aux_loss` to the objective for load
+    balancing; fetch `fraction` (E,) for per-expert routing
+    observability.
 
     Not in the 1.2 reference (predates MoE); first-class here because
     ep is a primary TPU scale axis."""
+    from ..param_attr import ParamAttr
+
+    for attr in (param_attr, bias_attr):
+        if isinstance(attr, ParamAttr) and attr.name:
+            raise ValueError(
+                "switch_moe: a NAMED ParamAttr cannot apply to its "
+                "multiple parameters (name collision) and would break "
+                "the moe_expert/moe_gate prefix the ep sharding rules "
+                "key on; use name= to disambiguate layers instead")
     d = int(input.shape[-1])
     # user names APPEND to the moe_gate/moe_expert prefixes — the
     # prefixes are what the ep sharding rules key on, so a named layer
@@ -88,13 +99,21 @@ def switch_moe(input, num_experts, d_inner, top_k=1,
                                      dtype=dtype)
     eh = LayerHelper("moe_expert",
                      name=name and f"moe_expert_{name}")
+    # explicit per-expert fans: the default rank-3 fan computation
+    # treats (E, D, H) as a conv kernel and under-initializes ~sqrt(E)x
+    from ..initializer import Xavier
+
     w1 = eh.create_parameter(param_attr, shape=[num_experts, d, d_inner],
-                             dtype=dtype)
-    b1 = eh.create_parameter(param_attr, shape=[num_experts, d_inner],
+                             dtype=dtype,
+                             default_initializer=Xavier(
+                                 fan_in=d, fan_out=d_inner))
+    b1 = eh.create_parameter(bias_attr, shape=[num_experts, d_inner],
                              dtype=dtype, is_bias=True)
     w2 = eh.create_parameter(param_attr, shape=[num_experts, d_inner, d],
-                             dtype=dtype)
-    b2 = eh.create_parameter(param_attr, shape=[num_experts, d],
+                             dtype=dtype,
+                             default_initializer=Xavier(
+                                 fan_in=d_inner, fan_out=d))
+    b2 = eh.create_parameter(bias_attr, shape=[num_experts, d],
                              dtype=dtype, is_bias=True)
     out_v = eh.create_variable_for_type_inference(dtype)
     aux = eh.create_variable_for_type_inference("float32")
@@ -109,7 +128,7 @@ def switch_moe(input, num_experts, d_inner, top_k=1,
     out_v.desc.shape = tuple(input.shape)
     aux.desc.shape = (1,)
     frac.desc.shape = (num_experts,)
-    return out_v, aux
+    return out_v, aux, frac
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,

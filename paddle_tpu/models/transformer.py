@@ -112,21 +112,35 @@ def pre_post_process(prev_out, out, process_cmd, dropout_rate=0.0):
     return out
 
 
+def _ffn_or_moe(x, d_inner, d_model, moe_experts, aux_list):
+    """FFN sublayer: dense (default) or a switch-MoE block with the
+    expert dim sharded over mp/ep (moe_experts > 0).  Aux load-balance
+    losses accumulate into aux_list for the objective."""
+    if not moe_experts:
+        return positionwise_feed_forward(x, d_inner, d_model)
+    out, aux, _frac = layers.switch_moe(x, num_experts=moe_experts,
+                                        d_inner=d_inner)
+    if aux_list is not None:
+        aux_list.append(aux)
+    return out
+
+
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
-                  dropout, use_flash=False, fused_qkv=False):
+                  dropout, use_flash=False, fused_qkv=False,
+                  moe_experts=0, aux_list=None):
     attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, attn_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
         fused_qkv=fused_qkv)
     attn = pre_post_process(x, attn, "ad", dropout)
-    ff = positionwise_feed_forward(pre_post_process(None, attn, "n"),
-                                   d_inner, d_model)
+    ff = _ffn_or_moe(pre_post_process(None, attn, "n"), d_inner,
+                     d_model, moe_experts, aux_list)
     return pre_post_process(attn, ff, "ad", dropout)
 
 
 def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
                   d_model, d_inner, dropout, use_flash=False,
-                  fused_qkv=False):
+                  fused_qkv=False, moe_experts=0, aux_list=None):
     self_attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, self_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
@@ -136,9 +150,19 @@ def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
     cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
                                  d_value, d_model, n_head, dropout)
     cross = pre_post_process(self_attn, cross, "ad", dropout)
-    ff = positionwise_feed_forward(pre_post_process(None, cross, "n"),
-                                   d_inner, d_model)
+    ff = _ffn_or_moe(pre_post_process(None, cross, "n"), d_inner,
+                     d_model, moe_experts, aux_list)
     return pre_post_process(cross, ff, "ad", dropout)
+
+
+def _fold_moe_aux(avg_cost, moe_aux, weight):
+    """objective += weight * sum of per-layer load-balance losses."""
+    if not moe_aux:
+        return avg_cost
+    total = moe_aux[0] if len(moe_aux) == 1 else layers.sums(moe_aux)
+    return layers.elementwise_add(
+        avg_cost, layers.scale(layers.reduce_sum(total),
+                               scale=float(weight)))
 
 
 def _word_embedding(ids, vocab_size, d_model, name):
@@ -179,8 +203,13 @@ def _causal_bias(max_len):
 def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
-                use_flash=False, use_fused_ce=False, fused_qkv=False):
-    """Build the full training graph; returns (avg_cost, logits, feeds)."""
+                use_flash=False, use_fused_ce=False, fused_qkv=False,
+                moe_experts=0, moe_aux_weight=0.01):
+    """Build the full training graph; returns (avg_cost, logits, feeds).
+    moe_experts > 0 swaps every FFN sublayer for a switch-MoE block
+    (experts sharded over mp/ep) and folds the load-balance aux losses
+    into the objective with weight moe_aux_weight."""
+    moe_aux: list = []
     src_word = layers.data(name="src_word", shape=[max_length],
                            dtype="int64")
     trg_word = layers.data(name="trg_word", shape=[max_length],
@@ -202,7 +231,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     for _ in range(n_layer):
         x = encoder_layer(x, src_bias, n_head, d_key, d_value, d_model,
                           d_inner_hid, dropout, use_flash=use_flash,
-                          fused_qkv=fused_qkv)
+                          fused_qkv=fused_qkv, moe_experts=moe_experts,
+                          aux_list=moe_aux)
     enc_out = pre_post_process(None, x, "n")
 
     # decoder
@@ -212,7 +242,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     for _ in range(n_layer):
         y = decoder_layer(y, enc_out, self_bias, src_bias, n_head, d_key,
                           d_value, d_model, d_inner_hid, dropout,
-                          use_flash=use_flash, fused_qkv=fused_qkv)
+                          use_flash=use_flash, fused_qkv=fused_qkv,
+                          moe_experts=moe_experts, aux_list=moe_aux)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -235,6 +266,7 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
         sum_cost = layers.reduce_sum(cost)
         token_num = layers.reduce_sum(tmask)
         avg_cost = layers.elementwise_div(sum_cost, token_num)
+        avg_cost = _fold_moe_aux(avg_cost, moe_aux, moe_aux_weight)
         feeds = ["src_word", "trg_word", "lbl_word", "src_len",
                  "trg_len"]
         return avg_cost, logits, feeds
@@ -263,6 +295,7 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     sum_cost = layers.reduce_sum(cost)
     token_num = layers.reduce_sum(tmask)
     avg_cost = layers.elementwise_div(sum_cost, token_num)
+    avg_cost = _fold_moe_aux(avg_cost, moe_aux, moe_aux_weight)
     feeds = ["src_word", "trg_word", "lbl_word", "src_len", "trg_len"]
     return avg_cost, logits, feeds
 
@@ -271,12 +304,14 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_model=512, d_inner_hid=2048,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
-                use_amp=False, use_fused_ce=False, fused_qkv=False):
+                use_amp=False, use_fused_ce=False, fused_qkv=False,
+                moe_experts=0):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
         dropout, label_smooth_eps, use_flash=use_flash,
-        use_fused_ce=use_fused_ce, fused_qkv=fused_qkv)
+        use_fused_ce=use_fused_ce, fused_qkv=fused_qkv,
+        moe_experts=moe_experts)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

@@ -57,6 +57,11 @@ def moe_ffn(ctx, ins, attrs):
     act = _act(attrs.get("act", "relu"))
     if top_k not in (1, 2):
         raise ValueError(f"moe_ffn: top_k must be 1 or 2, got {top_k}")
+    if top_k > gate_w.shape[1]:
+        raise ValueError(
+            f"moe_ffn: top_k={top_k} needs at least that many experts, "
+            f"got E={gate_w.shape[1]} (the second pass would re-route "
+            f"to the same expert)")
 
     lead = x.shape[:-1]
     d = x.shape[-1]
@@ -85,8 +90,9 @@ def moe_ffn(ctx, ins, attrs):
         fill = fill + jnp.sum(onehot, axis=0)
         fits = pos < cap
         gate = jnp.sum(probs * onehot, axis=-1)      # (B,)
-        pos_oh = jax.nn.one_hot(jnp.where(fits, pos, 0), cap,
-                                dtype=jnp.float32)
+        pos_oh = jax.nn.one_hot(
+            jnp.where(fits, pos, 0).astype(jnp.int32), cap,
+            dtype=jnp.float32)
         plan = (onehot[:, :, None] * pos_oh[:, None, :]
                 * jnp.where(fits, gate, 0.0)[:, None, None])
         combine = combine + plan.astype(xf.dtype)

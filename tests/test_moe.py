@@ -155,7 +155,7 @@ def test_switch_moe_layer_trains_and_balances():
             fluid.unique_name.guard():
         x = layers.data("x", shape=[16])
         y = layers.data("y", shape=[1], dtype="int64")
-        h, aux = layers.switch_moe(x, num_experts=4, d_inner=32)
+        h, aux, frac = layers.switch_moe(x, num_experts=4, d_inner=32)
         logits = layers.fc(h, size=4)
         ce = layers.mean(layers.softmax_with_cross_entropy(logits, y))
         loss = layers.elementwise_add(
@@ -167,10 +167,12 @@ def test_switch_moe_layer_trains_and_balances():
         xv = rng.randn(64, 16).astype(np.float32)
         yv = (np.abs(xv[:, :4]).argmax(1))[:, None].astype(np.int64)
         for _ in range(25):
-            lv, av = exe.run(main, feed={"x": xv, "y": yv},
-                             fetch_list=[loss, aux])
+            lv, av, fv = exe.run(main, feed={"x": xv, "y": yv},
+                                 fetch_list=[loss, aux, frac])
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
             assert np.isfinite(float(np.asarray(av).reshape(-1)[0]))
+        # routing fractions are fetchable and sum to 1 over experts
+        np.testing.assert_allclose(np.asarray(fv).sum(), 1.0, rtol=1e-5)
     assert losses[-1] < losses[0]
 
 
@@ -189,7 +191,7 @@ def test_expert_parallel_sharded_parity():
                 fluid.scope_guard(scope), fluid.unique_name.guard():
             x = layers.data("x", shape=[8])
             y = layers.data("y", shape=[1], dtype="int64")
-            h, aux = layers.switch_moe(x, num_experts=4, d_inner=16,
+            h, aux, _frac = layers.switch_moe(x, num_experts=4, d_inner=16,
                                        capacity_factor=4.0)
             logits = layers.fc(h, size=3)
             ce = layers.mean(layers.softmax_with_cross_entropy(
@@ -228,3 +230,44 @@ def test_expert_parallel_sharded_parity():
     single = run(None)
     np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-5)
     assert sharded[-1] < sharded[0]
+
+
+def test_moe_transformer_trains_and_shards():
+    """Transformer with moe_experts=4: trains on a tiny config, and the
+    ep-sharded run (experts over mp) matches the unsharded trajectory."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.strategies import megatron_transformer_rules
+
+    def run(mesh):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 9
+        scope = fluid.Scope()
+        losses = []
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            model = transformer.build_model(
+                src_vocab_size=64, trg_vocab_size=64, max_length=8,
+                n_layer=1, n_head=4, d_model=32, d_inner_hid=64,
+                dropout=0.0, moe_experts=4)
+            exe = fluid.Executor()
+            exe.run(startup)
+            prog = main
+            if mesh is not None:
+                bs = fluid.BuildStrategy()
+                bs.sharding_rules = megatron_transformer_rules()
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=model["loss"].name, build_strategy=bs,
+                    mesh=mesh)
+            feed = transformer.make_fake_batch(8, 8, 64, 64)
+            for _ in range(3):
+                lv, = exe.run(prog, feed=feed,
+                              fetch_list=[model["loss"]])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        return losses
+
+    sharded = run(make_mesh({"dp": 2, "mp": 4}))
+    single = run(None)
+    assert all(np.isfinite(sharded))
+    assert sharded[-1] < sharded[0]
+    np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-5)
