@@ -57,7 +57,42 @@ class LayerHelper:
 
         main_block = self.main_program.global_block()
         if main_block.has_var(name):
-            raise ValueError(f"parameter {name!r} already exists")
+            if not attr.name:
+                raise ValueError(f"parameter {name!r} already exists")
+            # fluid parameter sharing: an EXPLICITLY named ParamAttr
+            # reuses the existing parameter (the reference book models
+            # share embeddings this way — test_label_semantic_roles.py
+            # binds 6 features to one 'emb' table); generated names
+            # colliding is still a bug and still raises
+            existing = main_block.var(name)
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    f"name {name!r} already belongs to a non-parameter "
+                    f"variable; cannot share it as a layer weight")
+            if (tuple(existing.shape) != tuple(shape)
+                    or str(existing.dtype) != str(dtype)):
+                raise ValueError(
+                    f"shared parameter {name!r} re-declared with "
+                    f"mismatched shape/dtype: existing "
+                    f"{existing.shape}/{existing.dtype} vs requested "
+                    f"{tuple(shape)}/{dtype}")
+            # a second declaration cannot re-configure the parameter —
+            # silently dropping its attrs would make hyperparameter
+            # edits on the later site no-ops
+            if attr.learning_rate != getattr(existing, "learning_rate",
+                                             attr.learning_rate):
+                raise ValueError(
+                    f"shared parameter {name!r} re-declared with a "
+                    f"different learning_rate "
+                    f"({existing.learning_rate} vs "
+                    f"{attr.learning_rate}); attrs bind at the FIRST "
+                    f"declaration")
+            if attr.initializer is not None or attr.regularizer is not None:
+                raise ValueError(
+                    f"shared parameter {name!r}: initializer/"
+                    f"regularizer on a re-declaration cannot apply — "
+                    f"set them where the parameter is first declared")
+            return existing
         param = main_block.create_parameter(
             name, shape, dtype,
             regularizer=attr.regularizer,

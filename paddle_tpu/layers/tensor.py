@@ -77,6 +77,22 @@ def sums(input, out=None):
     if out is None:
         out = helper.create_variable_for_type_inference(input[0].dtype)
     helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})
+    # elementwise over sequences: the result keeps the operands' ragged
+    # lengths (the SRL book model sums per-feature projections and
+    # feeds the result onward to length-aware LSTM/CRF layers).  Only
+    # level-1 raggedness is defined here — a nested operand must fail
+    # loudly, not silently drop its .seq_len2 (CLAUDE.md invariant).
+    from .sequence import _propagate_seq_len, seq_len_var
+
+    for x in input:
+        if getattr(x, "lod_level", 0) and x.lod_level > 1:
+            raise NotImplementedError(
+                "sums over lod_level=2 operands: the summed result's "
+                "nested lengths are ambiguous; pool the inner level "
+                "first (sequence_pool)")
+    src = next((x for x in input if seq_len_var(x) is not None), None)
+    if src is not None:
+        _propagate_seq_len(src, out)
     return out
 
 

@@ -12,6 +12,7 @@ from . import deepfm  # noqa: F401
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import se_resnext  # noqa: F401
+from . import sequence_tagging  # noqa: F401
 from . import stacked_dynamic_lstm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import vgg  # noqa: F401
