@@ -206,19 +206,20 @@ def _dense_equiv_flops(feed, build_no_flash):
 def bench_transformer(batch_size: int, steps: int, warmup: int,
                       max_length: int = 256, use_amp: bool = True,
                       use_flash: bool = True, use_fused_ce: bool = False,
-                      fused_qkv: bool = False):
+                      fused_qkv: bool = False, moe_experts: int = 0):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    def build(flash, fused_ce=use_fused_ce, fq=None):
+    def build(flash, fused_ce=use_fused_ce, fq=None, moe=None):
         return transformer.build_model(
             src_vocab_size=32000, trg_vocab_size=32000,
             max_length=max_length, n_layer=6, n_head=8, d_model=512,
             d_inner_hid=2048, dropout=0.1, use_flash=flash,
             use_amp=use_amp, use_fused_ce=fused_ce,
-            fused_qkv=fused_qkv if fq is None else fq)
+            fused_qkv=fused_qkv if fq is None else fq,
+            moe_experts=moe_experts if moe is None else moe)
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -246,7 +247,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                                  / elapsed, 1),
          "batch_size": batch_size, "max_length": max_length,
          "amp": use_amp, "flash": use_flash, "fused_ce": use_fused_ce,
-         "fused_qkv": fused_qkv,
+         "fused_qkv": fused_qkv, "moe_experts": moe_experts,
          "flop_count": ("dense-equivalent"
                         if (use_flash or use_fused_ce) else "xla"),
          "last_loss": last_loss})
@@ -543,6 +544,9 @@ def main():
     p.add_argument("--fused-qkv", action="store_true",
                    help="transformer: Megatron-style single fused QKV "
                         "projection in self-attention")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="transformer: swap FFN sublayers for switch-MoE "
+                        "blocks with this many experts (0 = dense)")
     p.add_argument("--data", default="synthetic",
                    choices=["synthetic", "frozen", "host"],
                    help="resnet50 input mode: fresh on-device synthetic "
@@ -654,7 +658,7 @@ def main():
         _run("transformer", bench_transformer, args.batch or 64,
              args.steps, args.warmup, use_amp=amp,
              use_flash=not args.no_flash, use_fused_ce=args.fused_ce,
-             fused_qkv=args.fused_qkv)
+             fused_qkv=args.fused_qkv, moe_experts=args.moe_experts)
     if args.model in ("all", "bert"):
         _run("bert", bench_bert, args.batch or 32, args.steps,
              args.warmup, use_amp=amp, use_flash=not args.no_flash)

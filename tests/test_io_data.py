@@ -337,3 +337,40 @@ def test_imdb_aclimdb_tar_parses(tmp_path):
                        wd[b"movie"]]          # punctuation stripped
     test_s = list(dataset.imdb.test(wd, data_dir=str(tmp_path))())
     assert len(test_s) == 1 and test_s[0][1] == 0
+
+
+def test_fit_a_line_book_flow(tmp_path):
+    """Book ch.1 fit_a_line (reference tests/book/test_fit_a_line.py):
+    uci_housing reader -> batch decorator -> linear regression via
+    square_error_cost -> SGD -> save/load inference model -> predict."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = layers.fc(input=x, size=1, act=None)
+        cost = layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        reader = batch(dataset.uci_housing.train(), batch_size=20)
+        feeder = fluid.DataFeeder(feed_list=[x, y], place=None)
+        first = last = None
+        for _ in range(12):
+            for data in reader():
+                (lv,) = exe.run(main, feed=feeder.feed(data),
+                                fetch_list=[avg_cost])
+                lv = float(np.asarray(lv).reshape(-1)[0])
+                if first is None:
+                    first = lv
+                last = lv
+        assert last < first * 0.5, (first, last)
+
+        d = str(tmp_path / "fit_a_line")
+        io.save_inference_model(d, ["x"], [y_predict], exe,
+                                main_program=main)
+    pred = fluid.Predictor(d)
+    out = pred.run({"x": np.zeros((4, 13), np.float32)})
+    assert np.asarray(out[0]).shape == (4, 1)
