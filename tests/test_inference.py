@@ -181,3 +181,97 @@ def test_qdq_gradient_is_straight_through():
 
     g = jax.grad(f)(jnp.asarray([-1.0, 0.5, 0.25, 1.0]))
     np.testing.assert_allclose(np.asarray(g), np.arange(4.0), rtol=1e-6)
+
+
+def test_beam_decode_exports_through_predictor(tmp_path):
+    """The AOT Predictor serves a CONTROL-FLOW program: the NMT beam
+    -search decode (While loop + beam ops) exports via
+    save_inference_model and the Predictor's jitted run matches the
+    executor's decode bit-for-bit (reference analog: exporting the
+    RNN-search decoder through the inference engine)."""
+    from paddle_tpu.models import machine_translation as mt
+
+    B, Tsrc, V, K, L = 4, 8, 50, 3, 6
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+
+    # train briefly so decode weights are non-trivial
+    train_prog, train_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(train_prog, train_startup):
+        avg_cost, _ = mt.seq_to_seq_net(
+            src_vocab_size=V, trg_vocab_size=V, embed_dim=16,
+            hidden_dim=32, batch_size=B, max_src_len=Tsrc,
+            max_trg_len=7)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(train_startup)
+        feed = {
+            "src_word_id": rng.randint(2, V, (B, Tsrc)).astype(np.int64),
+            "src_word_id.seq_len": rng.randint(
+                3, Tsrc + 1, B).astype(np.int32),
+            "trg_word_id": rng.randint(2, V, (B, 7)).astype(np.int64),
+            "trg_word_id.seq_len": rng.randint(3, 8, B).astype(np.int32),
+            "trg_next_id": rng.randint(2, V, (B, 7)).astype(np.int64),
+        }
+        for _ in range(3):
+            exe.run(train_prog, feed=feed, fetch_list=[avg_cost])
+
+        # decode program in the SAME scope (shares trained params)
+        infer_prog, infer_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(infer_prog, infer_startup):
+            sents, scores, _ = mt.beam_search_net(
+                src_vocab_size=V, trg_vocab_size=V, embed_dim=16,
+                hidden_dim=32, batch_size=B, max_src_len=Tsrc,
+                beam_size=K, max_decode_len=L, start_id=0, end_id=1)
+        dec_feed = {"src_word_id": feed["src_word_id"],
+                    "src_word_id.seq_len": feed["src_word_id.seq_len"]}
+        ref_s, ref_sc = exe.run(infer_prog, feed=dec_feed,
+                                fetch_list=[sents, scores])
+
+        d = str(tmp_path / "decoder")
+        fluid.io.save_inference_model(
+            d, ["src_word_id", "src_word_id.seq_len"], [sents, scores],
+            exe, main_program=infer_prog)
+
+    pred = fluid.Predictor(d)
+    got_s, got_sc = pred.run(dec_feed)
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(ref_s))
+    np.testing.assert_allclose(np.asarray(got_sc), np.asarray(ref_sc),
+                               rtol=1e-5, atol=1e-6)
+    assert np.asarray(got_s).shape == (B, K, L)
+
+
+def test_beam_decode_stablehlo_export(tmp_path):
+    """The While-loop beam decoder also survives the portable StableHLO
+    export (jax.export): artifact served == traced serving."""
+    from paddle_tpu.models import machine_translation as mt
+
+    B, Tsrc, V, K, L = 2, 6, 30, 2, 4
+    scope = fluid.Scope()
+    rng = np.random.RandomState(5)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        infer_prog, infer_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(infer_prog, infer_startup):
+            sents, scores, _ = mt.beam_search_net(
+                src_vocab_size=V, trg_vocab_size=V, embed_dim=8,
+                hidden_dim=16, batch_size=B, max_src_len=Tsrc,
+                beam_size=K, max_decode_len=L, start_id=0, end_id=1)
+        exe.run(infer_startup)
+        d = str(tmp_path / "dec")
+        fluid.io.save_inference_model(
+            d, ["src_word_id", "src_word_id.seq_len"], [sents, scores],
+            exe, main_program=infer_prog)
+    feed = {"src_word_id": rng.randint(2, V, (B, Tsrc)).astype(np.int64),
+            "src_word_id.seq_len": np.full((B,), Tsrc, np.int32)}
+    ref = fluid.Predictor(d).run(feed)
+    path = fluid.inference.export_serialized_model(d, feed)
+    assert os.path.exists(path)
+    p = fluid.Predictor(d)
+    assert p._exported is not None
+    got = p.run(feed)
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(ref[0]))
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]),
+                               rtol=1e-5, atol=1e-6)

@@ -271,3 +271,28 @@ def test_moe_transformer_trains_and_shards():
     assert all(np.isfinite(sharded))
     assert sharded[-1] < sharded[0]
     np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_program_exports_through_predictor(tmp_path):
+    """A switch-MoE program exports via save_inference_model and the
+    AOT Predictor's output matches the executor's (routing einsums and
+    capacity logic all inside the jitted serving computation)."""
+    scope = fluid.Scope()
+    rng = np.random.RandomState(11)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[12])
+        h, aux, _frac = layers.switch_moe(x, num_experts=4, d_inner=24,
+                                          capacity_factor=4.0)
+        out = layers.fc(h, size=3, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = rng.rand(8, 12).astype(np.float32)
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        d = str(tmp_path / "moe_model")
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    pred = fluid.Predictor(d)
+    (got,) = pred.run({"x": xv})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
