@@ -221,7 +221,7 @@ class Layer:
         super().__setattr__(key, value)
 
     def create_parameter(self, name: str, shape, dtype="float32",
-                         initializer=None) -> VarBase:
+                         initializer=None, fan_in=None) -> VarBase:
         if initializer is None:
             import zlib
 
@@ -229,7 +229,10 @@ class Layer:
             # process and would make default inits non-reproducible
             seed = zlib.crc32(f"{self._name}.{name}".encode())
             rng = np.random.RandomState(seed % (2 ** 31))
-            fan_in = int(np.prod(shape[:-1])) or 1
+            # default fan heuristic fits (in, out)-style FC weights;
+            # layers with other layouts (conv OIHW) pass fan_in
+            if fan_in is None:
+                fan_in = int(np.prod(shape[:-1])) or 1
             value = (rng.randn(*shape) / np.sqrt(fan_in)).astype(dtype)
         else:
             value = np.asarray(initializer, dtype=dtype)
@@ -273,3 +276,134 @@ class FC(Layer):
         if self._act:
             y = trace_op(self._act, {"X": [y]})
         return y
+
+
+class Conv2D(Layer):
+    """Eager conv layer over the static-graph conv2d kernel (NCHW,
+    filter (C_out, C_in/groups, kH, kW))."""
+
+    def __init__(self, num_channels: int, num_filters: int,
+                 filter_size: int, stride: int = 1, padding: int = 0,
+                 groups: int = 1, act: Optional[str] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        k = ([filter_size, filter_size]
+             if isinstance(filter_size, int) else list(filter_size))
+        # OIHW: fan_in is C_in/groups * kH * kW (the FC heuristic would
+        # count num_filters and drop kW)
+        self.w = self.create_parameter(
+            "w", [num_filters, num_channels // groups] + k,
+            fan_in=(num_channels // groups) * int(np.prod(k)))
+        self.b = self.create_parameter(
+            "b", [num_filters],
+            initializer=np.zeros([num_filters], np.float32))
+        self._attrs = {"strides": stride, "paddings": padding,
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        y = trace_op("conv2d", {"Input": [x], "Filter": [self.w]},
+                     self._attrs, out_slots=["Output"])
+        y = trace_op("elementwise_add", {"X": [y], "Y": [self.b]},
+                     {"axis": 1})
+        if self._act:
+            y = trace_op(self._act, {"X": [y]})
+        return y
+
+
+class Embedding(Layer):
+    """Eager embedding lookup (lookup_table kernel)."""
+
+    def __init__(self, size, name: Optional[str] = None):
+        super().__init__(name)
+        self.w = self.create_parameter("w", list(size))
+
+    def forward(self, ids: VarBase) -> VarBase:
+        return trace_op("lookup_table",
+                        {"Ids": [ids], "W": [self.w]},
+                        {"padding_idx": -1})
+
+
+# ---------------------------------------------------------------------------
+# Eager optimizers (reference dygraph pattern: backward() then
+# optimizer.minimize applies updates directly to parameter VarBases).
+# Updates route through the SAME registered sgd/adam kernels the static
+# graph uses (ops/optim.py), so eager and static trajectories match
+# exactly — no second optimizer formula to maintain.
+# ---------------------------------------------------------------------------
+
+class EagerOptimizer:
+    def step(self, parameters: Sequence[VarBase]):
+        import jax
+
+        ctx = OpContext(jax.random.PRNGKey(0), 0)
+        for p in parameters:
+            if p.grad is not None:
+                self._apply(ctx, p)
+
+    def _apply(self, ctx, p: VarBase):
+        raise NotImplementedError
+
+    def minimize(self, loss: VarBase, parameters: Sequence[VarBase]):
+        """backward + apply + clear grads + reset the tape (the tape
+        must not grow across steps)."""
+        loss.backward()
+        self.step(parameters)
+        for p in parameters:
+            p.clear_gradient()
+        tracer = _active_tracer()
+        if tracer is not None:
+            tracer.reset()
+        return loss
+
+
+class SGDOptimizer(EagerOptimizer):
+    def __init__(self, learning_rate: float = 0.01):
+        import jax.numpy as jnp
+
+        self.lr = jnp.asarray([learning_rate], jnp.float32)
+
+    def _apply(self, ctx, p: VarBase):
+        outs = get_op_impl("sgd")(
+            ctx, {"Param": [p.value], "Grad": [p.grad],
+                  "LearningRate": [self.lr]}, {})
+        p.value = outs["ParamOut"][0]
+
+
+class AdamOptimizer(EagerOptimizer):
+    # per-parameter state keyed by the VarBase itself (id() alone can
+    # be recycled after GC and hand a new parameter dead moments)
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
+        import jax.numpy as jnp
+
+        self.lr = jnp.asarray([learning_rate], jnp.float32)
+        self.attrs = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+        self._state: Dict[int, Any] = {}  # id -> (p_ref, slots dict)
+
+    def _apply(self, ctx, p: VarBase):
+        import jax.numpy as jnp
+
+        key = id(p)
+        hit = self._state.get(key)
+        if hit is None or hit[0] is not p:
+            hit = (p, {"Moment1": jnp.zeros_like(p.value),
+                       "Moment2": jnp.zeros_like(p.value),
+                       "Beta1Pow": jnp.asarray([self.attrs["beta1"]],
+                                               jnp.float32),
+                       "Beta2Pow": jnp.asarray([self.attrs["beta2"]],
+                                               jnp.float32)})
+            self._state[key] = hit
+        slots = hit[1]
+        outs = get_op_impl("adam")(
+            ctx, {"Param": [p.value], "Grad": [p.grad],
+                  "LearningRate": [self.lr],
+                  "Moment1": [slots["Moment1"]],
+                  "Moment2": [slots["Moment2"]],
+                  "Beta1Pow": [slots["Beta1Pow"]],
+                  "Beta2Pow": [slots["Beta2Pow"]]}, dict(self.attrs))
+        p.value = outs["ParamOut"][0]
+        slots["Moment1"] = outs["Moment1Out"][0]
+        slots["Moment2"] = outs["Moment2Out"][0]
+        slots["Beta1Pow"] = outs["Beta1PowOut"][0]
+        slots["Beta2Pow"] = outs["Beta2PowOut"][0]
