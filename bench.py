@@ -61,18 +61,32 @@ def _peak_flops():
     return _DEFAULT_PEAK, f"{kind} (assumed v5e peak)"
 
 
+_PROFILE_DIR = None  # set by --profile; wraps every timed window
+
+
 def _timed_loop(exe, program, feed_dev, loss, steps, warmup):
     """Device-resident data loop: feeds are placed on device once; the
     timed window is ONE host dispatch chaining `steps` training steps
     on-chip (the tunnel here has high host<->device latency); a final
-    fetch synchronizes and validates the loss."""
+    fetch synchronizes and validates the loss.  With --profile DIR the
+    timed window is captured as a jax.profiler trace (the input for
+    closing the MFU gap: op-level device timelines, HBM traffic)."""
+    import contextlib
+
     for _ in range(warmup):
         exe.run(program, feed=feed_dev, fetch_list=[loss])
     exe.run(program, feed=feed_dev, fetch_list=[loss], iterations=steps)
-    t0 = time.perf_counter()
-    (lv,) = exe.run(program, feed=feed_dev, fetch_list=[loss],
-                    iterations=steps)
-    elapsed = time.perf_counter() - t0
+    if _PROFILE_DIR:
+        import jax
+
+        trace_cm = jax.profiler.trace(_PROFILE_DIR)
+    else:
+        trace_cm = contextlib.nullcontext()
+    with trace_cm:
+        t0 = time.perf_counter()
+        (lv,) = exe.run(program, feed=feed_dev, fetch_list=[loss],
+                        iterations=steps)
+        elapsed = time.perf_counter() - t0
     return elapsed, float(np.asarray(lv).reshape(-1)[0])
 
 
@@ -547,6 +561,9 @@ def main():
     p.add_argument("--moe-experts", type=int, default=0,
                    help="transformer: swap FFN sublayers for switch-MoE "
                         "blocks with this many experts (0 = dense)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of each timed "
+                        "window into DIR (feeds the MFU-gap analysis)")
     p.add_argument("--data", default="synthetic",
                    choices=["synthetic", "frozen", "host"],
                    help="resnet50 input mode: fresh on-device synthetic "
@@ -566,6 +583,10 @@ def main():
                         "(0 disables)")
     args = p.parse_args()
     amp = not args.no_amp
+
+    if args.profile:
+        global _PROFILE_DIR
+        _PROFILE_DIR = args.profile
 
     if os.environ.get("BENCH_PLATFORM"):
         # testing escape hatch: JAX_PLATFORMS env is stomped by the
@@ -746,6 +767,10 @@ def main():
         }
         if failed:
             result["failed"] = failed
+    if args.profile:
+        # profiler-inflated numbers must be distinguishable from clean
+        # runs (bench-honesty gate)
+        result["profiled"] = args.profile
     print(json.dumps(result))
 
 

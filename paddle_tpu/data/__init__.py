@@ -9,3 +9,4 @@ from .data_feeder import DataFeeder  # noqa: F401
 from .decorator import (batch, buffered, chain, compose, firstn,  # noqa: F401
                         map_readers, shuffle, xmap_readers)
 from . import dataset  # noqa: F401
+from . import image  # noqa: F401

@@ -211,7 +211,16 @@ class Layer:
     hold parameters, define forward()."""
 
     def __init__(self, name: Optional[str] = None):
-        self._name = name or self.__class__.__name__
+        if name is None:
+            # distinct default names per INSTANCE (the deterministic
+            # init seeds derive from the name, so two unnamed layers of
+            # one class must not share weights) — via core.unique_name
+            # so unique_name.guard() resets them for in-process
+            # rebuilds, same as static-graph layers (CLAUDE.md gotcha)
+            from .core import unique_name
+
+            name = unique_name.generate(self.__class__.__name__)
+        self._name = name
         self._params: Dict[str, VarBase] = {}
         self._sublayers: Dict[str, "Layer"] = {}
 
@@ -371,28 +380,34 @@ class SGDOptimizer(EagerOptimizer):
 
 
 class AdamOptimizer(EagerOptimizer):
-    # per-parameter state keyed by the VarBase itself (id() alone can
-    # be recycled after GC and hand a new parameter dead moments)
+    # per-parameter state keyed by WEAK reference: dead parameters drop
+    # their moments (no device-memory leak across model rebuilds), and
+    # a recycled id can never inherit a dead parameter's state
     def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8):
         import jax.numpy as jnp
 
         self.lr = jnp.asarray([learning_rate], jnp.float32)
         self.attrs = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
-        self._state: Dict[int, Any] = {}  # id -> (p_ref, slots dict)
+        self._state: Dict[int, Any] = {}  # id -> (weakref(p), slots)
 
     def _apply(self, ctx, p: VarBase):
+        import weakref
+
         import jax.numpy as jnp
 
         key = id(p)
         hit = self._state.get(key)
-        if hit is None or hit[0] is not p:
-            hit = (p, {"Moment1": jnp.zeros_like(p.value),
-                       "Moment2": jnp.zeros_like(p.value),
-                       "Beta1Pow": jnp.asarray([self.attrs["beta1"]],
-                                               jnp.float32),
-                       "Beta2Pow": jnp.asarray([self.attrs["beta2"]],
-                                               jnp.float32)})
+        if hit is None or hit[0]() is not p:
+            slots = {"Moment1": jnp.zeros_like(p.value),
+                     "Moment2": jnp.zeros_like(p.value),
+                     "Beta1Pow": jnp.asarray([self.attrs["beta1"]],
+                                             jnp.float32),
+                     "Beta2Pow": jnp.asarray([self.attrs["beta2"]],
+                                             jnp.float32)}
+            hit = (weakref.ref(
+                p, lambda _ref, k=key, s=self._state: s.pop(k, None)),
+                slots)
             self._state[key] = hit
         slots = hit[1]
         outs = get_op_impl("adam")(

@@ -146,8 +146,37 @@ def test_eager_training_with_optimizers_converges():
                     "elementwise_sub", {"X": [pred], "Y": [y]})
                 sq = imperative.trace_op("square", {"X": [err]})
                 loss = imperative.trace_op("reduce_mean", {"X": [sq]})
-                losses.append(float(loss.numpy()))
+                losses.append(float(loss.numpy().reshape(())))
                 opt.minimize(loss, params)
                 assert tracer.tape == []  # reset each step
             assert losses[-1] < losses[0] * 0.6, (
                 type(opt).__name__, losses[0], losses[-1])
+
+
+def test_unnamed_layers_get_distinct_inits():
+    """Two unnamed layers of one class must NOT share default weights
+    (the deterministic seed mixes an instance counter)."""
+    c1 = imperative.Conv2D(3, 4, 3)
+    c2 = imperative.Conv2D(3, 4, 3)
+    assert not np.array_equal(c1.w.numpy(), c2.w.numpy())
+    e1 = imperative.Embedding([10, 6])
+    e2 = imperative.Embedding([10, 6])
+    assert not np.array_equal(e1.w.numpy(), e2.w.numpy())
+
+
+def test_adam_state_drops_with_dead_params():
+    """Adam moments are weakref-keyed: rebuilding the model releases
+    the old parameters' state instead of leaking it."""
+    import gc
+
+    opt = imperative.AdamOptimizer(learning_rate=0.01)
+    with imperative.guard():
+        for _ in range(3):
+            fc = imperative.FC(8, 4)
+            x = imperative.to_variable(
+                np.ones((2, 8), np.float32), stop_gradient=True)
+            loss = imperative.trace_op("reduce_mean", {"X": [fc(x)]})
+            opt.minimize(loss, fc.parameters())
+            del fc, x, loss
+            gc.collect()
+    assert len(opt._state) <= 2  # only the LAST model's 2 params remain

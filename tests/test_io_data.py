@@ -379,3 +379,46 @@ def test_fit_a_line_book_flow(tmp_path):
     pred = fluid.Predictor(d)
     out = pred.run({"x": np.zeros((4, 13), np.float32)})
     assert np.asarray(out[0]).shape == (4, 1)
+
+
+def test_image_transforms():
+    """dataset/image.py analog (data/image.py): resize_short keeps
+    aspect ratio, crops/flips behave, simple_transform yields CHW
+    float32 with mean subtracted."""
+    from paddle_tpu.data import image
+
+    rng = np.random.RandomState(0)
+    im = rng.randint(0, 256, (40, 60, 3)).astype(np.uint8)
+
+    r = image.resize_short(im, 20)
+    assert r.shape == (20, 30, 3)  # shorter edge 20, aspect kept
+    # a constant image stays constant under bilinear resize
+    const = np.full((17, 33, 3), 77, np.uint8)
+    rc = image.resize_short(const, 24)
+    assert (rc == 77).all()
+
+    c = image.center_crop(r, 16)
+    assert c.shape == (16, 16, 3)
+    np.testing.assert_array_equal(c, r[2:18, 7:23])
+
+    f = image.left_right_flip(im)
+    np.testing.assert_array_equal(f[:, 0], im[:, -1])
+
+    rcrop = image.random_crop(r, 16, rng=np.random.RandomState(1))
+    assert rcrop.shape == (16, 16, 3)
+
+    out = image.simple_transform(im, 32, 24, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    out_tr = image.simple_transform(im, 32, 24, is_train=True,
+                                    rng=np.random.RandomState(2))
+    assert out_tr.shape == (3, 24, 24)
+
+    # grayscale + per-channel mean must FAIL loudly, not broadcast a
+    # (H, W) image into a bogus (3, H, W) tensor
+    gray = rng.randint(0, 256, (40, 60)).astype(np.uint8)
+    g = image.simple_transform(gray, 32, 24, is_train=False, mean=[7.0])
+    assert g.shape == (24, 24)
+    with pytest.raises(ValueError, match="per-channel"):
+        image.simple_transform(gray, 32, 24, is_train=False,
+                               mean=[1.0, 2.0, 3.0])
