@@ -66,6 +66,11 @@ def main():
     trainer_id = int(sys.argv[1])
     coordinator = sys.argv[2]
     accum = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    # optional: sharded-ckpt round-trip mid-run (save + load back into
+    # the NamedShardings after step 2) — the parent checks loss parity
+    # with the uninterrupted single-process reference, proving the
+    # MULTI-PROCESS per-shard save/load path is lossless
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
 
     init_distributed(trainer_id=trainer_id, num_trainers=2,
                      coordinator=coordinator)
@@ -80,6 +85,14 @@ def main():
     bs.num_trainers = 2
     bs.trainer_id = trainer_id
     bs.gradient_accumulation_steps = accum
+    if ckpt_dir:
+        # FSDP param placement so BOTH processes own real shard data —
+        # a replicated layout would park every shard on process 0 and
+        # make the multi-process ckpt test vacuous
+        from paddle_tpu.parallel.strategies import ShardingRules
+
+        bs.sharding_rules = ShardingRules(default="fsdp",
+                                          fsdp_axis="dp")
     compiled = fluid.CompiledProgram(main_prog).with_data_parallel(
         loss_name=loss.name, build_strategy=bs, mesh=mesh)
 
@@ -94,6 +107,22 @@ def main():
                 "y": global_batch(mesh, gy[lo:lo + LOCAL_B])}
         (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
         losses.append(float(np.asarray(lv)))
+        if ckpt_dir and _step == 1:
+            fluid.io.save_sharded(exe, ckpt_dir, main_program=main_prog)
+            # PERTURB the state with an off-stream batch, then load:
+            # the remaining trajectory only matches the reference if
+            # load actually rewinds the parameters (a silently no-op
+            # load would leave the perturbed state and diverge)
+            rng2 = np.random.RandomState(99)
+            px = rng2.rand(2 * LOCAL_B, 4).astype("float32")
+            py = rng2.rand(2 * LOCAL_B, 1).astype("float32")
+            exe.run(compiled,
+                    feed={"x": global_batch(mesh, px[lo:lo + LOCAL_B]),
+                          "y": global_batch(mesh, py[lo:lo + LOCAL_B])},
+                    fetch_list=[loss])
+            fluid.io.load_sharded(exe, ckpt_dir, main_program=main_prog,
+                                  mesh=mesh,
+                                  sharding_rules=bs.sharding_rules)
     print("DIST_LOSSES " + json.dumps(losses), flush=True)
 
 
