@@ -220,20 +220,23 @@ def _dense_equiv_flops(feed, build_no_flash):
 def bench_transformer(batch_size: int, steps: int, warmup: int,
                       max_length: int = 256, use_amp: bool = True,
                       use_flash: bool = True, use_fused_ce: bool = False,
-                      fused_qkv: bool = False, moe_experts: int = 0):
+                      fused_qkv: bool = False, moe_experts: int = 0,
+                      flash_pallas: bool = False):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    def build(flash, fused_ce=use_fused_ce, fq=None, moe=None):
+    def build(flash, fused_ce=use_fused_ce, fq=None, moe=None,
+              pallas=None):
         return transformer.build_model(
             src_vocab_size=32000, trg_vocab_size=32000,
             max_length=max_length, n_layer=6, n_head=8, d_model=512,
             d_inner_hid=2048, dropout=0.1, use_flash=flash,
             use_amp=use_amp, use_fused_ce=fused_ce,
             fused_qkv=fused_qkv if fq is None else fq,
-            moe_experts=moe_experts if moe is None else moe)
+            moe_experts=moe_experts if moe is None else moe,
+            flash_pallas=flash_pallas if pallas is None else pallas)
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -244,11 +247,13 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         feed = {k: jnp.asarray(v) for k, v in
                 transformer.make_fake_batch(batch_size, max_length,
                                             32000, 32000).items()}
-        if use_flash or use_fused_ce:
+        if (use_flash and flash_pallas) or use_fused_ce:
             # dense-equivalent numerator whenever any Pallas kernel is
-            # active (custom calls report zero flops to XLA)
+            # active (custom calls report zero flops to XLA); the XLA
+            # flash path reports real flops, no twin needed
             step_flops = _dense_equiv_flops(
-                feed, lambda: build(False, fused_ce=False, fq=False))
+                feed, lambda: build(False, fused_ce=False, fq=False,
+                                    pallas=False))
         else:
             cost = exe.cost_analysis(main, feed=feed,
                                      fetch_list=[model["loss"]])
@@ -260,10 +265,12 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         {"tokens_per_sec": round(batch_size * max_length * steps
                                  / elapsed, 1),
          "batch_size": batch_size, "max_length": max_length,
-         "amp": use_amp, "flash": use_flash, "fused_ce": use_fused_ce,
+         "amp": use_amp, "flash": use_flash,
+         "flash_pallas": flash_pallas, "fused_ce": use_fused_ce,
          "fused_qkv": fused_qkv, "moe_experts": moe_experts,
          "flop_count": ("dense-equivalent"
-                        if (use_flash or use_fused_ce) else "xla"),
+                        if ((use_flash and flash_pallas)
+                            or use_fused_ce) else "xla"),
          "last_loss": last_loss})
 
 
@@ -561,6 +568,10 @@ def main():
     p.add_argument("--moe-experts", type=int, default=0,
                    help="transformer: swap FFN sublayers for switch-MoE "
                         "blocks with this many experts (0 = dense)")
+    p.add_argument("--pallas-attn", action="store_true",
+                   help="transformer: route flash attention through "
+                        "the tiled Pallas kernel instead of the XLA "
+                        "composition (A/B candidate)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of each timed "
                         "window into DIR (feeds the MFU-gap analysis)")
@@ -679,7 +690,8 @@ def main():
         _run("transformer", bench_transformer, args.batch or 64,
              args.steps, args.warmup, use_amp=amp,
              use_flash=not args.no_flash, use_fused_ce=args.fused_ce,
-             fused_qkv=args.fused_qkv, moe_experts=args.moe_experts)
+             fused_qkv=args.fused_qkv, moe_experts=args.moe_experts,
+             flash_pallas=args.pallas_attn)
     if args.model in ("all", "bert"):
         _run("bert", bench_bert, args.batch or 32, args.steps,
              args.warmup, use_amp=amp, use_flash=not args.no_flash)

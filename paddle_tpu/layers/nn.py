@@ -965,16 +965,21 @@ def grid_sampler(x, grid, name=None):
 
 
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
-                    use_pallas=False, name=None):
+                    use_pallas=None, sequence_parallel=False,
+                    name=None):
     """Fused multi-head attention over (N, H, T, D) tensors (see
     ops/attention.py).  The TPU-native replacement for composing
-    matmul+softmax+matmul by hand."""
+    matmul+softmax+matmul by hand.  With sequence_parallel=True and a
+    CompiledProgram mesh that has an `sp` axis, the sequence dimension
+    shards over sp and runs ring attention (long-context path; causal/
+    no-bias only)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     ins = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         ins["Bias"] = [bias]
-    attrs = {"causal": causal, "use_pallas": use_pallas}
+    attrs = {"causal": causal, "use_pallas": use_pallas,
+             "sequence_parallel": sequence_parallel}
     if scale is not None:
         attrs["scale"] = float(scale)
     helper.append_op(type="flash_attention", inputs=ins,

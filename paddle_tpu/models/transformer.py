@@ -21,7 +21,8 @@ from ..initializer import Normal
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head, dropout_rate=0.0,
-                         use_flash=False, fused_qkv=False):
+                         use_flash=False, fused_qkv=False,
+                         flash_pallas=None):
     if keys is None and fused_qkv:
         # Megatron-style fused QKV: ONE (D, (2dk+dv)·H) matmul instead
         # of three — a 3× wider MXU tile per layer.  The fused output
@@ -68,8 +69,12 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         v = split_heads(v, d_value)
 
     if use_flash:
+        # flash_pallas=True routes through the tiled Pallas kernel
+        # (ops/pallas/flash_attention.py); default None/False keeps the
+        # XLA composition inside the op — the historically-benched path
         ctx = layers.flash_attention(q, k, v, attn_bias,
-                                     scale=d_key ** -0.5)
+                                     scale=d_key ** -0.5,
+                                     use_pallas=flash_pallas)
     else:
         product = layers.matmul(q, k, transpose_y=True,
                                 alpha=d_key ** -0.5)
@@ -127,11 +132,11 @@ def _ffn_or_moe(x, d_inner, d_model, moe_experts, aux_list):
 
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
                   dropout, use_flash=False, fused_qkv=False,
-                  moe_experts=0, aux_list=None):
+                  moe_experts=0, aux_list=None, flash_pallas=None):
     attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, attn_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
-        fused_qkv=fused_qkv)
+        fused_qkv=fused_qkv, flash_pallas=flash_pallas)
     attn = pre_post_process(x, attn, "ad", dropout)
     ff = _ffn_or_moe(pre_post_process(None, attn, "n"), d_inner,
                      d_model, moe_experts, aux_list)
@@ -140,11 +145,12 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
 
 def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
                   d_model, d_inner, dropout, use_flash=False,
-                  fused_qkv=False, moe_experts=0, aux_list=None):
+                  fused_qkv=False, moe_experts=0, aux_list=None,
+                  flash_pallas=None):
     self_attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, self_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
-        fused_qkv=fused_qkv)
+        fused_qkv=fused_qkv, flash_pallas=flash_pallas)
     self_attn = pre_post_process(x, self_attn, "ad", dropout)
     q = pre_post_process(None, self_attn, "n")
     cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
@@ -204,7 +210,7 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
                 use_flash=False, use_fused_ce=False, fused_qkv=False,
-                moe_experts=0, moe_aux_weight=0.01):
+                moe_experts=0, moe_aux_weight=0.01, flash_pallas=None):
     """Build the full training graph; returns (avg_cost, logits, feeds).
     moe_experts > 0 swaps every FFN sublayer for a switch-MoE block
     (experts sharded over mp/ep) and folds the load-balance aux losses
@@ -232,7 +238,7 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
         x = encoder_layer(x, src_bias, n_head, d_key, d_value, d_model,
                           d_inner_hid, dropout, use_flash=use_flash,
                           fused_qkv=fused_qkv, moe_experts=moe_experts,
-                          aux_list=moe_aux)
+                          aux_list=moe_aux, flash_pallas=flash_pallas)
     enc_out = pre_post_process(None, x, "n")
 
     # decoder
@@ -243,7 +249,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
         y = decoder_layer(y, enc_out, self_bias, src_bias, n_head, d_key,
                           d_value, d_model, d_inner_hid, dropout,
                           use_flash=use_flash, fused_qkv=fused_qkv,
-                          moe_experts=moe_experts, aux_list=moe_aux)
+                          moe_experts=moe_experts, aux_list=moe_aux,
+                          flash_pallas=flash_pallas)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -305,13 +312,13 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
                 use_amp=False, use_fused_ce=False, fused_qkv=False,
-                moe_experts=0):
+                moe_experts=0, flash_pallas=None):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
         dropout, label_smooth_eps, use_flash=use_flash,
         use_fused_ce=use_fused_ce, fused_qkv=fused_qkv,
-        moe_experts=moe_experts)
+        moe_experts=moe_experts, flash_pallas=flash_pallas)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

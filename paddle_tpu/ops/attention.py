@@ -40,6 +40,39 @@ def flash_attention(ctx, ins, attrs):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     causal = attrs.get("causal", False)
+    if attrs.get("sequence_parallel", False):
+        # long-context path: shard the sequence axis over the mesh's
+        # sp axis and run ring attention (KV rotation via ppermute,
+        # parallel/ring_attention.py).  Only reachable inside a
+        # CompiledProgram traced under a mesh WITH an sp axis.
+        from ..parallel.mesh import get_executing_mesh
+
+        mesh = get_executing_mesh()
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            if bias is not None:
+                raise ValueError(
+                    "sequence_parallel flash_attention does not take "
+                    "an additive Bias: ring attention supports causal "
+                    "masking only — drop padding bias (full-length "
+                    "sequences / packed batches) or disable "
+                    "sequence_parallel")
+            sp = mesh.shape["sp"]
+            if q.shape[2] % sp != 0:
+                raise ValueError(
+                    f"sequence_parallel flash_attention: sequence "
+                    f"length {q.shape[2]} must divide the sp axis "
+                    f"({sp}) — pad T to a multiple")
+            from ..parallel.ring_attention import ring_attention
+
+            # use_pallas None = ring's auto (Pallas on TPU); the batch
+            # axis keeps dp-sharded activations dp-sharded inside the
+            # shard_map instead of all-gathering per dp group
+            o = ring_attention(q, k, v, mesh, axis="sp", scale=scale,
+                               causal=causal,
+                               use_pallas=attrs.get("use_pallas"),
+                               batch_axis="dp")
+            return out(Out=o)
+        # no sp axis in this compile: fall through to the local kernel
     if attrs.get("use_pallas", False):
         from .pallas.flash_attention import pallas_flash_attention
 

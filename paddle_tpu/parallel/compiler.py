@@ -103,7 +103,10 @@ class CompiledProgram:
 
         shape = np.shape(value)
         dp = self._mesh.shape.get(self._batch_axis, 1)
-        if len(shape) >= 1 and shape[0] % dp == 0 and shape[0] > 0:
+        # a mesh WITHOUT the batch axis (e.g. pure {"sp": N}) must not
+        # reference it in a spec; feeds replicate
+        if (dp > 1 and len(shape) >= 1 and shape[0] % dp == 0
+                and shape[0] > 0):
             return NamedSharding(
                 self._mesh, P(self._batch_axis, *([None] * (len(shape) - 1))))
         return NamedSharding(self._mesh, P())
@@ -159,13 +162,16 @@ class CompiledProgram:
             feed_names = tuple(sorted(feed))
 
             def step(st, feeds):
+                from .mesh import executing_mesh
+
                 rng_key = st[RNG_STATE_VAR]
                 env = {k: v for k, v in st.items() if k != RNG_STATE_VAR}
                 env.update(feeds)
-                env = interpret_program(program, env, rng_key,
-                                        fetch_names=fetch_names,
-                                        accum_steps=accum,
-                                        feed_names=feed_names)
+                with executing_mesh(self._mesh):
+                    env = interpret_program(program, env, rng_key,
+                                            fetch_names=fetch_names,
+                                            accum_steps=accum,
+                                            feed_names=feed_names)
                 new_state = {n: env[n] for n in persistable_names
                              if n in env}
                 new_state[RNG_STATE_VAR] = jax.random.split(rng_key, 1)[0]

@@ -34,11 +34,37 @@ def make_mesh(axes: Dict[str, int], devices=None):
 
 
 _default_mesh = None
+_executing_mesh = None
 
 
 def set_default_mesh(mesh):
     global _default_mesh
     _default_mesh = mesh
+
+
+class executing_mesh:
+    """Trace-time marker: the mesh a CompiledProgram is being traced
+    under.  Mesh-aware op impls (sequence-parallel flash attention)
+    read it via get_executing_mesh() to route onto shard_map
+    collectives; it is set only while the wrapper traces its step."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        global _executing_mesh
+        self._prev = _executing_mesh
+        _executing_mesh = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        global _executing_mesh
+        _executing_mesh = self._prev
+        return False
+
+
+def get_executing_mesh():
+    return _executing_mesh
 
 
 def get_default_mesh(create_dp: bool = True):

@@ -59,13 +59,17 @@ def _merge(o_a, lse_a, o_b, lse_b):
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
-                   causal: bool = False, use_pallas=None):
+                   causal: bool = False, use_pallas=None,
+                   batch_axis=None):
     """q/k/v: GLOBAL (N, H, T, D) logically sharded over T on `axis`.
     Returns the full attention output with the same sharding.
 
     use_pallas: route each rotated chunk through the tiled Pallas flash
     kernel (forward AND backward O(t_local) memory, causal masking via
-    the kernel's global-offset scalars).  Default: auto (on for TPU)."""
+    the kernel's global-offset scalars).  Default: auto (on for TPU).
+    batch_axis: mesh axis the batch dim is sharded over (e.g. "dp" on a
+    dp x sp mesh) — without it the shard_map boundary would all-gather
+    dp-sharded activations and every dp group would redo the compute."""
     try:
         from jax import shard_map
     except ImportError:
@@ -111,7 +115,9 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
             0, n_dev, body, (o0, lse0, k_l, v_l))
         return o
 
-    spec = P(None, None, axis, None)
+    b_ax = (batch_axis if batch_axis
+            and mesh.shape.get(batch_axis, 1) > 1 else None)
+    spec = P(b_ax, None, axis, None)
     fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)

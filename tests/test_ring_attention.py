@@ -96,3 +96,84 @@ def test_ulysses_rejects_bad_heads(qkv):
     mesh = make_mesh({"sp": 8})
     with pytest.raises(ValueError):
         ulysses_attention(q[:, :3], k[:, :3], v[:, :3], mesh)
+
+
+def test_sequence_parallel_flash_in_fluid_program():
+    """layers.flash_attention(sequence_parallel=True) inside a
+    CompiledProgram over an sp mesh: the fluid program's attention runs
+    as ring attention (KV ppermute rotation) and the TRAINING
+    trajectory matches the unsharded program exactly."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    N, H, T, D = 2, 2, 32, 8
+
+    def run(mesh):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        scope = fluid.Scope()
+        losses = []
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            x = fluid.layers.data("x", shape=[N, T, H * D],
+                                  append_batch_size=False)
+            qkv = layers.fc(x, size=3 * H * D, num_flatten_dims=2,
+                            bias_attr=False, name="attn_qkv")
+            r = layers.reshape(qkv, shape=[0, 0, H, 3 * D])
+            r = layers.transpose(r, perm=[0, 2, 1, 3])
+            q = layers.slice(r, axes=[3], starts=[0], ends=[D])
+            k = layers.slice(r, axes=[3], starts=[D], ends=[2 * D])
+            v = layers.slice(r, axes=[3], starts=[2 * D],
+                             ends=[3 * D])
+            att = layers.flash_attention(q, k, v, causal=True,
+                                         sequence_parallel=True)
+            loss = layers.reduce_mean(layers.square(att))
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            prog = main
+            if mesh is not None:
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name, mesh=mesh)
+            feed = {"x": np.random.RandomState(0)
+                    .randn(N, T, H * D).astype(np.float32)}
+            for _ in range(3):
+                (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        return losses
+
+    sp = run(make_mesh({"sp": 8}))
+    single = run(None)
+    assert all(np.isfinite(sp))
+    assert sp[-1] < sp[0]
+    np.testing.assert_allclose(sp, single, rtol=1e-4, atol=1e-6)
+
+
+def test_sequence_parallel_flash_rejects_bias():
+    """sequence_parallel + additive Bias must fail loudly (ring path
+    supports causal masking only)."""
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        q = fluid.layers.data("q", shape=[2, 2, 16, 4],
+                              append_batch_size=False)
+        bias = fluid.layers.data("b", shape=[2, 1, 16, 16],
+                                 append_batch_size=False)
+        o = layers.flash_attention(q, q, q, bias=bias, causal=True,
+                                   sequence_parallel=True)
+        loss = layers.reduce_mean(o)
+        exe = fluid.Executor()
+        exe.run(startup)
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=None, mesh=make_mesh({"sp": 8}))
+        rng = np.random.RandomState(1)
+        with pytest.raises(Exception, match="sequence_parallel"):
+            exe.run(prog,
+                    feed={"q": rng.rand(2, 2, 16, 4).astype(np.float32),
+                          "b": np.zeros((2, 1, 16, 16), np.float32)},
+                    fetch_list=[loss])

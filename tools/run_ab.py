@@ -27,6 +27,8 @@ VARIANTS = [
     ("transformer_fused_qkv", ["--model", "transformer", "--fused-qkv"]),
     ("transformer_fused_both", ["--model", "transformer", "--fused-ce",
                                 "--fused-qkv"]),
+    ("transformer_pallas_attn", ["--model", "transformer",
+                                 "--pallas-attn"]),
 ]
 
 
@@ -83,6 +85,8 @@ def main():
         "fused_ce_wins": (mfu("transformer_fused_ce") or 0)
         > (mfu("transformer_base") or 0),
         "fused_qkv_wins": (mfu("transformer_fused_qkv") or 0)
+        > (mfu("transformer_base") or 0),
+        "pallas_attn_wins": (mfu("transformer_pallas_attn") or 0)
         > (mfu("transformer_base") or 0),
     }
     results["summary"] = summary
