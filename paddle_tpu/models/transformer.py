@@ -22,7 +22,7 @@ from ..initializer import Normal
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head, dropout_rate=0.0,
                          use_flash=False, fused_qkv=False,
-                         flash_pallas=None):
+                         flash_pallas=None, causal=False):
     if keys is None and fused_qkv:
         # Megatron-style fused QKV: ONE (D, (2dk+dv)·H) matmul instead
         # of three — a 3× wider MXU tile per layer.  The fused output
@@ -71,9 +71,13 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     if use_flash:
         # flash_pallas=True routes through the tiled Pallas kernel
         # (ops/pallas/flash_attention.py); default None/False keeps the
-        # XLA composition inside the op — the historically-benched path
+        # XLA composition inside the op — the historically-benched path.
+        # causal=True (decoder self-attn under flash) uses the op's
+        # in-kernel causal masking with a key-padding-only bias, the
+        # form the Pallas kernel supports natively.
         ctx = layers.flash_attention(q, k, v, attn_bias,
                                      scale=d_key ** -0.5,
+                                     causal=causal,
                                      use_pallas=flash_pallas)
     else:
         product = layers.matmul(q, k, transpose_y=True,
@@ -146,11 +150,12 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
 def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
                   d_model, d_inner, dropout, use_flash=False,
                   fused_qkv=False, moe_experts=0, aux_list=None,
-                  flash_pallas=None):
+                  flash_pallas=None, self_causal=False):
     self_attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, self_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
-        fused_qkv=fused_qkv, flash_pallas=flash_pallas)
+        fused_qkv=fused_qkv, flash_pallas=flash_pallas,
+        causal=self_causal)
     self_attn = pre_post_process(x, self_attn, "ad", dropout)
     q = pre_post_process(None, self_attn, "n")
     cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
@@ -227,8 +232,16 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
 
     src_bias = _padding_bias(src_len, max_length)
     trg_pad_bias = _padding_bias(trg_len, max_length)
-    causal = _causal_bias(max_length)
-    self_bias = layers.elementwise_add(trg_pad_bias, causal)
+    if use_flash:
+        # flash path: decoder self-attn takes the key-padding bias +
+        # the op's causal flag (the Pallas kernel's native form; the
+        # XLA path inside the op applies the same mask)
+        self_bias = trg_pad_bias
+        self_causal = True
+    else:
+        causal = _causal_bias(max_length)
+        self_bias = layers.elementwise_add(trg_pad_bias, causal)
+        self_causal = False
 
     # encoder
     enc_in = _prepare_input(src_word, src_vocab_size, d_model, max_length,
@@ -250,7 +263,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                           d_value, d_model, d_inner_hid, dropout,
                           use_flash=use_flash, fused_qkv=fused_qkv,
                           moe_experts=moe_experts, aux_list=moe_aux,
-                          flash_pallas=flash_pallas)
+                          flash_pallas=flash_pallas,
+                          self_causal=self_causal)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:

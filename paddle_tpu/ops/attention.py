@@ -74,6 +74,24 @@ def flash_attention(ctx, ins, attrs):
             return out(Out=o)
         # no sp axis in this compile: fall through to the local kernel
     if attrs.get("use_pallas", False):
+        def _kernel_bias_ok(b):
+            # the tiled kernel takes a KEY-padding bias broadcastable
+            # TO (N, 1, 1, Tk): every (right-aligned) dim must be 1 or
+            # match the target
+            target = (q.shape[0], 1, 1, k.shape[2])
+            if b.ndim > 4:
+                return False
+            for bd, td in zip(reversed(b.shape), reversed(target)):
+                if bd != 1 and bd != td:
+                    return False
+            return True
+
+        if bias is not None and not _kernel_bias_ok(bias):
+            # richer biases ((Tq, Tk) shapes, per-head biases) take the
+            # documented XLA fallback — express causal+padding as
+            # causal=True + a key bias to stay on the kernel
+            o = _xla_attention(q, k, v, bias, scale, causal)
+            return out(Out=o)
         from .pallas.flash_attention import pallas_flash_attention
 
         o = pallas_flash_attention(q, k, v, bias, scale, causal)
