@@ -120,41 +120,123 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
     from .registry import get_macro_op_impl, is_macro_op
     from .selected_rows import densify
 
-    for i, op in enumerate(ops):
-        desc = op.desc
-        try:
-            if is_macro_op(desc.type):
-                ctx = OpContext(rng_key, op_index=start_index + i,
-                                program=program, amp_lists=amp_lists)
-                get_macro_op_impl(desc.type)(ctx, env, desc)
+    # rematerialization: maximal runs of consecutive ops sharing a
+    # __recompute__ tag (fluid.recompute_scope) execute inside
+    # jax.checkpoint — their activations are recomputed in the backward
+    # instead of saved.  Macro (control-flow) ops never join a segment.
+    i = 0
+    n_ops = len(ops)
+    while i < n_ops:
+        tag = ops[i].desc.attrs.get("__recompute__")
+        if tag is not None and not is_macro_op(ops[i].desc.type):
+            j = i
+            while (j < n_ops
+                   and ops[j].desc.attrs.get("__recompute__") == tag
+                   and not is_macro_op(ops[j].desc.type)):
+                j += 1
+            # a 1-op segment gains nothing from remat (inputs AND
+            # outputs are saved regardless) and would break the
+            # control-flow vjp replay, which re-traces ops one at a
+            # time relying on CSE to merge with the forward
+            # (ops/control_flow.py) — checkpoint only real runs
+            if j - i >= 2:
+                _run_checkpointed_segment(
+                    ops[i:j], env, rng_key, start_index + i,
+                    amp_lists=amp_lists, program=program,
+                    sparse_rows=sparse_rows)
+                i = j
                 continue
-            impl = get_op_impl(desc.type)
-            ins = {
-                slot: [env[n] for n in names]
-                for slot, names in desc.inputs.items()
-            }
-            if desc.type not in SPARSE_AWARE_OPS:
-                ins = {slot: [densify(v) for v in vals]
-                       for slot, vals in ins.items()}
-            if amp_lists is not None:
-                from ..amp import cast_ins_for_op
+        _run_one_op(ops[i], env, rng_key, start_index + i,
+                    amp_lists=amp_lists, program=program,
+                    sparse_rows=sparse_rows)
+        i += 1
+    return env
 
-                ins = cast_ins_for_op(desc.type, ins, amp_lists)
-            ctx = OpContext(rng_key, op_index=start_index + i,
-                            program=program, amp_lists=amp_lists,
-                            sparse_rows=sparse_rows)
-            outs = impl(ctx, ins, desc.attrs)
-        except Exception as exc:
-            _reraise_with_op_context(exc, desc, start_index + i)
-        for slot, names in desc.outputs.items():
-            values = outs.get(slot, [])
-            if len(values) != len(names):
-                raise RuntimeError(
-                    f"op {desc.type}: output slot {slot!r} produced "
-                    f"{len(values)} values for {len(names)} names"
-                )
-            for name, val in zip(names, values):
-                env[name] = val
+
+def _run_checkpointed_segment(seg_ops, env, rng_key, start_index,
+                              amp_lists=None, program=None,
+                              sparse_rows=None):
+    """Execute a recompute segment under jax.checkpoint.  All env names
+    the segment reads enter as EXPLICIT arguments (closed-over tracers
+    would be saved as residuals, defeating the remat); every name it
+    writes merges back into env."""
+    import jax
+
+    read, written = [], set()
+    read_set = set()
+    for op in seg_ops:
+        for n in op.desc.input_names():
+            if n not in written and n in env and n not in read_set:
+                read.append(n)
+                read_set.add(n)
+        written.update(op.desc.output_names())
+    out_names = sorted(written)
+
+    # non-array env entries (host constants) can't cross the
+    # checkpoint boundary as traced args; keep them closed-over
+    import numpy as np
+
+    def _is_arrayish(v):
+        return hasattr(v, "dtype") or isinstance(
+            v, (np.ndarray, float, int, bool))
+
+    arr_in = [n for n in read if _is_arrayish(env[n])]
+    arr_set = set(arr_in)
+    other_in = {n: env[n] for n in read if n not in arr_set}
+
+    @jax.checkpoint
+    def seg_fn(rk, *vals):
+        local = dict(other_in)
+        local.update(zip(arr_in, vals))
+        for k, op in enumerate(seg_ops):
+            _run_one_op(op, local, rk, start_index + k,
+                        amp_lists=amp_lists, program=program,
+                        sparse_rows=sparse_rows)
+        return tuple(local[n] for n in out_names)
+
+    results = seg_fn(rng_key, *(env[n] for n in arr_in))
+    env.update(zip(out_names, results))
+
+
+def _run_one_op(op, env, rng_key, op_index, amp_lists=None,
+                program=None, sparse_rows=None):
+    from .registry import get_macro_op_impl, is_macro_op
+    from .selected_rows import densify
+
+    desc = op.desc
+    try:
+        if is_macro_op(desc.type):
+            ctx = OpContext(rng_key, op_index=op_index,
+                            program=program, amp_lists=amp_lists)
+            get_macro_op_impl(desc.type)(ctx, env, desc)
+            return env
+        impl = get_op_impl(desc.type)
+        ins = {
+            slot: [env[n] for n in names]
+            for slot, names in desc.inputs.items()
+        }
+        if desc.type not in SPARSE_AWARE_OPS:
+            ins = {slot: [densify(v) for v in vals]
+                   for slot, vals in ins.items()}
+        if amp_lists is not None:
+            from ..amp import cast_ins_for_op
+
+            ins = cast_ins_for_op(desc.type, ins, amp_lists)
+        ctx = OpContext(rng_key, op_index=op_index,
+                        program=program, amp_lists=amp_lists,
+                        sparse_rows=sparse_rows)
+        outs = impl(ctx, ins, desc.attrs)
+    except Exception as exc:
+        _reraise_with_op_context(exc, desc, op_index)
+    for slot, names in desc.outputs.items():
+        values = outs.get(slot, [])
+        if len(values) != len(names):
+            raise RuntimeError(
+                f"op {desc.type}: output slot {slot!r} produced "
+                f"{len(values)} values for {len(names)} names"
+            )
+        for name, val in zip(names, values):
+            env[name] = val
     return env
 
 

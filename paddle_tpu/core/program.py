@@ -301,6 +301,13 @@ class Block:
             outputs=_slot_names(outputs),
             attrs=dict(attrs or {}),
         )
+        # ops built inside a fluid.recompute_scope() carry the scope's
+        # tag; the executor wraps each maximal tagged run in
+        # jax.checkpoint (rematerialization — recompute instead of
+        # storing activations for the backward)
+        tag = getattr(self.program, "_recompute_tag", None)
+        if tag is not None and "__recompute__" not in desc.attrs:
+            desc.attrs["__recompute__"] = tag
         op = Operator(self, desc)
         self.ops.append(op)
         self.program._bump()
@@ -553,6 +560,30 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
     finally:
         _main_program = old_main
         _startup_program = old_startup
+
+
+_recompute_counter = [0]
+
+
+@contextlib.contextmanager
+def recompute_scope(main_program: Optional[Program] = None):
+    """Mark the ops built inside this scope for rematerialization: the
+    executor wraps them in jax.checkpoint, so their activations are
+    RECOMPUTED during the backward instead of stored — the TPU way to
+    trade FLOPs for HBM on deep stacks.  (The 1.2 reference predates
+    RecomputeOptimizer; on TPU this is a one-liner around XLA's remat.)
+
+        with fluid.recompute_scope():
+            x = encoder_layer(x, ...)
+    """
+    program = main_program or default_main_program()
+    _recompute_counter[0] += 1
+    prev = getattr(program, "_recompute_tag", None)
+    program._recompute_tag = _recompute_counter[0]
+    try:
+        yield
+    finally:
+        program._recompute_tag = prev
 
 
 @contextlib.contextmanager
