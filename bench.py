@@ -221,14 +221,15 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                       max_length: int = 256, use_amp: bool = True,
                       use_flash: bool = True, use_fused_ce: bool = False,
                       fused_qkv: bool = False, moe_experts: int = 0,
-                      flash_pallas: bool = False):
+                      flash_pallas: bool = False,
+                      recompute: bool = False):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
     def build(flash, fused_ce=use_fused_ce, fq=None, moe=None,
-              pallas=None):
+              pallas=None, rc=None):
         return transformer.build_model(
             src_vocab_size=32000, trg_vocab_size=32000,
             max_length=max_length, n_layer=6, n_head=8, d_model=512,
@@ -236,7 +237,8 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
             use_amp=use_amp, use_fused_ce=fused_ce,
             fused_qkv=fused_qkv if fq is None else fq,
             moe_experts=moe_experts if moe is None else moe,
-            flash_pallas=flash_pallas if pallas is None else pallas)
+            flash_pallas=flash_pallas if pallas is None else pallas,
+            recompute=recompute if rc is None else rc)
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -247,13 +249,15 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         feed = {k: jnp.asarray(v) for k, v in
                 transformer.make_fake_batch(batch_size, max_length,
                                             32000, 32000).items()}
-        if (use_flash and flash_pallas) or use_fused_ce:
-            # dense-equivalent numerator whenever any Pallas kernel is
-            # active (custom calls report zero flops to XLA); the XLA
-            # flash path reports real flops, no twin needed
+        if (use_flash and flash_pallas) or use_fused_ce or recompute:
+            # twin-program numerator whenever the measured program's own
+            # cost analysis would lie: active Pallas kernels report ZERO
+            # flops, and a remat program DOUBLE-counts the recomputed
+            # forward — the twin (no Pallas, no recompute) carries the
+            # algorithmic flop count
             step_flops = _dense_equiv_flops(
                 feed, lambda: build(False, fused_ce=False, fq=False,
-                                    pallas=False))
+                                    pallas=False, rc=False))
         else:
             cost = exe.cost_analysis(main, feed=feed,
                                      fetch_list=[model["loss"]])
@@ -268,9 +272,10 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "amp": use_amp, "flash": use_flash,
          "flash_pallas": flash_pallas, "fused_ce": use_fused_ce,
          "fused_qkv": fused_qkv, "moe_experts": moe_experts,
+         "recompute": recompute,
          "flop_count": ("dense-equivalent"
                         if ((use_flash and flash_pallas)
-                            or use_fused_ce) else "xla"),
+                            or use_fused_ce or recompute) else "xla"),
          "last_loss": last_loss})
 
 
@@ -568,6 +573,10 @@ def main():
     p.add_argument("--moe-experts", type=int, default=0,
                    help="transformer: swap FFN sublayers for switch-MoE "
                         "blocks with this many experts (0 = dense)")
+    p.add_argument("--recompute", action="store_true",
+                   help="transformer: rematerialize encoder/decoder "
+                        "layers (HBM for FLOPs; pair with a larger "
+                        "--batch)")
     p.add_argument("--pallas-attn", action="store_true",
                    help="transformer: route flash attention through "
                         "the tiled Pallas kernel instead of the XLA "
@@ -691,7 +700,7 @@ def main():
              args.steps, args.warmup, use_amp=amp,
              use_flash=not args.no_flash, use_fused_ce=args.fused_ce,
              fused_qkv=args.fused_qkv, moe_experts=args.moe_experts,
-             flash_pallas=args.pallas_attn)
+             flash_pallas=args.pallas_attn, recompute=args.recompute)
     if args.model in ("all", "bert"):
         _run("bert", bench_bert, args.batch or 32, args.steps,
              args.warmup, use_amp=amp, use_flash=not args.no_flash)

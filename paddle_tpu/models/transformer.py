@@ -215,11 +215,21 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
                 use_flash=False, use_fused_ce=False, fused_qkv=False,
-                moe_experts=0, moe_aux_weight=0.01, flash_pallas=None):
+                moe_experts=0, moe_aux_weight=0.01, flash_pallas=None,
+                recompute=False):
     """Build the full training graph; returns (avg_cost, logits, feeds).
     moe_experts > 0 swaps every FFN sublayer for a switch-MoE block
     (experts sharded over mp/ep) and folds the load-balance aux losses
-    into the objective with weight moe_aux_weight."""
+    into the objective with weight moe_aux_weight.  recompute=True
+    wraps every encoder/decoder layer in fluid.recompute_scope
+    (activations rematerialized in the backward — HBM for FLOPs)."""
+    import contextlib
+
+    from ..core.program import recompute_scope
+
+    def layer_scope():
+        return recompute_scope() if recompute else contextlib.nullcontext()
+
     moe_aux: list = []
     src_word = layers.data(name="src_word", shape=[max_length],
                            dtype="int64")
@@ -248,10 +258,13 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                             dropout, "src_word_emb")
     x = enc_in
     for _ in range(n_layer):
-        x = encoder_layer(x, src_bias, n_head, d_key, d_value, d_model,
-                          d_inner_hid, dropout, use_flash=use_flash,
-                          fused_qkv=fused_qkv, moe_experts=moe_experts,
-                          aux_list=moe_aux, flash_pallas=flash_pallas)
+        with layer_scope():
+            x = encoder_layer(x, src_bias, n_head, d_key, d_value,
+                              d_model, d_inner_hid, dropout,
+                              use_flash=use_flash, fused_qkv=fused_qkv,
+                              moe_experts=moe_experts,
+                              aux_list=moe_aux,
+                              flash_pallas=flash_pallas)
     enc_out = pre_post_process(None, x, "n")
 
     # decoder
@@ -259,12 +272,15 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                             dropout, "trg_word_emb")
     y = dec_in
     for _ in range(n_layer):
-        y = decoder_layer(y, enc_out, self_bias, src_bias, n_head, d_key,
-                          d_value, d_model, d_inner_hid, dropout,
-                          use_flash=use_flash, fused_qkv=fused_qkv,
-                          moe_experts=moe_experts, aux_list=moe_aux,
-                          flash_pallas=flash_pallas,
-                          self_causal=self_causal)
+        with layer_scope():
+            y = decoder_layer(y, enc_out, self_bias, src_bias, n_head,
+                              d_key, d_value, d_model, d_inner_hid,
+                              dropout, use_flash=use_flash,
+                              fused_qkv=fused_qkv,
+                              moe_experts=moe_experts,
+                              aux_list=moe_aux,
+                              flash_pallas=flash_pallas,
+                              self_causal=self_causal)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -326,13 +342,14 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
                 use_amp=False, use_fused_ce=False, fused_qkv=False,
-                moe_experts=0, flash_pallas=None):
+                moe_experts=0, flash_pallas=None, recompute=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
         dropout, label_smooth_eps, use_flash=use_flash,
         use_fused_ce=use_fused_ce, fused_qkv=fused_qkv,
-        moe_experts=moe_experts, flash_pallas=flash_pallas)
+        moe_experts=moe_experts, flash_pallas=flash_pallas,
+        recompute=recompute)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

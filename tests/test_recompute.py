@@ -111,3 +111,35 @@ def test_recompute_scope_nests_and_restores():
             for op in main.global_block().ops]
     assert any(t is not None for t in tags)
     assert tags[0] is None and tags[-1] is None
+
+
+def test_transformer_recompute_option_parity():
+    """build_model(recompute=True) wraps each encoder/decoder layer in
+    a remat scope; trajectory identical to the plain build."""
+    from paddle_tpu.models import transformer
+
+    def run(rc):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 4
+        scope = fluid.Scope()
+        losses = []
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            m = transformer.build_model(
+                src_vocab_size=64, trg_vocab_size=64, max_length=8,
+                n_layer=2, n_head=2, d_model=16, d_inner_hid=32,
+                dropout=0.0, recompute=rc)
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = transformer.make_fake_batch(4, 8, 60, 60)
+            for _ in range(3):
+                lv, = exe.run(main, feed=feed, fetch_list=[m["loss"]])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        if rc:
+            tagged = sum(
+                1 for op in main.global_block().ops
+                if op.desc.attrs.get("__recompute__") is not None)
+            assert tagged > 20  # both stacks tagged
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
