@@ -969,10 +969,12 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                     name=None):
     """Fused multi-head attention over (N, H, T, D) tensors (see
     ops/attention.py).  The TPU-native replacement for composing
-    matmul+softmax+matmul by hand.  With sequence_parallel=True and a
-    CompiledProgram mesh that has an `sp` axis, the sequence dimension
-    shards over sp and runs ring attention (long-context path; causal/
-    no-bias only)."""
+    matmul+softmax+matmul by hand.  With sequence_parallel=True (or
+    "ring" / "ulysses") and a CompiledProgram mesh that has an `sp`
+    axis, the sequence dimension shards over sp and attention runs as
+    ring attention (KV ppermute rotation) or Ulysses (head/sequence
+    all-to-all; needs sp | n_head) — the long-context path; causal/
+    no-bias only."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     ins = {"Q": [q], "K": [k], "V": [v]}

@@ -42,9 +42,16 @@ def flash_attention(ctx, ins, attrs):
     causal = attrs.get("causal", False)
     if attrs.get("sequence_parallel", False):
         # long-context path: shard the sequence axis over the mesh's
-        # sp axis and run ring attention (KV rotation via ppermute,
-        # parallel/ring_attention.py).  Only reachable inside a
-        # CompiledProgram traced under a mesh WITH an sp axis.
+        # sp axis and run ring attention (KV rotation via ppermute) or
+        # Ulysses (head/sequence all-to-all), parallel/ring_attention.
+        # Only ACTIVE inside a CompiledProgram traced under a mesh WITH
+        # an sp axis — but the strategy value validates everywhere so a
+        # typo'd flag can never silently no-op.
+        strategy0 = attrs.get("sequence_parallel")
+        if strategy0 not in (True, "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel must be True/'ring'/'ulysses', "
+                f"got {strategy0!r}")
         from ..parallel.mesh import get_executing_mesh
 
         mesh = get_executing_mesh()
@@ -62,6 +69,21 @@ def flash_attention(ctx, ins, attrs):
                     f"sequence_parallel flash_attention: sequence "
                     f"length {q.shape[2]} must divide the sp axis "
                     f"({sp}) — pad T to a multiple")
+            strategy = "ring" if strategy0 is True else strategy0
+            if strategy == "ulysses":
+                if q.shape[1] % sp != 0:
+                    raise ValueError(
+                        f"ulysses sequence_parallel: the sp axis "
+                        f"({sp}) must divide n_head ({q.shape[1]}) — "
+                        f"use 'ring' for head counts below the sp "
+                        f"degree")
+                from ..parallel.ring_attention import ulysses_attention
+
+                o = ulysses_attention(
+                    q, k, v, mesh, axis="sp", scale=scale,
+                    causal=causal, use_pallas=attrs.get("use_pallas"),
+                    batch_axis="dp")
+                return out(Out=o)
             from ..parallel.ring_attention import ring_attention
 
             # use_pallas None = ring's auto (Pallas on TPU); the batch

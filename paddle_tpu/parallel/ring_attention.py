@@ -124,15 +124,20 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
 
 
 def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
-                      causal: bool = False, use_pallas: bool = False):
+                      causal: bool = False, use_pallas=None,
+                      batch_axis=None):
     """Ulysses sequence parallelism: a2a seq→heads, dense local
     attention, a2a heads→seq.  q/k/v: GLOBAL (N, H, T, D) sharded over T
-    on `axis`; H must be divisible by the axis size."""
+    on `axis`; H must be divisible by the axis size.  use_pallas None =
+    auto (Pallas kernel on TPU), same convention as ring_attention;
+    batch_axis keeps dp-sharded batches sharded inside the shard_map."""
     try:
         from jax import shard_map
     except ImportError:
         from jax.experimental.shard_map import shard_map
 
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
     n_dev = mesh.shape[axis]
     n, h, t, d = q.shape
     if h % n_dev != 0:
@@ -162,7 +167,9 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
                                               causal)
         return heads_to_seq(oh)
 
-    spec = P(None, None, axis, None)
+    b_ax = (batch_axis if batch_axis
+            and mesh.shape.get(batch_axis, 1) > 1 else None)
+    spec = P(b_ax, None, axis, None)
     fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -149,6 +149,42 @@ def test_sequence_parallel_flash_in_fluid_program():
     np.testing.assert_allclose(sp, single, rtol=1e-4, atol=1e-6)
 
 
+def test_sequence_parallel_ulysses_in_fluid_program():
+    """sequence_parallel="ulysses": head/sequence all-to-all strategy
+    from the fluid surface, parity with the unsharded program (H=8
+    divides sp=8)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    N, H, T, D = 2, 8, 32, 4
+
+    def run(mesh):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 6
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            q = fluid.layers.data("q", shape=[N, H, T, D],
+                                  append_batch_size=False)
+            att = layers.flash_attention(
+                q, q, q, causal=True, sequence_parallel="ulysses")
+            loss = layers.reduce_mean(layers.square(att))
+            exe = fluid.Executor()
+            exe.run(startup)
+            prog = main
+            if mesh is not None:
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=None, build_strategy=None, mesh=mesh)
+            feed = {"q": np.random.RandomState(2)
+                    .randn(N, H, T, D).astype(np.float32)}
+            (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            return float(np.asarray(lv).reshape(-1)[0])
+
+    u = run(make_mesh({"sp": 8}))
+    ref = run(None)
+    np.testing.assert_allclose(u, ref, rtol=1e-5)
+
+
 def test_sequence_parallel_flash_rejects_bias():
     """sequence_parallel + additive Bias must fail loudly (ring path
     supports causal masking only)."""
