@@ -792,6 +792,14 @@ def main():
         # profiler-inflated numbers must be distinguishable from clean
         # runs (bench-honesty gate)
         result["profiled"] = args.profile
+    if not failed and result["metric"] != "bench_failed":
+        # the incremental snapshot is crash evidence only — it must
+        # never outlive a clean run (a grep for "mfu" should find the
+        # real artifacts, not a partial)
+        try:
+            os.remove("bench_partial.json")
+        except OSError:
+            pass
     print(json.dumps(result))
 
 

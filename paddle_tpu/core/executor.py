@@ -107,7 +107,8 @@ SPARSE_AWARE_OPS = {"sgd", "momentum", "adam", "adagrad"}
 
 
 def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
-            amp_lists=None, program=None, sparse_rows=None):
+            amp_lists=None, program=None, sparse_rows=None,
+            keep_names=None):
     """Interpret a straight-line op list over `env` (name → traced array).
 
     This runs under jax tracing: each op impl emits jaxpr; nothing executes
@@ -140,10 +141,20 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
             # time relying on CSE to merge with the forward
             # (ops/control_flow.py) — checkpoint only real runs
             if j - i >= 2:
+                # restrict the checkpoint's outputs to names actually
+                # consumed after the segment (later ops in this run, or
+                # the caller's fetch/persistable set) — the HBM saving
+                # must not depend on JAX's remat DCE pruning unused
+                # outputs
+                keep = None
+                if keep_names is not None:
+                    keep = set(keep_names)
+                    for later in ops[j:]:
+                        keep.update(later.desc.input_names())
                 _run_checkpointed_segment(
                     ops[i:j], env, rng_key, start_index + i,
                     amp_lists=amp_lists, program=program,
-                    sparse_rows=sparse_rows)
+                    sparse_rows=sparse_rows, keep=keep)
                 i = j
                 continue
         _run_one_op(ops[i], env, rng_key, start_index + i,
@@ -155,11 +166,12 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
 
 def _run_checkpointed_segment(seg_ops, env, rng_key, start_index,
                               amp_lists=None, program=None,
-                              sparse_rows=None):
+                              sparse_rows=None, keep=None):
     """Execute a recompute segment under jax.checkpoint.  All env names
     the segment reads enter as EXPLICIT arguments (closed-over tracers
-    would be saved as residuals, defeating the remat); every name it
-    writes merges back into env."""
+    would be saved as residuals, defeating the remat); names it writes
+    that someone downstream reads (`keep`; None = all) merge back into
+    env."""
     import jax
 
     read, written = [], set()
@@ -170,7 +182,7 @@ def _run_checkpointed_segment(seg_ops, env, rng_key, start_index,
                 read.append(n)
                 read_set.add(n)
         written.update(op.desc.output_names())
-    out_names = sorted(written)
+    out_names = sorted(written if keep is None else written & keep)
 
     # non-array env entries (host constants) can't cross the
     # checkpoint boundary as traced args; keep them closed-over
@@ -309,21 +321,31 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
 
     info = program._backward_info
     amp_lists = getattr(program, "_amp_lists", None)
+    block = program.global_block()
+    persist = {v.name for v in block.vars.values() if v.persistable}
     if info is None:
         return run_ops(prune_ops(program, fetch_names), env, rng_key,
-                       amp_lists=amp_lists, program=program)
-    ops = program.global_block().ops
+                       amp_lists=amp_lists, program=program,
+                       keep_names=set(fetch_names) | persist)
+    ops = block.ops
 
     k = info["index"]
     loss_name = info["loss"]
     fwd_ops, rest_ops = ops[:k], ops[k:]
     trainable = _split_params(program, env)
+    # names someone reads after the forward section: the loss, fetches,
+    # persistable state, and anything the post-marker (optimizer/metric)
+    # ops consume — everything else a recompute segment writes is
+    # internal and need not leave its jax.checkpoint
+    fwd_keep = set(fetch_names) | persist | {loss_name}
+    for op in rest_ops:
+        fwd_keep.update(op.desc.input_names())
 
     def fwd(params, base_env, key, sparse_rows=None):
         e = dict(base_env)
         e.update(params)
         run_ops(fwd_ops, e, key, amp_lists=amp_lists, program=program,
-                sparse_rows=sparse_rows)
+                sparse_rows=sparse_rows, keep_names=fwd_keep)
         loss = e[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp

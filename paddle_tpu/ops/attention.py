@@ -52,9 +52,14 @@ def flash_attention(ctx, ins, attrs):
             raise ValueError(
                 f"sequence_parallel must be True/'ring'/'ulysses', "
                 f"got {strategy0!r}")
-        from ..parallel.mesh import get_executing_mesh
+        from ..parallel.mesh import get_exec_context
 
-        mesh = get_executing_mesh()
+        ectx = get_exec_context()
+        mesh = None if ectx is None else ectx.mesh
+        # the compiled program's actual batch axis (not a hardcoded
+        # "dp"): a non-default batch axis name must still keep batch
+        # sharding inside the sp shard_map
+        batch_axis = "dp" if ectx is None else ectx.batch_axis
         if mesh is not None and mesh.shape.get("sp", 1) > 1:
             if bias is not None:
                 raise ValueError(
@@ -67,8 +72,8 @@ def flash_attention(ctx, ins, attrs):
             if q.shape[2] % sp != 0:
                 raise ValueError(
                     f"sequence_parallel flash_attention: sequence "
-                    f"length {q.shape[2]} must divide the sp axis "
-                    f"({sp}) — pad T to a multiple")
+                    f"length {q.shape[2]} must be divisible by the sp "
+                    f"axis size ({sp}) — pad T to a multiple")
             strategy = "ring" if strategy0 is True else strategy0
             if strategy == "ulysses":
                 if q.shape[1] % sp != 0:
@@ -82,7 +87,7 @@ def flash_attention(ctx, ins, attrs):
                 o = ulysses_attention(
                     q, k, v, mesh, axis="sp", scale=scale,
                     causal=causal, use_pallas=attrs.get("use_pallas"),
-                    batch_axis="dp")
+                    batch_axis=batch_axis)
                 return out(Out=o)
             from ..parallel.ring_attention import ring_attention
 
@@ -92,7 +97,7 @@ def flash_attention(ctx, ins, attrs):
             o = ring_attention(q, k, v, mesh, axis="sp", scale=scale,
                                causal=causal,
                                use_pallas=attrs.get("use_pallas"),
-                               batch_axis="dp")
+                               batch_axis=batch_axis)
             return out(Out=o)
         # no sp axis in this compile: fall through to the local kernel
     if attrs.get("use_pallas", False):

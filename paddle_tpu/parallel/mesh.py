@@ -12,7 +12,8 @@ Conventional axis names: "dp" (data), "mp" (tensor/model), "sp"
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+import contextvars
+from typing import Dict, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -34,7 +35,6 @@ def make_mesh(axes: Dict[str, int], devices=None):
 
 
 _default_mesh = None
-_executing_mesh = None
 
 
 def set_default_mesh(mesh):
@@ -42,29 +42,52 @@ def set_default_mesh(mesh):
     _default_mesh = mesh
 
 
+class ExecContext(NamedTuple):
+    """What a CompiledProgram trace exposes to mesh-aware op impls:
+    the mesh, the name of the mesh axis the batch dim is sharded over
+    (so sp/pp shard_maps keep dp-sharded activations sharded instead of
+    assuming the axis is literally called "dp"), and the pipeline
+    microbatch count (0 = pipelining off)."""
+
+    mesh: object
+    batch_axis: str = "dp"
+    pipeline_microbatches: int = 0
+
+
+# ContextVar, not a module global: two CompiledPrograms tracing
+# concurrently (threads, or a nested trace) must not cross-contaminate
+# the mesh seen by mesh-aware op impls.
+_exec_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_exec_ctx", default=None)
+
+
 class executing_mesh:
     """Trace-time marker: the mesh a CompiledProgram is being traced
-    under.  Mesh-aware op impls (sequence-parallel flash attention)
-    read it via get_executing_mesh() to route onto shard_map
-    collectives; it is set only while the wrapper traces its step."""
+    under.  Mesh-aware op impls (sequence-parallel flash attention, the
+    pipeline engine) read it via get_executing_mesh() /
+    get_exec_context() to route onto shard_map collectives; it is set
+    only while the wrapper traces its step."""
 
-    def __init__(self, mesh):
-        self._mesh = mesh
+    def __init__(self, mesh, batch_axis: str = "dp",
+                 pipeline_microbatches: int = 0):
+        self._ctx = ExecContext(mesh, batch_axis, pipeline_microbatches)
 
     def __enter__(self):
-        global _executing_mesh
-        self._prev = _executing_mesh
-        _executing_mesh = self._mesh
-        return self._mesh
+        self._token = _exec_ctx.set(self._ctx)
+        return self._ctx.mesh
 
     def __exit__(self, *exc):
-        global _executing_mesh
-        _executing_mesh = self._prev
+        _exec_ctx.reset(self._token)
         return False
 
 
 def get_executing_mesh():
-    return _executing_mesh
+    ctx = _exec_ctx.get()
+    return None if ctx is None else ctx.mesh
+
+
+def get_exec_context() -> Optional[ExecContext]:
+    return _exec_ctx.get()
 
 
 def get_default_mesh(create_dp: bool = True):

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# TPU tunnel probe loop (VERDICT r4 item 1 / outage playbook).
+#
+# Probes the axon backend in a SUBPROCESS with a hard timeout (the hang
+# mode never raises in-process) every INTERVAL seconds, appending one
+# timestamped line per probe to docs/PROBE_r05.log:
+#
+#   2026-07-31T02:10:11Z UP TPU_v5e_x1 (12.3s)
+#   2026-07-31T02:30:12Z DOWN timeout>90s
+#
+# On the first UP it also touches docs/PROBE_UP.flag so a glance at the
+# repo root answers "has the tunnel been alive at any point this round".
+# Runs until killed; intended to be started detached at round start.
+set -u
+cd "$(dirname "$0")/.."
+LOG=docs/PROBE_r05.log
+INTERVAL="${PROBE_INTERVAL:-1200}"
+TIMEOUT="${PROBE_TIMEOUT:-90}"
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  start=$(date +%s.%N)
+  out=$(timeout "$TIMEOUT" python - <<'EOF' 2>&1
+import jax
+ds = jax.devices()
+print("PROBE_OK", len(ds), ds[0].platform, getattr(ds[0], "device_kind", "?"))
+EOF
+)
+  rc=$?
+  dur=$(python -c "import time;print(f'{$(date +%s.%N)-$start:.1f}')")
+  if [ $rc -eq 0 ] && printf '%s' "$out" | grep -q PROBE_OK; then
+    kind=$(printf '%s' "$out" | grep PROBE_OK | awk '{print $3"_"$4"_x"$2}')
+    echo "$ts UP $kind (${dur}s)" >> "$LOG"
+    touch docs/PROBE_UP.flag
+  elif [ $rc -eq 124 ]; then
+    echo "$ts DOWN timeout>${TIMEOUT}s" >> "$LOG"
+  else
+    err=$(printf '%s' "$out" | tail -1 | cut -c1-120)
+    echo "$ts DOWN rc=$rc $err" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
