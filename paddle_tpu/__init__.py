@@ -22,6 +22,7 @@ from .core.executor import (Executor, Scope, global_scope,  # noqa: F401
 from .core.program import (Block, Operator, Parameter, Program,  # noqa: F401
                            Variable, default_main_program,
                            default_startup_program, name_scope,
+                           pipeline_scope, pipeline_segment,
                            program_guard, recompute_scope)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import nets  # noqa: F401

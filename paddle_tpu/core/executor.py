@@ -121,13 +121,66 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
     from .registry import get_macro_op_impl, is_macro_op
     from .selected_rows import densify
 
+    # pipelining: maximal runs of consecutive ops sharing a
+    # __pp_group__ tag (fluid.pipeline_scope) lift into the GPipe
+    # schedule when the executing mesh has a pp axis
+    # (parallel/pipeline_engine.py); on meshes without pp the tags are
+    # inert and the ops run sequentially below.
+    pp_ctx = None
+    if program is not None and any(
+            "__pp_group__" in op.desc.attrs for op in ops):
+        from ..parallel.mesh import get_exec_context
+
+        ectx = get_exec_context()
+        if (ectx is not None
+                and ectx.mesh.shape.get("pp", 1) > 1):
+            pp_ctx = ectx
+
+    # suffix read-sets: segment boundaries below need "names consumed
+    # at or after op j" — precompute them in ONE backward walk
+    # (snapshots only where a tagged run can end) instead of rescanning
+    # ops[j:] per segment, which is quadratic on deep tagged stacks
+    n_ops = len(ops)
+    suffix_reads: Dict[int, set] = {}
+    if keep_names is not None:
+        def _tags(op):
+            return (op.desc.attrs.get("__pp_group__"),
+                    op.desc.attrs.get("__recompute__"))
+
+        needed = {
+            j for j in range(1, n_ops + 1)
+            if _tags(ops[j - 1]) != (None, None)
+            and (j == n_ops or _tags(ops[j]) != _tags(ops[j - 1]))
+        }
+        if needed:
+            acc = set(keep_names)
+            for j in range(n_ops, 0, -1):
+                if j in needed:
+                    suffix_reads[j] = set(acc)
+                acc.update(ops[j - 1].desc.input_names())
+
     # rematerialization: maximal runs of consecutive ops sharing a
     # __recompute__ tag (fluid.recompute_scope) execute inside
     # jax.checkpoint — their activations are recomputed in the backward
     # instead of saved.  Macro (control-flow) ops never join a segment.
     i = 0
-    n_ops = len(ops)
     while i < n_ops:
+        gid = ops[i].desc.attrs.get("__pp_group__")
+        if gid is not None and pp_ctx is not None:
+            j = i
+            while (j < n_ops
+                   and ops[j].desc.attrs.get("__pp_group__") == gid):
+                j += 1
+            from ..parallel.pipeline_engine import run_pipelined_group
+
+            run_pipelined_group(
+                ops[i:j], env, rng_key, start_index + i, program,
+                pp_ctx.mesh, batch_axis=pp_ctx.batch_axis,
+                n_micro_req=pp_ctx.pipeline_microbatches,
+                amp_lists=amp_lists,
+                downstream_reads=suffix_reads.get(j))
+            i = j
+            continue
         tag = ops[i].desc.attrs.get("__recompute__")
         if tag is not None and not is_macro_op(ops[i].desc.type):
             j = i
@@ -142,19 +195,12 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
             # (ops/control_flow.py) — checkpoint only real runs
             if j - i >= 2:
                 # restrict the checkpoint's outputs to names actually
-                # consumed after the segment (later ops in this run, or
-                # the caller's fetch/persistable set) — the HBM saving
-                # must not depend on JAX's remat DCE pruning unused
-                # outputs
-                keep = None
-                if keep_names is not None:
-                    keep = set(keep_names)
-                    for later in ops[j:]:
-                        keep.update(later.desc.input_names())
+                # consumed after the segment — the HBM saving must not
+                # depend on JAX's remat DCE pruning unused outputs
                 _run_checkpointed_segment(
                     ops[i:j], env, rng_key, start_index + i,
                     amp_lists=amp_lists, program=program,
-                    sparse_rows=sparse_rows, keep=keep)
+                    sparse_rows=sparse_rows, keep=suffix_reads.get(j))
                 i = j
                 continue
         _run_one_op(ops[i], env, rng_key, start_index + i,

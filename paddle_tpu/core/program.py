@@ -308,6 +308,13 @@ class Block:
         tag = getattr(self.program, "_recompute_tag", None)
         if tag is not None and "__recompute__" not in desc.attrs:
             desc.attrs["__recompute__"] = tag
+        # ops built inside fluid.pipeline_scope()/pipeline_segment()
+        # carry (group, segment) tags; on a mesh with a pp axis the
+        # executor lifts each tagged group into the GPipe schedule
+        # (parallel/pipeline_engine.py)
+        if getattr(self.program, "_pp_seg_active", False):
+            desc.attrs["__pp_group__"] = self.program._pp_group_tag
+            desc.attrs["__pp_seg__"] = self.program._pp_seg_counter
         op = Operator(self, desc)
         self.ops.append(op)
         self.program._bump()
@@ -584,6 +591,58 @@ def recompute_scope(main_program: Optional[Program] = None):
         yield
     finally:
         program._recompute_tag = prev
+
+
+_pipeline_counter = [0]
+
+
+@contextlib.contextmanager
+def pipeline_scope(main_program: Optional[Program] = None):
+    """Mark a pipelined region: the structurally-identical layer
+    segments built inside (one per `pipeline_segment()`) become GPipe
+    stages when the program executes on a mesh with a "pp" axis
+    (parallel/pipeline_engine.py lifts them into parallel/pipeline.py's
+    shard_map+ppermute schedule).  On a mesh without pp the tags are
+    inert and the ops run sequentially — same math either way.
+
+        with fluid.pipeline_scope():
+            for _ in range(n_layer):
+                with fluid.pipeline_segment():
+                    x = encoder_layer(x, ...)
+
+    The engine requires: segments structurally identical (same op
+    sequence/attrs/shapes, layer-private parameters), a shape-preserved
+    carry (each segment's input activation produced by the previous
+    segment), and all other segment inputs invariant across segments.
+    """
+    program = main_program or default_main_program()
+    _pipeline_counter[0] += 1
+    prev = (getattr(program, "_pp_group_tag", None),
+            getattr(program, "_pp_seg_counter", None))
+    program._pp_group_tag = _pipeline_counter[0]
+    program._pp_seg_counter = -1
+    try:
+        yield
+    finally:
+        program._pp_group_tag, program._pp_seg_counter = prev
+
+
+@contextlib.contextmanager
+def pipeline_segment(main_program: Optional[Program] = None):
+    """One repeatable layer inside a `pipeline_scope()` (see above)."""
+    program = main_program or default_main_program()
+    if getattr(program, "_pp_group_tag", None) is None:
+        raise RuntimeError(
+            "pipeline_segment() must be used inside a pipeline_scope()")
+    program._pp_seg_counter += 1
+    prev = getattr(program, "_pp_seg_active", False)
+    if prev:
+        raise RuntimeError("pipeline_segment() cannot nest")
+    program._pp_seg_active = True
+    try:
+        yield
+    finally:
+        program._pp_seg_active = False
 
 
 @contextlib.contextmanager

@@ -17,7 +17,7 @@ from .transformer import encoder_layer, pre_post_process
 
 def bert_encoder(src_ids, sent_ids, input_mask_bias, vocab_size, max_len,
                  n_layer=12, n_head=12, d_model=768, d_inner=3072,
-                 dropout=0.1, use_flash=False):
+                 dropout=0.1, use_flash=False, pipeline=False):
     init = TruncatedNormal(0.0, 0.02)
     word_emb = layers.embedding(
         src_ids, size=[vocab_size, d_model],
@@ -38,18 +38,27 @@ def bert_encoder(src_ids, sent_ids, input_mask_bias, vocab_size, max_len,
     if dropout:
         emb = layers.dropout(emb, dropout_prob=dropout,
                              dropout_implementation="upscale_in_train")
+    import contextlib
+
+    from ..core.program import pipeline_scope, pipeline_segment
+
     x = emb
-    for _ in range(n_layer):
-        x = encoder_layer(x, input_mask_bias, n_head, d_model // n_head,
-                          d_model // n_head, d_model, d_inner, dropout,
-                          use_flash=use_flash)
+    with pipeline_scope() if pipeline else contextlib.nullcontext():
+        for _ in range(n_layer):
+            with (pipeline_segment() if pipeline
+                  else contextlib.nullcontext()):
+                x = encoder_layer(x, input_mask_bias, n_head,
+                                  d_model // n_head, d_model // n_head,
+                                  d_model, d_inner, dropout,
+                                  use_flash=use_flash)
     return pre_post_process(None, x, "n")
 
 
 def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
                 d_model=768, d_inner=3072, max_predictions=20,
                 learning_rate=1e-4, warmup_steps=10000, dropout=0.1,
-                with_optimizer=True, use_flash=False, use_amp=False):
+                with_optimizer=True, use_flash=False, use_amp=False,
+                pipeline=False):
     src_ids = layers.data(name="src_ids", shape=[max_len], dtype="int64")
     sent_ids = layers.data(name="sent_ids", shape=[max_len], dtype="int64")
     seq_len = layers.data(name="seq_len", shape=[], dtype="int32")
@@ -67,7 +76,7 @@ def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
 
     enc = bert_encoder(src_ids, sent_ids, bias, vocab_size, max_len,
                        n_layer, n_head, d_model, d_inner, dropout,
-                       use_flash=use_flash)
+                       use_flash=use_flash, pipeline=pipeline)
 
     # --- masked LM head: gather masked positions per row
     gathered = _gather_rows(enc, mask_pos)

@@ -216,19 +216,32 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
                 use_flash=False, use_fused_ce=False, fused_qkv=False,
                 moe_experts=0, moe_aux_weight=0.01, flash_pallas=None,
-                recompute=False):
+                recompute=False, pipeline=False):
     """Build the full training graph; returns (avg_cost, logits, feeds).
     moe_experts > 0 swaps every FFN sublayer for a switch-MoE block
     (experts sharded over mp/ep) and folds the load-balance aux losses
     into the objective with weight moe_aux_weight.  recompute=True
     wraps every encoder/decoder layer in fluid.recompute_scope
-    (activations rematerialized in the backward — HBM for FLOPs)."""
+    (activations rematerialized in the backward — HBM for FLOPs).
+    pipeline=True tags the encoder and decoder stacks as two
+    fluid.pipeline_scope groups: on a mesh with a "pp" axis each stack
+    runs as a GPipe schedule over the pp stages
+    (parallel/pipeline_engine.py); on other meshes the tags are inert."""
     import contextlib
 
-    from ..core.program import recompute_scope
+    from ..core.program import (pipeline_scope, pipeline_segment,
+                                recompute_scope)
+
+    def stack_scope():
+        return pipeline_scope() if pipeline else contextlib.nullcontext()
 
     def layer_scope():
-        return recompute_scope() if recompute else contextlib.nullcontext()
+        ctx = contextlib.ExitStack()
+        if pipeline:
+            ctx.enter_context(pipeline_segment())
+        if recompute:
+            ctx.enter_context(recompute_scope())
+        return ctx
 
     moe_aux: list = []
     src_word = layers.data(name="src_word", shape=[max_length],
@@ -257,30 +270,34 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     enc_in = _prepare_input(src_word, src_vocab_size, d_model, max_length,
                             dropout, "src_word_emb")
     x = enc_in
-    for _ in range(n_layer):
-        with layer_scope():
-            x = encoder_layer(x, src_bias, n_head, d_key, d_value,
-                              d_model, d_inner_hid, dropout,
-                              use_flash=use_flash, fused_qkv=fused_qkv,
-                              moe_experts=moe_experts,
-                              aux_list=moe_aux,
-                              flash_pallas=flash_pallas)
+    with stack_scope():
+        for _ in range(n_layer):
+            with layer_scope():
+                x = encoder_layer(x, src_bias, n_head, d_key, d_value,
+                                  d_model, d_inner_hid, dropout,
+                                  use_flash=use_flash,
+                                  fused_qkv=fused_qkv,
+                                  moe_experts=moe_experts,
+                                  aux_list=moe_aux,
+                                  flash_pallas=flash_pallas)
     enc_out = pre_post_process(None, x, "n")
 
     # decoder
     dec_in = _prepare_input(trg_word, trg_vocab_size, d_model, max_length,
                             dropout, "trg_word_emb")
     y = dec_in
-    for _ in range(n_layer):
-        with layer_scope():
-            y = decoder_layer(y, enc_out, self_bias, src_bias, n_head,
-                              d_key, d_value, d_model, d_inner_hid,
-                              dropout, use_flash=use_flash,
-                              fused_qkv=fused_qkv,
-                              moe_experts=moe_experts,
-                              aux_list=moe_aux,
-                              flash_pallas=flash_pallas,
-                              self_causal=self_causal)
+    with stack_scope():
+        for _ in range(n_layer):
+            with layer_scope():
+                y = decoder_layer(y, enc_out, self_bias, src_bias,
+                                  n_head, d_key, d_value, d_model,
+                                  d_inner_hid, dropout,
+                                  use_flash=use_flash,
+                                  fused_qkv=fused_qkv,
+                                  moe_experts=moe_experts,
+                                  aux_list=moe_aux,
+                                  flash_pallas=flash_pallas,
+                                  self_causal=self_causal)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -342,14 +359,15 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 dropout=0.1, learning_rate=2.0, warmup_steps=4000,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
                 use_amp=False, use_fused_ce=False, fused_qkv=False,
-                moe_experts=0, flash_pallas=None, recompute=False):
+                moe_experts=0, flash_pallas=None, recompute=False,
+                pipeline=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
         dropout, label_smooth_eps, use_flash=use_flash,
         use_fused_ce=use_fused_ce, fused_qkv=fused_qkv,
         moe_experts=moe_experts, flash_pallas=flash_pallas,
-        recompute=recompute)
+        recompute=recompute, pipeline=pipeline)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

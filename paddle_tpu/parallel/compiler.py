@@ -45,6 +45,12 @@ class BuildStrategy:
         # K-micro-batch gradient accumulation (reference:
         # ir/multi_batch_merge_pass.cc)
         self.gradient_accumulation_steps = 1
+        # GPipe microbatch count for programs built with
+        # fluid.pipeline_scope() layer tagging, executed on a mesh with
+        # a "pp" axis.  0 = auto (2x the pp degree when the batch
+        # divides, else the pp degree).  Ignored when the program has no
+        # pipeline tags or the mesh has no pp axis.
+        self.pipeline_microbatches = 0
 
 
 class ExecutionStrategy:
@@ -66,6 +72,7 @@ class CompiledProgram:
         self._cache: Dict[Any, Any] = {}
         self._loss_name = None
         self._accum_steps = 1
+        self._pp_microbatches = 0
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -78,6 +85,8 @@ class CompiledProgram:
         bs = build_strategy or BuildStrategy()
         self._accum_steps = int(getattr(bs, "gradient_accumulation_steps",
                                         1) or 1)
+        self._pp_microbatches = int(getattr(bs, "pipeline_microbatches",
+                                            0) or 0)
         if bs.sharding_rules is not None:
             self._rules = bs.sharding_rules
         elif bs.reduce_strategy == ReduceStrategy.Reduce:
@@ -167,7 +176,9 @@ class CompiledProgram:
                 rng_key = st[RNG_STATE_VAR]
                 env = {k: v for k, v in st.items() if k != RNG_STATE_VAR}
                 env.update(feeds)
-                with executing_mesh(self._mesh):
+                with executing_mesh(
+                        self._mesh, batch_axis=self._batch_axis,
+                        pipeline_microbatches=self._pp_microbatches):
                     env = interpret_program(program, env, rng_key,
                                             fetch_names=fetch_names,
                                             accum_steps=accum,

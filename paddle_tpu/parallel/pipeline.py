@@ -14,8 +14,19 @@ permutation), so `jax.grad` of a loss on the pipeline output yields
 per-stage parameter gradients without any hand-written backward
 schedule.
 
+Capabilities (round 5; the round-4 primitive took a single array):
+- activations are PYTREES: stage_fn maps a pytree of arrays to a
+  same-structure, same-shape pytree (room for (hidden, attention-bias,
+  encoder-context, ...) bundles — invariant leaves just pass through),
+- inputs can arrive SCATTERED over the pp axis (each rank holds
+  n_micro/S microbatches; a one-slot-per-tick ppermute conveyor streams
+  them to stage 0) so no rank ever materializes the full batch,
+- a dp axis composes: `batch_axis=` keeps the per-microbatch batch dim
+  sharded inside the shard_map (each dp group pipelines its own shard;
+  stage-parameter gradients are psum'd over dp in the backward).
+
 Constraints (documented, enforced):
-- every stage maps activations of one fixed shape to the same shape
+- every stage maps activations of one fixed pytree-of-shapes to itself
   (transformer-block pipelines satisfy this; embed/head layers run
   outside the pipelined region),
 - stage_params is a pytree whose every leaf has leading dim S.
@@ -30,80 +41,161 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def gpipe(stage_fn, mesh, axis: str = "pp"):
-    """Build a pipelined apply: `fn(stacked_params, micro_x) -> out`.
-
-    stage_fn(params_s, x) -> y with y.shape == x.shape;
-    stacked_params: pytree, leaves (S, ...) — stage s uses leaf[s];
-    micro_x: (n_micro, B_micro, ...) microbatched input.
-    Returns out (n_micro, B_micro, ...) = stage_{S-1}(...stage_0(x)).
-    """
+def _shard_map():
+    """shard_map with the check_rep/check_vma rename smoothed over."""
     import inspect
 
     try:
         from jax import shard_map as _sm
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map as _sm
-    # jax 0.8 renamed check_rep -> check_vma
-    _kw = ("check_vma" if "check_vma" in
-           inspect.signature(_sm).parameters else "check_rep")
+    kw = ("check_vma" if "check_vma" in
+          inspect.signature(_sm).parameters else "check_rep")
 
-    def shard_map(f, **kwargs):
-        kwargs[_kw] = kwargs.pop("check_rep")
+    def sm(f, **kwargs):
+        kwargs[kw] = kwargs.pop("check_rep")
         return _sm(f, **kwargs)
 
+    return sm
+
+
+def gpipe(stage_fn, mesh, axis: str = "pp", batch_axis=None,
+          scatter_inputs=None):
+    """Build a pipelined apply: `fn(stacked_params, micro_x) -> out`.
+
+    stage_fn(params_s, x) -> y, x/y pytrees with identical structure
+    and shapes (a single array works as a one-leaf pytree);
+    stacked_params: pytree, leaves (S, ...) — stage s uses leaf[s];
+    micro_x: pytree, every leaf (n_micro, B_micro, ...) microbatched.
+    Returns out with micro_x's structure/shapes =
+    stage_{S-1}(...stage_0(x)).
+
+    batch_axis: mesh axis the per-microbatch batch dim (leaf dim 1) is
+    sharded over (e.g. "dp" on a dp x pp mesh) — without it the
+    shard_map boundary would all-gather dp-sharded activations and
+    every dp group would redo the full compute.
+    scatter_inputs: shard micro_x's microbatch dim over the pp axis
+    (needs S | n_micro) and stream microbatches to stage 0 via a
+    ppermute conveyor.  None = auto (on when S divides n_micro).
+    """
     from jax.sharding import PartitionSpec as P
 
+    shard_map = _shard_map()
     s = mesh.shape[axis]
-    perm = [(i, i + 1) for i in range(s - 1)]
+    perm_fwd = [(i, i + 1) for i in range(s - 1)]
+    # input conveyor: a full ring rotated one slot toward rank 0 per
+    # tick (rank r's head -> rank r-1; consumed items recirculate
+    # through rank S-1's tail, so rank 0 sees microbatch t at tick t)
+    perm_conv = [(i, (i - 1) % s) for i in range(s)]
+    b_ax = (batch_axis if batch_axis
+            and mesh.shape.get(batch_axis, 1) > 1 else None)
+    dp = mesh.shape.get(b_ax, 1) if b_ax else 1
+
+    def leaf_spec(l, scattered):
+        dims = [axis if scattered else None]
+        if l.ndim >= 2 and l.shape[1] % dp == 0:
+            dims.append(b_ax)
+        dims += [None] * (l.ndim - len(dims))
+        return P(*dims)
 
     def pipelined(stacked_params, micro_x):
-        n_micro = micro_x.shape[0]
+        leaves = jax.tree.leaves(micro_x)
+        if not leaves:
+            raise ValueError("gpipe: micro_x has no array leaves")
+        n_micro = leaves[0].shape[0]
+        if any(l.shape[0] != n_micro for l in leaves):
+            raise ValueError(
+                "gpipe: every micro_x leaf needs the same leading "
+                f"(n_micro) dim; got {[l.shape for l in leaves]}")
+        scatter = (n_micro % s == 0 if scatter_inputs is None
+                   else scatter_inputs)
+        if scatter and n_micro % s != 0:
+            raise ValueError(
+                f"gpipe(scatter_inputs=True): n_micro ({n_micro}) must "
+                f"be divisible by the {axis!r} axis size ({s})")
         ticks = n_micro + s - 1
+
+        in_x_spec = jax.tree.map(lambda l: leaf_spec(l, scatter), micro_x)
+        out_spec = jax.tree.map(lambda l: leaf_spec(l, False), micro_x)
 
         @partial(
             shard_map, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(axis), stacked_params),
-                      P()),
-            out_specs=P(),
+                      in_x_spec),
+            out_specs=out_spec,
             check_rep=False)
         def run(params, xs):
-            # inside: params leaves are (1, ...) — this device's stage
+            # inside: params leaves are (1, ...) — this device's stage.
+            # NOTE params enter replicated over the dp axis (spec
+            # mentions only pp); shard_map's transpose psums their
+            # cotangents over the unmentioned axis, so per-dp-shard
+            # batch contributions sum correctly — pinned by
+            # tests/test_gpipe.py::test_gpipe_dp_gradients_match.
             params = jax.tree.map(lambda l: l[0], params)
             rank = lax.axis_index(axis)
-            zero = jnp.zeros_like(xs[0])
+            zero = jax.tree.map(lambda l: jnp.zeros(l.shape[1:], l.dtype),
+                                xs)
 
-            def tick(buf_in, t):
+            def where(pred, a, b):
+                return jax.tree.map(partial(jnp.where, pred), a, b)
+
+            def ppermute(t, perm):
+                return jax.tree.map(
+                    lambda l: lax.ppermute(l, axis, perm), t)
+
+            def step(x_in, handoff, t):
+                y = stage_fn(params, x_in)
                 mb = t - rank
                 active = (mb >= 0) & (mb < n_micro)
-                # stage 0 pulls its microbatch; others take the buffer
-                x_in = jnp.where(
-                    rank == 0,
-                    xs[jnp.clip(t, 0, n_micro - 1)], buf_in)
-                y = stage_fn(params, x_in)
-                y = jnp.where(active, y, zero)
-                handoff = lax.ppermute(y, axis, perm)
-                return handoff, y
+                y = where(active, y, zero)
+                return ppermute(y, perm_fwd), y
 
-            _, ys = lax.scan(tick, zero, jnp.arange(ticks))
+            if scatter:
+                def tick(carry, t):
+                    handoff, conv = carry
+                    head = jax.tree.map(lambda c: c[0], conv)
+                    x_in = where(rank == 0, head, handoff)
+                    new_handoff, y = step(x_in, handoff, t)
+                    sent = ppermute(head, perm_conv)
+                    conv = jax.tree.map(
+                        lambda c, sv: jnp.concatenate(
+                            [c[1:], sv[None]], axis=0), conv, sent)
+                    return (new_handoff, conv), y
+
+                (_, _), ys = lax.scan(tick, (zero, xs),
+                                      jnp.arange(ticks))
+            else:
+                def tick(handoff, t):
+                    x_t = jax.tree.map(
+                        lambda l: l[jnp.clip(t, 0, n_micro - 1)], xs)
+                    x_in = where(rank == 0, x_t, handoff)
+                    new_handoff, y = step(x_in, handoff, t)
+                    return new_handoff, y
+
+                _, ys = lax.scan(tick, zero, jnp.arange(ticks))
+
             # microbatch m leaves the last stage at tick m + (S-1):
             # ys[s-1:] on the last rank is the pipeline output
-            outs = lax.dynamic_slice_in_dim(ys, s - 1, n_micro, 0)
+            outs = jax.tree.map(
+                lambda l: lax.dynamic_slice_in_dim(l, s - 1, n_micro, 0),
+                ys)
             # broadcast the last stage's result to every pp rank so the
-            # out_spec P() (replicated) is truthful
-            last = jnp.zeros((), outs.dtype) + (rank == s - 1)
-            outs = lax.psum(outs * last.astype(outs.dtype), axis)
-            return outs
+            # out_spec (replicated over pp) is truthful
+            last = (rank == s - 1)
+            return jax.tree.map(
+                lambda l: lax.psum(l * last.astype(l.dtype), axis), outs)
 
         return run(stacked_params, micro_x)
 
     return pipelined
 
 
-def gpipe_loss_and_grad(stage_fn, loss_fn, mesh, axis: str = "pp"):
+def gpipe_loss_and_grad(stage_fn, loss_fn, mesh, axis: str = "pp",
+                        batch_axis=None, scatter_inputs=None):
     """Convenience: (stacked_params, micro_x, micro_y) ->
     (mean loss, grads w.r.t. stacked_params) through the pipeline."""
-    fwd = gpipe(stage_fn, mesh, axis)
+    fwd = gpipe(stage_fn, mesh, axis, batch_axis=batch_axis,
+                scatter_inputs=scatter_inputs)
 
     def loss(params, micro_x, micro_y):
         out = fwd(params, micro_x)
