@@ -68,34 +68,83 @@ def moe_ffn(ctx, ins, attrs):
     e = gate_w.shape[1]
     xf = x.reshape(-1, d)
     b = xf.shape[0]
-    # C = ceil(B * top_k / E * capacity_factor), the documented formula
+
+    # GShard GROUPED formulation: tokens split into G groups with
+    # per-group capacity.  G=1 reproduces the ungrouped Switch layout;
+    # on a mesh with an `ep` axis G = ep so the group dim shards over
+    # ep and the dispatch/combine einsums lower to the GShard
+    # all-to-alls (pinned by tests/test_moe.py HLO assertion) instead
+    # of all-gathering the dispatch tensor.  Capacity is then per
+    # GROUP (C = ceil(B/G * k / E * cf)) — the published GShard
+    # semantics.
+    ectx = None
+    try:
+        from ..parallel.mesh import get_exec_context
+
+        ectx = get_exec_context()
+    except ImportError:  # pragma: no cover
+        pass
+    g = 1
+    ep_ax = mp_ax = batch_ax = None
+    if ectx is not None:
+        mesh = ectx.mesh
+        if mesh.shape.get("ep", 1) > 1:
+            g = mesh.shape["ep"]
+            ep_ax = "ep"
+            if mesh.shape.get("mp", 1) > 1:
+                mp_ax = "mp"
+            if mesh.shape.get(ectx.batch_axis, 1) > 1:
+                batch_ax = ectx.batch_axis
+    if b % g != 0:
+        raise ValueError(
+            f"moe_ffn on an ep={g} mesh needs the token count ({b}) "
+            f"divisible by ep (per-group GShard capacity)")
+    bg = b // g
+    # C = ceil(B/G * top_k / E * capacity_factor)
     import math
 
-    cap = max(1, int(math.ceil(b * top_k / e * cap_factor)))
+    cap = max(1, int(math.ceil(bg * top_k / e * cap_factor)))
 
-    logits = (xf @ gate_w).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)          # (B, E)
+    def wsc(v, *spec):
+        if ep_ax is None:
+            return v
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    combine = jnp.zeros((b, e, cap), xf.dtype)
-    used = jnp.zeros((b, e), bool)
-    fill = jnp.zeros((e,), jnp.float32)  # slots taken by earlier k's
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(*spec)))
+
+    xg = wsc(xf.reshape(g, bg, d), ep_ax, batch_ax, None)
+    logits = jnp.einsum("gbd,de->gbe", xg, gate_w).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)          # (G, Bg, E)
+
+    combine = jnp.zeros((g, bg, e, cap), xf.dtype)
+    dispatch = jnp.zeros((g, bg, e, cap), xf.dtype)
+    used = jnp.zeros((g, bg, e), bool)
+    fill = jnp.zeros((g, e), jnp.float32)  # slots taken by earlier k's
     for k in range(top_k):
         masked = jnp.where(used, -jnp.inf, logits)
-        idx = jnp.argmax(masked, axis=-1)            # (B,)
+        idx = jnp.argmax(masked, axis=-1)            # (G, Bg)
         onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
-        # deterministic position in the expert buffer (token order),
-        # offset by the slots previous routing passes already filled
-        pos = (jnp.cumsum(onehot, axis=0) - onehot)  # exclusive
-        pos = jnp.sum((pos + fill[None, :]) * onehot, axis=-1)  # (B,)
-        fill = fill + jnp.sum(onehot, axis=0)
-        fits = pos < cap
-        gate = jnp.sum(probs * onehot, axis=-1)      # (B,)
+        # deterministic position in the expert buffer (token order
+        # WITHIN the group), offset by slots earlier k's already filled
+        pos = (jnp.cumsum(onehot, axis=1) - onehot)  # exclusive
+        pos = jnp.sum((pos + fill[:, None, :]) * onehot, axis=-1)
+        fill = fill + jnp.sum(onehot, axis=1)
+        fits = pos < cap                              # (G, Bg)
+        gate = jnp.sum(probs * onehot, axis=-1)       # (G, Bg)
         pos_oh = jax.nn.one_hot(
             jnp.where(fits, pos, 0).astype(jnp.int32), cap,
             dtype=jnp.float32)
-        plan = (onehot[:, :, None] * pos_oh[:, None, :]
-                * jnp.where(fits, gate, 0.0)[:, None, None])
-        combine = combine + plan.astype(xf.dtype)
+        # dispatch derives from the ROUTING plan (chosen expert & a
+        # fitting slot), not from the gate-weighted combine tensor: a
+        # token whose softmax prob underflows to exactly 0.0 still
+        # occupies its slot (contributing 0 to the output) instead of
+        # silently freeing capacity
+        plan_mask = (onehot[..., None] * pos_oh[..., None, :]
+                     * fits.astype(jnp.float32)[..., None, None])
+        dispatch = dispatch + plan_mask.astype(xf.dtype)
+        combine = combine + (plan_mask
+                             * gate[..., None, None]).astype(xf.dtype)
         used = used | (onehot > 0)
 
     if top_k == 2:
@@ -103,23 +152,31 @@ def moe_ffn(ctx, ins, attrs):
         # CHOSEN experts (p1 + p2) so the pair's weights sum to 1; a
         # capacity-dropped choice simply vanishes, leaving the kept
         # expert at p_kept/(p1+p2) — never amplified
-        chosen = jnp.sum(probs * used, axis=-1)[:, None, None]
+        chosen = jnp.sum(probs * used, axis=-1)[..., None, None]
         combine = combine / jnp.maximum(chosen, 1e-9).astype(
             combine.dtype)
 
-    dispatch = (combine > 0).astype(xf.dtype)        # (B, E, C)
-    expert_in = jnp.einsum("bec,bd->ecd", dispatch, xf)
-    h = act(jnp.einsum("ecd,edh->ech", expert_in, w1)
-            + (b1[:, None, :] if b1 is not None else 0.0))
-    expert_out = (jnp.einsum("ech,ehd->ecd", h, w2)
-                  + (b2[:, None, :] if b2 is not None else 0.0))
-    yf = jnp.einsum("bec,ecd->bd", combine, expert_out)
+    dispatch = wsc(dispatch, ep_ax, batch_ax, None, None)
+    combine = wsc(combine, ep_ax, batch_ax, None, None)
+    # dispatch all-to-all: (G over ep, ...) -> (E over ep, G, ...)
+    expert_in = wsc(jnp.einsum("gbec,gbd->egcd", dispatch, xg),
+                    ep_ax, None, None, None)
+    h = act(jnp.einsum("egcd,edh->egch", expert_in, w1)
+            + (b1[:, None, None, :] if b1 is not None else 0.0))
+    h = wsc(h, ep_ax, None, None, mp_ax)
+    expert_out = (jnp.einsum("egch,ehd->egcd", h, w2)
+                  + (b2[:, None, None, :] if b2 is not None else 0.0))
+    expert_out = wsc(expert_out, ep_ax, None, None, None)
+    # combine all-to-all: back to (G over ep, Bg, D)
+    yf = wsc(jnp.einsum("gbec,egcd->gbd", combine, expert_out),
+             ep_ax, batch_ax, None)
+    yf = yf.reshape(b, d)
 
-    # Switch load-balancing loss on the top-1 assignment
+    # Switch load-balancing loss on the top-1 assignment (global stats)
     top1 = jax.nn.one_hot(jnp.argmax(logits, axis=-1), e,
                           dtype=jnp.float32)
-    fraction = jnp.mean(top1, axis=0)                # (E,)
-    mean_prob = jnp.mean(probs, axis=0)
+    fraction = jnp.mean(top1, axis=(0, 1))           # (E,)
+    mean_prob = jnp.mean(probs, axis=(0, 1))
     aux = e * jnp.sum(fraction * mean_prob)
 
     return {"Out": [yf.reshape(lead + (d,))],

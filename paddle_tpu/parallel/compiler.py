@@ -126,6 +126,35 @@ class CompiledProgram:
             accumulation_steps: int = 1):
         import jax
 
+        fn, state, feed_arrays, _, _ = self._prepare_step(
+            feed, fetch_names, scope, iterations, accumulation_steps)
+        new_state, fetches = fn(state, feed_arrays)
+        for name, val in new_state.items():
+            scope.set_var(name, val)
+        from ..core.executor import _debug_checks
+
+        _debug_checks(fetch_names, fetches, new_state)
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
+        return fetches
+
+    def compiled_hlo_text(self, feed: Dict[str, Any], fetch_names,
+                          scope, iterations: int = 1) -> str:
+        """AOT-lower the sharded step and return the compiled
+        (post-SPMD-partitioning) HLO text — for inspecting which
+        collectives GSPMD inserted (e.g. asserting MoE dispatch lowers
+        to all-to-all, tests/test_moe.py) and for roofline tooling.
+        One extra XLA compile; the traced fn comes from the same
+        cache as run()."""
+        fn, state, feed_arrays, _, _ = self._prepare_step(
+            feed, fetch_names, scope, iterations, 1)
+        compiled = fn.lower(state, feed_arrays).compile()
+        return compiled.as_text()
+
+    def _prepare_step(self, feed, fetch_names, scope, iterations,
+                      accumulation_steps):
+        import jax
+
         # an explicit per-run override wins over the BuildStrategy knob
         accum = (accumulation_steps if accumulation_steps != 1
                  else self._accum_steps)
@@ -207,12 +236,4 @@ class CompiledProgram:
 
         feed_arrays = {n: jax.device_put(jnp.asarray(v), feed_shardings[n])
                        for n, v in feed.items()}
-        new_state, fetches = fn(state, feed_arrays)
-        for name, val in new_state.items():
-            scope.set_var(name, val)
-        from ..core.executor import _debug_checks
-
-        _debug_checks(fetch_names, fetches, new_state)
-        if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
-        return fetches
+        return fn, state, feed_arrays, state_shardings, feed_shardings

@@ -93,6 +93,12 @@ def gpipe(stage_fn, mesh, axis: str = "pp", batch_axis=None,
 
     def leaf_spec(l, scattered):
         dims = [axis if scattered else None]
+        # a batch dim that doesn't divide dp degrades to replicated —
+        # each dp rank then redundantly computes it (perf, not
+        # correctness: shard_map's transpose psums per-shard cotangents
+        # and passes replicated ones through correctly in either
+        # layout; pinned by test_gpipe_dp_gradients_match including the
+        # mb=1 indivisible case)
         if l.ndim >= 2 and l.shape[1] % dp == 0:
             dims.append(b_ax)
         dims += [None] * (l.ndim - len(dims))

@@ -222,16 +222,25 @@ def analyze_group(group_ops, block) -> Dict[str, Any]:
     }
 
 
-def _pick_n_micro(requested: int, batch: int, s: int) -> int:
+def _pick_n_micro(requested: int, batch: int, s: int,
+                  dp: int = 1) -> int:
     if requested:
         if batch % requested != 0:
             raise PipelineStructureError(
                 f"pipeline_microbatches={requested} must divide the "
                 f"batch size {batch}")
         return requested
-    for cand in (2 * s, s):
-        if batch % cand == 0:
+    # prefer a count whose per-microbatch size still divides the dp
+    # axis: otherwise gpipe's leaf_spec degrades the batch dim to
+    # replicated and every dp rank redundantly computes the full batch
+    # (gradients stay correct — shard_map's transpose handles the
+    # replication — but the dp compute saving is lost)
+    cands = [c for c in (2 * s, s) if batch % c == 0]
+    for cand in cands:
+        if (batch // cand) % dp == 0:
             return cand
+    if cands:
+        return cands[0]
     raise PipelineStructureError(
         f"cannot auto-pick a microbatch count: batch {batch} is not "
         f"divisible by {2 * s} or {s} (pp={s}); set "
@@ -298,7 +307,8 @@ def run_pipelined_group(group_ops, env: Dict[str, Any], rng_key,
     # --- microbatch the carry + invariants
     carries = [env[n] for n in carry_names0]
     batch = np.shape(carries[0])[0]
-    n_micro = _pick_n_micro(n_micro_req, batch, s)
+    n_micro = _pick_n_micro(n_micro_req, batch, s,
+                            dp=mesh.shape.get(batch_axis, 1))
     mb = batch // n_micro
 
     def split(v):

@@ -50,8 +50,11 @@ class ShardingRules:
             if ax is None:
                 out.append(None)
             else:
-                size = mesh.shape[ax]
-                out.append(ax if d % size == 0 else None)
+                # an axis the mesh doesn't have degrades to replicated
+                # (one rule set serves dp, dp x mp and dp x mp x ep
+                # meshes)
+                size = mesh.shape.get(ax, 1)
+                out.append(ax if size > 1 and d % size == 0 else None)
         return tuple(out)
 
 
@@ -63,18 +66,41 @@ class ShardingRules:
 # ffn_out row-parallel (input dim over mp) — GSPMD then inserts exactly
 # one all-reduce per attention block and one per MLP block, matching
 # Megatron-LM's layout instead of the column-everywhere fallback.
-def megatron_transformer_rules(fsdp: bool = False) -> ShardingRules:
+def megatron_transformer_rules(fsdp: bool = False,
+                               moe_axis: str = "mp") -> ShardingRules:
+    """moe_axis: mesh axis the expert (E) dim of MoE weights shards
+    over.  "mp" (default) reuses the tensor-parallel axis — fine when
+    ep and tp don't need to compose.  "ep" gives experts their OWN axis
+    on a dp x mp x ep mesh (the GShard formulation): the E dim shards
+    over ep AND each expert's FFN matrices shard over mp on the hidden
+    dim, so expert parallelism and tensor parallelism compose
+    multiplicatively.  Axes absent from the executing mesh degrade to
+    replicated (see _validate), so one rule set serves every mesh."""
+    if moe_axis == "mp":
+        moe_rules = [
+            # expert parallelism riding the tensor-parallel axis: the E
+            # axis of per-expert MoE weights shards over mp (GShard
+            # dispatch/combine all-to-alls are GSPMD-inserted); the
+            # router gate stays replicated
+            (r"moe_expert\S*\.w", ("mp", None, None)),
+            (r"moe_expert\S*\.b", ("mp", None)),
+        ]
+    else:
+        moe_rules = [
+            # dedicated expert axis composing with tensor parallelism:
+            # w1 (E, D, H) -> (ep, -, mp); w2 (E, H, D) -> (ep, mp, -)
+            (r"moe_expert\S*\.w_0", (moe_axis, None, "mp")),
+            (r"moe_expert\S*\.w_1", (moe_axis, "mp", None)),
+            (r"moe_expert\S*\.w", (moe_axis, None, None)),
+            (r"moe_expert\S*\.b", (moe_axis, None)),
+        ]
     return ShardingRules(
         rules=[
             (r"(word_emb|src_word_emb|trg_word_emb|word_embedding|fm_emb)",
              ("mp", None)),
             (r"(attn_qkv|ffn_in)\S*\.w", (None, "mp")),
             (r"(attn_out|ffn_out)\S*\.w", ("mp", None)),
-            # expert parallelism: the E axis of per-expert MoE weights
-            # shards over mp (GShard dispatch/combine all-to-alls are
-            # GSPMD-inserted); the router gate stays replicated
-            (r"moe_expert\S*\.w", ("mp", None, None)),
-            (r"moe_expert\S*\.b", ("mp", None)),
+            *moe_rules,
             # any remaining fc (e.g. the softmax projection): column
             (r"fc_\d+\.w_\d+", (None, "mp")),
         ],

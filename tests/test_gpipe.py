@@ -139,6 +139,60 @@ def test_gpipe_dp_gradients_match():
                                        np.asarray(want_g[k]),
                                        rtol=1e-4, atol=1e-6)
 
+    # per-microbatch batch dim NOT divisible by dp (mb=1): leaf_spec
+    # degrades the batch dim to replicated — gradients must still be
+    # exactly right (no psum double-count from the replicated layout)
+    mx1 = jnp.asarray(np.random.RandomState(12).randn(4, 1, D),
+                      jnp.float32)
+    my1 = jnp.asarray(np.random.RandomState(13).randn(4, 1, D),
+                      jnp.float32)
+
+    def seq1(p):
+        out = mx1
+        for s in range(2):
+            ps = {"w": p["w"][s], "b": p["b"][s]}
+            out = jax.vmap(lambda mb: stage_fn(ps, mb))(out)
+        return jnp.mean((out - my1) ** 2)
+
+    wl1, wg1 = jax.value_and_grad(seq1)(params)
+    lv1, g1 = jax.jit(gpipe_loss_and_grad(
+        stage_fn, loss_fn, mesh, batch_axis="dp"))(params, mx1, my1)
+    np.testing.assert_allclose(float(lv1), float(wl1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1["w"]),
+                               np.asarray(wg1["w"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_gpipe_extra_mesh_axes_unmentioned():
+    """A mesh with an extra (mp) axis the gpipe specs never mention:
+    compute replicates over it and gradients must remain exactly right
+    (pins the shard_map transpose behavior for unmentioned axes)."""
+    mesh = make_mesh({"mp": 2, "pp": 2})
+    rng = np.random.RandomState(21)
+    params = {"w": jnp.asarray(rng.randn(2, D, D) * 0.4, jnp.float32),
+              "b": jnp.asarray(rng.randn(2, D) * 0.1, jnp.float32)}
+    micro_x = jnp.asarray(rng.randn(4, 4, D), jnp.float32)
+    micro_y = jnp.asarray(rng.randn(4, 4, D), jnp.float32)
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    def seq_loss(p):
+        out = micro_x
+        for s in range(2):
+            ps = {"w": p["w"][s], "b": p["b"][s]}
+            out = jax.vmap(lambda mb: stage_fn(ps, mb))(out)
+        return jnp.mean((out - micro_y) ** 2)
+
+    want_l, want_g = jax.value_and_grad(seq_loss)(params)
+    lv, g = jax.jit(gpipe_loss_and_grad(
+        stage_fn, loss_fn, mesh))(params, micro_x, micro_y)
+    np.testing.assert_allclose(float(lv), float(want_l), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g[k]),
+                                   np.asarray(want_g[k]),
+                                   rtol=1e-4, atol=1e-6)
+
 
 def test_gpipe_trains():
     """A few SGD steps through the pipeline reduce the loss."""
