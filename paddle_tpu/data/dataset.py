@@ -35,6 +35,20 @@ def _dataset_home(sub):
     return os.path.join(home, sub) if home else None
 
 
+def _find_archive(data_dir, sub, names):
+    """Probe `data_dir` (or $PADDLE_DATASET_HOME/sub) for the first
+    existing archive filename in `names`; None when absent."""
+    if data_dir is None:
+        data_dir = _dataset_home(sub)
+    if data_dir is None:
+        return None
+    for name in names:
+        p = os.path.join(data_dir, name)
+        if os.path.exists(p):
+            return p
+    return None
+
+
 def _synthetic_classification(n, feature_shape, num_classes, seed,
                               flatten=False):
     rng = np.random.RandomState(seed)
@@ -143,12 +157,7 @@ class cifar:
 
     @staticmethod
     def _tar(data_dir, fname):
-        if data_dir is None:
-            data_dir = _dataset_home("cifar")
-        if data_dir is None:
-            return None
-        p = os.path.join(data_dir, fname)
-        return p if os.path.exists(p) else None
+        return _find_archive(data_dir, "cifar", (fname,))
 
     @staticmethod
     def train10(n=50000, seed=1, data_dir=None):
@@ -300,12 +309,7 @@ class imdb:
 
     @staticmethod
     def _tar(data_dir):
-        if data_dir is None:
-            data_dir = _dataset_home("imdb")
-        if data_dir is None:
-            return None
-        p = os.path.join(data_dir, imdb.TAR)
-        return p if os.path.exists(p) else None
+        return _find_archive(data_dir, "imdb", (imdb.TAR,))
 
     @staticmethod
     def word_dict(data_dir=None):
@@ -373,3 +377,499 @@ class imikolov:
                 yield tuple(int(x) for x in r.randint(0, vocab, size=(n,)))
 
         return reader
+
+class movielens:
+    """MovieLens 1-M (dataset/movielens.py): `ml-1m.zip` holding
+    movies.dat / users.dat / ratings.dat ('::'-separated, latin-1).
+    Sample layout is the reference's `usr.value() + mov.value() +
+    [[rating]]`:
+
+        [user_id, gender(0=M,1=F), age_bucket_idx, job_id,
+         movie_id, [category ids], [title word ids], [rating]]
+
+    with rating scaled `* 2 - 5` (movielens.py:160) and the age mapped
+    through `age_table` (movielens.py:41).  Divergence: the category /
+    title-word vocabularies are SORTED for determinism (the reference
+    enumerates python-set iteration order, movielens.py:132-139).
+    data_dir may hold the zip or the extracted ml-1m/ files."""
+
+    age_table = [1, 18, 25, 35, 45, 50, 56]
+
+    @staticmethod
+    def _read_members(data_dir):
+        """→ {name: text lines} for movies/users/ratings, from
+        ml-1m.zip or a plain directory (None when absent)."""
+        import io
+        import zipfile
+
+        if data_dir is None:
+            return None
+        names = ("movies.dat", "users.dat", "ratings.dat")
+        zp = os.path.join(data_dir, "ml-1m.zip")
+        out = {}
+        if os.path.exists(zp):
+            with zipfile.ZipFile(zp) as z:
+                for n in names:
+                    with z.open(f"ml-1m/{n}") as f:
+                        out[n] = io.TextIOWrapper(
+                            io.BytesIO(f.read()),
+                            encoding="latin-1").readlines()
+            return out
+        for n in names:
+            p = os.path.join(data_dir, n)
+            if not os.path.exists(p):
+                p2 = os.path.join(data_dir, "ml-1m", n)
+                p = p2 if os.path.exists(p2) else p
+            if not os.path.exists(p):
+                return None
+            with open(p, encoding="latin-1") as f:
+                out[n] = f.readlines()
+        return out
+
+    @staticmethod
+    def load_meta(data_dir):
+        """Parse movies.dat/users.dat → (movie_info, user_info,
+        title_dict, categories_dict).  movie_info[id] = (id, [cat ids],
+        [title word ids]); user_info[id] = (id, gender01, age_idx,
+        job)."""
+        import re
+
+        members = movielens._read_members(data_dir)
+        if members is None:
+            raise IOError(
+                f"movielens: no ml-1m.zip or *.dat under {data_dir!r} "
+                f"(pass data_dir= or set $PADDLE_DATASET_HOME)")
+        return movielens._parse_meta(members)
+
+    @staticmethod
+    def _parse_meta(members):
+        import re
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        raw_movies = []
+        title_words, categories = set(), set()
+        for line in members["movies.dat"]:
+            if not line.strip():
+                continue
+            mid, title, cats = line.strip().split("::")
+            cats = cats.split("|")
+            m = pattern.match(title)
+            title = m.group(1) if m else title
+            words = [w.lower() for w in title.split()]
+            raw_movies.append((int(mid), cats, words))
+            title_words.update(words)
+            categories.update(cats)
+        title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+        cat_dict = {c: i for i, c in enumerate(sorted(categories))}
+        movie_info = {
+            mid: (mid, [cat_dict[c] for c in cats],
+                  [title_dict[w] for w in words])
+            for mid, cats, words in raw_movies
+        }
+        user_info = {}
+        for line in members["users.dat"]:
+            if not line.strip():
+                continue
+            uid, gender, age, job = line.strip().split("::")[:4]
+            user_info[int(uid)] = (
+                int(uid), 0 if gender == "M" else 1,
+                movielens.age_table.index(int(age)), int(job))
+        return movie_info, user_info, title_dict, cat_dict
+
+    @staticmethod
+    def reader_creator(data_dir, is_test=False, test_ratio=0.1,
+                       rand_seed=0):
+        # parse the archive ONCE, lazily at first use, shared by every
+        # epoch's reader() call (the real ml-1m is ~24 MB; re-parsing
+        # per epoch would dominate data time)
+        cache = []
+
+        def reader():
+            if not cache:
+                members = movielens._read_members(data_dir)
+                if members is None:
+                    raise IOError(
+                        f"movielens: no ml-1m.zip or *.dat under "
+                        f"{data_dir!r}")
+                movie_info, user_info, _, _ = \
+                    movielens._parse_meta(members)
+                cache.append((members["ratings.dat"], movie_info,
+                              user_info))
+            ratings, movie_info, user_info = cache[0]
+            r = np.random.RandomState(rand_seed)
+            for line in ratings:
+                if not line.strip():
+                    continue
+                take = (r.random_sample() < test_ratio) == is_test
+                if not take:
+                    continue
+                uid, mid, rating = line.strip().split("::")[:3]
+                usr = user_info[int(uid)]
+                mov = movie_info[int(mid)]
+                yield (list(usr) + [mov[0], mov[1], mov[2]]
+                       + [[float(rating) * 2 - 5.0]])
+
+        return reader
+
+    @staticmethod
+    def _dir(data_dir):
+        return data_dir or _dataset_home("movielens")
+
+    @staticmethod
+    def _present(data_dir):
+        """Cheap existence probe (no archive read)."""
+        if data_dir is None:
+            return False
+        if os.path.exists(os.path.join(data_dir, "ml-1m.zip")):
+            return True
+        return all(
+            os.path.exists(os.path.join(data_dir, n))
+            or os.path.exists(os.path.join(data_dir, "ml-1m", n))
+            for n in ("movies.dat", "users.dat", "ratings.dat"))
+
+    @staticmethod
+    def _synthetic(n, seed, user_vocab=100, movie_vocab=200):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                uid = int(r.randint(1, user_vocab))
+                mid = int(r.randint(1, movie_vocab))
+                cats = [int(c) for c in r.randint(0, 18, r.randint(1, 4))]
+                title = [int(t) for t in r.randint(0, 500,
+                                                   r.randint(1, 8))]
+                rating = float((uid + mid) % 5 + 1) * 2 - 5.0
+                yield [uid, int(r.randint(0, 2)), int(r.randint(0, 7)),
+                       int(r.randint(0, 21)), mid, cats, title,
+                       [rating]]
+
+        return reader
+
+    @staticmethod
+    def train(n=9000, seed=14, data_dir=None, test_ratio=0.1):
+        d = movielens._dir(data_dir)
+        if movielens._present(d):
+            return movielens.reader_creator(d, is_test=False,
+                                            test_ratio=test_ratio)
+        return movielens._synthetic(n, seed)
+
+    @staticmethod
+    def test(n=1000, seed=15, data_dir=None, test_ratio=0.1):
+        d = movielens._dir(data_dir)
+        if movielens._present(d):
+            return movielens.reader_creator(d, is_test=True,
+                                            test_ratio=test_ratio)
+        return movielens._synthetic(n, seed)
+
+    @staticmethod
+    def max_user_id(data_dir=None):
+        _, u, _, _ = movielens.load_meta(movielens._dir(data_dir))
+        return max(u)
+
+    @staticmethod
+    def max_movie_id(data_dir=None):
+        m, _, _, _ = movielens.load_meta(movielens._dir(data_dir))
+        return max(m)
+
+    @staticmethod
+    def max_job_id(data_dir=None):
+        _, u, _, _ = movielens.load_meta(movielens._dir(data_dir))
+        return max(v[3] for v in u.values())
+
+    @staticmethod
+    def get_movie_title_dict(data_dir=None):
+        _, _, t, _ = movielens.load_meta(movielens._dir(data_dir))
+        return t
+
+    @staticmethod
+    def movie_categories(data_dir=None):
+        _, _, _, c = movielens.load_meta(movielens._dir(data_dir))
+        return sorted(c)
+
+    @staticmethod
+    def batches_for_model(reader, batch_size, title_len=12):
+        """Adapt raw movielens samples to models/recommender.py feeds:
+        titles pad/truncate to `title_len` with a companion seq_len,
+        category list is pooled away (the model's movie tower consumes
+        id + title only, like the reference book test)."""
+
+        def gen():
+            buf = []
+            for s in reader():
+                buf.append(s)
+                if len(buf) == batch_size:
+                    yield movielens._to_feed(buf, title_len)
+                    buf = []
+
+        return gen
+
+    @staticmethod
+    def _to_feed(buf, title_len):
+        b = len(buf)
+        title = np.zeros((b, title_len), np.int64)
+        tlen = np.zeros((b,), np.int32)
+        for i, s in enumerate(buf):
+            words = s[6][:title_len]
+            title[i, :len(words)] = words
+            tlen[i] = max(1, len(words))
+        col = lambda j, dt: np.asarray([s[j] for s in buf],
+                                       dt).reshape(b, 1)
+        return {
+            "user_id": col(0, np.int64),
+            "gender_id": col(1, np.int64),
+            "age_id": col(2, np.int64),
+            "job_id": col(3, np.int64),
+            "movie_id": col(4, np.int64),
+            "title_ids": title,
+            "title_ids.seq_len": tlen,
+            "score": np.asarray([s[7][0] for s in buf],
+                                np.float32).reshape(b, 1),
+        }
+
+class wmt14:
+    """WMT14 en→fr subset (dataset/wmt14.py): a tar holding
+    `*/src.dict`, `*/trg.dict` (one token per line, line number = id)
+    and tab-separated parallel text under `train/train`, `test/test`.
+    Sample = (src_ids with <s>/<e> framing, <s>+trg_ids,
+    trg_ids+<e>); pairs with either side >80 tokens are dropped
+    (wmt14.py:107) and OOV maps to UNK_IDX=2 (wmt14.py:53)."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+    UNK_IDX = 2
+
+    @staticmethod
+    def _tar(data_dir):
+        return _find_archive(data_dir, "wmt14",
+                             ("wmt14.tgz", "wmt14.tar.gz", "wmt14.tar"))
+
+    @staticmethod
+    def _dicts(tar_path, dict_size):
+        def to_dict(fd, size):
+            return {line.decode("utf-8").strip(): i
+                    for i, line in enumerate(fd) if i < size}
+
+        with tarfile.open(tar_path) as f:
+            src = [m.name for m in f if m.name.endswith("src.dict")]
+            trg = [m.name for m in f if m.name.endswith("trg.dict")]
+            if len(src) != 1 or len(trg) != 1:
+                raise IOError(
+                    f"wmt14: expected exactly one src.dict and one "
+                    f"trg.dict in {tar_path!r}")
+            return (to_dict(f.extractfile(src[0]), dict_size),
+                    to_dict(f.extractfile(trg[0]), dict_size))
+
+    @staticmethod
+    def reader_creator(tar_path, file_name, dict_size):
+        cache = []  # dicts parsed once, shared by every epoch
+
+        def reader():
+            if not cache:
+                cache.append(wmt14._dicts(tar_path, dict_size))
+            src_dict, trg_dict = cache[0]
+            with tarfile.open(tar_path) as f:
+                names = [m.name for m in f
+                         if m.name.endswith(file_name)]
+                for name in names:
+                    for line in f.extractfile(name):
+                        parts = line.decode("utf-8").strip().split("\t")
+                        if len(parts) != 2:
+                            continue
+                        src_ids = [src_dict.get(w, wmt14.UNK_IDX)
+                                   for w in ([wmt14.START]
+                                             + parts[0].split()
+                                             + [wmt14.END])]
+                        trg_ids = [trg_dict.get(w, wmt14.UNK_IDX)
+                                   for w in parts[1].split()]
+                        if len(src_ids) > 80 or len(trg_ids) > 80:
+                            continue
+                        yield (src_ids,
+                               [trg_dict[wmt14.START]] + trg_ids,
+                               trg_ids + [trg_dict[wmt14.END]])
+
+        return reader
+
+    @staticmethod
+    def _synthetic(dict_size, n, seed):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = int(r.randint(4, 12))
+                body = r.randint(3, dict_size, ln)
+                src = [0] + [int(x) for x in body] + [1]
+                # learnable structure: trg token = succ(src token),
+                # wrapped past the 3 reserved ids
+                trg = [3 + (int(x) - 2) % (dict_size - 3) for x in body]
+                yield src, [0] + trg, trg + [1]
+
+        return reader
+
+    @staticmethod
+    def train(dict_size, data_dir=None, n=2000, seed=16):
+        tp = wmt14._tar(data_dir)
+        if tp:
+            return wmt14.reader_creator(tp, "train/train", dict_size)
+        return wmt14._synthetic(dict_size, n, seed)
+
+    @staticmethod
+    def test(dict_size, data_dir=None, n=200, seed=17):
+        tp = wmt14._tar(data_dir)
+        if tp:
+            return wmt14.reader_creator(tp, "test/test", dict_size)
+        return wmt14._synthetic(dict_size, n, seed)
+
+    @staticmethod
+    def get_dict(dict_size, reverse=True, data_dir=None):
+        tp = wmt14._tar(data_dir)
+        if tp is None:
+            raise IOError("wmt14.get_dict needs the real tar "
+                          "(data_dir= or $PADDLE_DATASET_HOME)")
+        src, trg = wmt14._dicts(tp, dict_size)
+        if reverse:
+            src = {i: w for w, i in src.items()}
+            trg = {i: w for w, i in trg.items()}
+        return src, trg
+
+
+class wmt16:
+    """WMT16 en↔de multimodal subset (dataset/wmt16.py): a tar holding
+    tab-separated `wmt16/train|val|test` (en \\t de).  Vocabularies are
+    built from the TRAIN split by descending frequency with <s>, <e>,
+    <unk> reserved as ids 0/1/2 (wmt16.py:63-84, built in memory here
+    instead of cached dict files); both sides frame with <s>/<e> ids
+    from the source dict (same indices in both, wmt16.py:119-122);
+    src_lang 'en' or 'de' picks the column."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    @staticmethod
+    def _tar(data_dir):
+        return _find_archive(data_dir, "wmt16",
+                             ("wmt16.tar.gz", "wmt16.tgz", "wmt16.tar"))
+
+    @staticmethod
+    def build_dict(tar_path, dict_size, lang):
+        from collections import defaultdict
+
+        freq = defaultdict(int)
+        with tarfile.open(tar_path) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                sen = parts[0] if lang == "en" else parts[1]
+                for w in sen.split():
+                    freq[w] += 1
+        words = [wmt16.START, wmt16.END, wmt16.UNK]
+        # descending frequency; ties broken by insertion order like the
+        # reference's sorted(iteritems, key=count)
+        for w, _c in sorted(freq.items(), key=lambda kv: kv[1],
+                            reverse=True):
+            if len(words) == dict_size:
+                break
+            words.append(w)
+        return {w: i for i, w in enumerate(words)}
+
+    @staticmethod
+    def reader_creator(tar_path, file_name, src_dict_size,
+                       trg_dict_size, src_lang):
+        cache = []  # vocab built once (two full train-split scans),
+        # shared by every epoch's reader() call
+
+        def reader():
+            if not cache:
+                trg_lang = "de" if src_lang == "en" else "en"
+                cache.append((
+                    wmt16.build_dict(tar_path, src_dict_size, src_lang),
+                    wmt16.build_dict(tar_path, trg_dict_size,
+                                     trg_lang)))
+            src_dict, trg_dict = cache[0]
+            start, end, unk = (src_dict[wmt16.START],
+                               src_dict[wmt16.END],
+                               src_dict[wmt16.UNK])
+            src_col = 0 if src_lang == "en" else 1
+            with tarfile.open(tar_path) as f:
+                for line in f.extractfile(file_name):
+                    parts = line.decode("utf-8").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = ([start]
+                               + [src_dict.get(w, unk)
+                                  for w in parts[src_col].split()]
+                               + [end])
+                    trg_ids = [trg_dict.get(w, unk)
+                               for w in parts[1 - src_col].split()]
+                    yield (src_ids, [start] + trg_ids, trg_ids + [end])
+
+        return reader
+
+    @staticmethod
+    def _creator(split, src_dict_size, trg_dict_size, src_lang,
+                 data_dir, n, seed):
+        if src_lang not in ("en", "de"):
+            raise ValueError(f"wmt16: src_lang must be 'en' or 'de', "
+                             f"got {src_lang!r}")
+        tp = wmt16._tar(data_dir)
+        if tp:
+            return wmt16.reader_creator(tp, f"wmt16/{split}",
+                                        src_dict_size, trg_dict_size,
+                                        src_lang)
+        return wmt14._synthetic(min(src_dict_size, trg_dict_size), n,
+                                seed)
+
+    @staticmethod
+    def train(src_dict_size, trg_dict_size, src_lang="en",
+              data_dir=None, n=2000, seed=18):
+        return wmt16._creator("train", src_dict_size, trg_dict_size,
+                              src_lang, data_dir, n, seed)
+
+    @staticmethod
+    def test(src_dict_size, trg_dict_size, src_lang="en",
+             data_dir=None, n=200, seed=19):
+        return wmt16._creator("test", src_dict_size, trg_dict_size,
+                              src_lang, data_dir, n, seed)
+
+    @staticmethod
+    def validation(src_dict_size, trg_dict_size, src_lang="en",
+                   data_dir=None, n=200, seed=20):
+        return wmt16._creator("val", src_dict_size, trg_dict_size,
+                              src_lang, data_dir, n, seed)
+
+
+def padded_nmt_batches(reader, batch_size, max_src_len, max_trg_len,
+                       drop_too_long=True):
+    """Adapt (src_ids, trg_ids, trg_next_ids) NMT samples (wmt14/wmt16)
+    to models/machine_translation.seq_to_seq_net feeds: pad to the
+    static max lengths with companion seq_len vars (the padded+seq_len
+    replacement for the reference's LoD batching, SURVEY.md §5.7).
+    drop_too_long=False TRUNCATES over-length samples instead of
+    dropping them."""
+
+    def gen():
+        buf = []
+        for src, trg, nxt in reader():
+            if drop_too_long and (len(src) > max_src_len
+                                  or len(trg) > max_trg_len):
+                continue
+            buf.append((src, trg, nxt))
+            if len(buf) == batch_size:
+                yield _nmt_feed(buf, max_src_len, max_trg_len)
+                buf = []
+
+    return gen
+
+
+def _nmt_feed(buf, max_src_len, max_trg_len):
+    b = len(buf)
+    src = np.zeros((b, max_src_len), np.int64)
+    trg = np.zeros((b, max_trg_len), np.int64)
+    nxt = np.zeros((b, max_trg_len), np.int64)
+    slen = np.zeros((b,), np.int32)
+    tlen = np.zeros((b,), np.int32)
+    for i, (s, t, nx) in enumerate(buf):
+        s, t = s[:max_src_len], t[:max_trg_len]
+        nx = nx[:max_trg_len]
+        src[i, :len(s)] = s
+        trg[i, :len(t)] = t
+        nxt[i, :len(nx)] = nx
+        slen[i], tlen[i] = len(s), len(t)
+    return {"src_word_id": src, "src_word_id.seq_len": slen,
+            "trg_word_id": trg, "trg_word_id.seq_len": tlen,
+            "trg_next_id": nxt}
