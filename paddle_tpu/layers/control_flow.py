@@ -197,6 +197,87 @@ def array_to_tensor(array, axis=0, use_stack=True):
     return out, idx
 
 
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat (default) or stack a tensor array's entries along `axis`
+    (reference: operators/tensor_array_to_tensor_op.cc:154 and the
+    later fluid API of the same name).  Returns (out, out_index) —
+    out_index holds each entry's size along the axis.  All capacity
+    slots participate (unwritten tail entries are zero: the dense
+    fixed-capacity array protocol)."""
+    from ..ops.control_flow import _tat_axis
+
+    t = input.shape[0]
+    entry = tuple(input.shape[1:])
+    # validate at BUILD time with the op's exact rule, so a bad axis
+    # fails at the offending call, not at executor trace
+    ax = _tat_axis(int(axis), len(entry), bool(use_stack))
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="tensor_array_to_tensor",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": int(axis),
+                            "use_stack": bool(use_stack)})
+    if use_stack:
+        out.desc.shape = entry[:ax] + (t,) + entry[ax:]
+    else:
+        out.desc.shape = (entry[:ax] + (t * entry[ax],)
+                          + entry[ax + 1:])
+    idx.desc.shape = (t,)
+    return out, idx
+
+
+def lod_rank_table(x, level=0):
+    """Rank table of a level-1 sequence batch: (B,) int32 indices
+    sorted by length descending, stable (reference:
+    layers/control_flow.py lod_rank_table / lod_rank_table_op.cc:19).
+    Lengths come from x's .seq_len companion."""
+    if level != 0:
+        raise NotImplementedError(
+            "lod_rank_table: only level-0 (outer) ranking is supported "
+            "— the padded+seq_len design caps nesting at the outer "
+            "level (see README LoD divergence note)")
+    from .sequence import _seq_inputs, seq_len_var
+
+    if seq_len_var(x) is None:
+        raise ValueError(
+            f"lod_rank_table: {x.name!r} has no .seq_len companion — "
+            f"it is not a sequence batch")
+    helper = LayerHelper("lod_rank_table")
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="lod_rank_table", inputs=_seq_inputs(x),
+                     outputs={"Out": [out]})
+    out.desc.shape = (x.shape[0],)
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Permute the batch dim of `x` by `rank_table`
+    (reference: reorder_lod_tensor_by_rank_op.cc:34).  The .seq_len
+    companion (when present) is reordered alongside."""
+    from .sequence import _seq_inputs, seq_len_var
+
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = _seq_inputs(x)
+    ins["RankTable"] = [rank_table]
+    outs = {"Out": [out]}
+    sl = seq_len_var(x)
+    new_sl = None
+    if sl is not None:
+        new_sl = _current_block().create_var(
+            name=f"{out.name}.seq_len", shape=sl.shape, dtype=sl.dtype,
+            stop_gradient=True)
+        outs["OutSeqLen"] = [new_sl]
+    helper.append_op(type="reorder_lod_tensor_by_rank", inputs=ins,
+                     outputs=outs)
+    out.desc.shape = tuple(x.shape)
+    if new_sl is not None:
+        new_sl.desc.shape = tuple(sl.shape)
+    return out
+
+
 def max_sequence_len(seq_len):
     helper = LayerHelper("max_sequence_len")
     out = helper.create_variable_for_type_inference("int32")

@@ -97,6 +97,21 @@ def uniform_random_batch_size_like(ctx, ins, attrs):
     return out(Out=o.astype(dtype))
 
 
+@register_op("gaussian_random_batch_size_like")
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    """reference: operators/gaussian_random_batch_size_like_op.cc —
+    N(mean, std) samples with the batch dim copied from Input."""
+    x = first(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    o = (attrs.get("mean", 0.0)
+         + attrs.get("std", 1.0)
+         * jax.random.normal(ctx.rng(), tuple(shape), jnp.float32))
+    return out(Out=o.astype(dtype))
+
+
 @register_op("randint")
 def randint(ctx, ins, attrs):
     shape = tuple(attrs["shape"])

@@ -445,3 +445,44 @@ def beam_search_decode(ctx, ins, attrs):
     # toks: (T, B, K) in reverse time order → (B, K, T) forward
     seqs = jnp.moveaxis(toks[::-1], 0, 2)
     return out(SentenceIds=seqs)
+
+@register_op("tensor_array_to_tensor")
+def tensor_array_to_tensor(ctx, ins, attrs):
+    """Concat (default) or stack the tensor-array buffer along `axis`
+    (reference: operators/tensor_array_to_tensor_op.cc:154 concats a
+    LoDTensorArray along axis, OutIndex recording each entry's size on
+    that axis).  Fixed-capacity divergence: all T capacity slots
+    participate (unwritten tail entries are zero) — the dense
+    tensor-array protocol above."""
+    buf, _length = first(ins, "X")
+    use_stack = bool(attrs.get("use_stack", False))
+    t = buf.shape[0]
+    entry = buf.shape[1:]
+    axis = _tat_axis(int(attrs.get("axis", 0)), len(entry), use_stack)
+    moved = jnp.moveaxis(buf, 0, axis)
+    if use_stack:
+        o = moved
+        index = jnp.ones((t,), jnp.int32)
+    else:
+        o = moved.reshape(entry[:axis] + (t * entry[axis],)
+                          + entry[axis + 1:])
+        index = jnp.full((t,), entry[axis], jnp.int32)
+    return out(Out=o, OutIndex=index)
+
+
+def _tat_axis(axis: int, rank: int, use_stack: bool) -> int:
+    """Validate/normalize tensor_array_to_tensor's axis: stacking
+    INSERTS a dim (valid positions 0..rank, like the reference
+    StackOp); concatenation needs entries of rank >= 1 and a dim to
+    concat on (0..rank-1)."""
+    if not use_stack and rank == 0:
+        raise ValueError(
+            "tensor_array_to_tensor: cannot concat scalar entries — "
+            "use use_stack=True to stack them into a vector")
+    bound = rank + 1 if use_stack else rank
+    if not -bound <= axis < bound:
+        raise ValueError(
+            f"tensor_array_to_tensor: axis {axis} out of range for "
+            f"entry rank {rank} "
+            f"({'stack inserts at 0..' + str(rank) if use_stack else 'concat needs 0..' + str(rank - 1)})")
+    return axis % bound

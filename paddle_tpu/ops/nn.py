@@ -813,3 +813,11 @@ def maxout(ctx, ins, attrs):
     groups = attrs["groups"]
     n, c, h, w = x.shape
     return out(Out=jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2))
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ctx, ins, attrs):
+    """reference: conv_transpose_op.cc:379 registers
+    depthwise_conv2d_transpose on the SAME ConvTransposeOp — the
+    depthwise-ness is just groups == channels, which
+    _conv_transpose_nd already lowers via feature_group_count."""
+    return _conv_transpose_nd(ins, attrs, 2)

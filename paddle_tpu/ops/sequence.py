@@ -486,3 +486,37 @@ def lod_reset(ctx, ins, attrs):
     o = o.at[seq_of, pos_new].set(gathered)
     return {"Out": [o],
             "Length": [jnp.asarray(new_lens, jnp.int32)]}
+
+@register_op("lod_rank_table")
+def lod_rank_table(ctx, ins, attrs):
+    """Rank a batch of sequences by length, DESCENDING, ties kept in
+    batch order — the dense analog of the reference LoDRankTable
+    (operators/lod_rank_table_op.cc:19; its items are (index, length)
+    sorted by length desc).  Here the table IS the (B,) int32 index
+    vector; lengths come from the input's .seq_len companion."""
+    _reject_nested(ins, "lod_rank_table")
+    sl = opt_in(ins, "SeqLen")
+    if sl is None:
+        raise ValueError(
+            "lod_rank_table requires a level-1 sequence input "
+            "(a var with a .seq_len companion)")
+    # jnp.argsort is stable: equal lengths keep original batch order,
+    # matching the reference's std::stable_sort
+    order = jnp.argsort(-sl.astype(jnp.int32))
+    return out(Out=order.astype(jnp.int32))
+
+
+@register_op("reorder_lod_tensor_by_rank")
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """Permute the batch dim of X by a rank table
+    (operators/reorder_lod_tensor_by_rank_op.cc:34).  Differentiable
+    (gather transposes to scatter-add); the layer wrapper reorders the
+    .seq_len companion alongside via the OutSeqLen output."""
+    _reject_nested(ins, "reorder_lod_tensor_by_rank")
+    x = first(ins, "X")
+    rt = first(ins, "RankTable").astype(jnp.int32)
+    outs = {"Out": [jnp.take(x, rt, axis=0)]}
+    sl = opt_in(ins, "SeqLen")
+    if sl is not None:
+        outs["OutSeqLen"] = [jnp.take(sl, rt, axis=0)]
+    return outs

@@ -422,3 +422,94 @@ def test_error_context_names_failing_op():
                             "y": np.zeros(5, np.float32)},
                 fetch_list=[z])
     assert "elementwise_add" in str(ei.value)
+
+
+def test_tensor_array_to_tensor_concat_and_stack(exe):
+    """tensor_array_to_tensor: axis-concat (default) and use_stack
+    variants over a written array (reference
+    tensor_array_to_tensor_op.cc)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 2, 4], append_batch_size=False)
+        arr = layers.create_array("float32", element_shape=[2, 4],
+                                  capacity=3)
+        for i in range(3):
+            xi = layers.squeeze(
+                layers.slice(x, axes=[0], starts=[i], ends=[i + 1]),
+                axes=[0])
+            layers.array_write(
+                xi, layers.fill_constant([1], "int64", i), arr)
+        cat, cat_idx = layers.tensor_array_to_tensor(arr, axis=1)
+        stk, stk_idx = layers.tensor_array_to_tensor(arr, axis=0,
+                                                     use_stack=True)
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(3, 2, 4).astype(np.float32)
+    c, ci, s, si = exe.run(main, feed={"x": xv},
+                           fetch_list=[cat, cat_idx, stk, stk_idx])
+    np.testing.assert_allclose(c, np.concatenate(list(xv), axis=1))
+    np.testing.assert_array_equal(ci, [4, 4, 4])
+    np.testing.assert_allclose(s, xv)
+    np.testing.assert_array_equal(si, [1, 1, 1])
+
+
+def test_lod_rank_table_and_reorder(exe):
+    """lod_rank_table sorts by length desc (stable); reorder permutes
+    the batch AND the .seq_len companion; gradients route through the
+    permutation."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 3, 2], append_batch_size=False,
+                        lod_level=1)
+        table = layers.lod_rank_table(x)
+        y = layers.reorder_lod_tensor_by_rank(x, table)
+        ylen = layers.seq_len_var(y)
+    exe.run(startup)
+    xv = np.arange(24, dtype=np.float32).reshape(4, 3, 2)
+    sl = np.array([2, 3, 1, 3], np.int32)
+    tb, yv, yl = exe.run(
+        main, feed={"x": xv, "x.seq_len": sl},
+        fetch_list=[table, y, ylen])
+    # lengths [2,3,1,3] -> stable desc order: idx 1 (3), 3 (3), 0, 2
+    np.testing.assert_array_equal(tb, [1, 3, 0, 2])
+    np.testing.assert_allclose(yv, xv[[1, 3, 0, 2]])
+    np.testing.assert_array_equal(yl, [3, 3, 2, 1])
+
+
+def test_lod_rank_table_requires_sequence():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("plain", shape=[4, 3],
+                        append_batch_size=False)
+        with pytest.raises(ValueError, match="seq_len"):
+            layers.lod_rank_table(x)
+
+
+def test_tensor_array_to_tensor_axis_validation(exe):
+    """Stack accepts the insert-at-end position (axis == entry rank);
+    concat rejects it and scalar entries, at BUILD time."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 2, 4], append_batch_size=False)
+        arr = layers.create_array("float32", element_shape=[2, 4],
+                                  capacity=3)
+        for i in range(3):
+            xi = layers.squeeze(
+                layers.slice(x, axes=[0], starts=[i], ends=[i + 1]),
+                axes=[0])
+            layers.array_write(
+                xi, layers.fill_constant([1], "int64", i), arr)
+        tail, _ = layers.tensor_array_to_tensor(arr, axis=2,
+                                                use_stack=True)
+        assert tuple(tail.shape) == (2, 4, 3)
+        with pytest.raises(ValueError, match="out of range"):
+            layers.tensor_array_to_tensor(arr, axis=2)  # concat bound
+        with pytest.raises(ValueError, match="out of range"):
+            layers.tensor_array_to_tensor(arr, axis=3, use_stack=True)
+        scal = layers.create_array("float32", element_shape=[],
+                                   capacity=3)
+        with pytest.raises(ValueError, match="scalar"):
+            layers.tensor_array_to_tensor(scal, axis=0)
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(3, 2, 4).astype(np.float32)
+    (tv,) = exe.run(main, feed={"x": xv}, fetch_list=[tail])
+    np.testing.assert_allclose(tv, np.stack(list(xv), axis=2))
