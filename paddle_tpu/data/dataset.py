@@ -182,12 +182,87 @@ class cifar:
 
 
 class flowers:
+    """Oxford 102 Flowers (dataset/flowers.py): 102flowers.tgz of
+    jpg_XXXXX.jpg images + imagelabels.mat (1-based labels) +
+    setid.mat whose trnid/tstid/valid vectors hold 1-based image
+    indices per split (flowers.py:110-115).  Yields (CHW float32
+    in [0,1] resized 224x224, 0-based label)."""
+
     @staticmethod
-    def train(n=6149, seed=3):
+    def _files(data_dir):
+        if data_dir is None:
+            data_dir = _dataset_home("flowers")
+        if data_dir is None:
+            return None
+        paths = [os.path.join(data_dir, f) for f in
+                 ("102flowers.tgz", "imagelabels.mat", "setid.mat")]
+        return paths if all(os.path.exists(p) for p in paths) else None
+
+    @staticmethod
+    def reader_creator(tgz, label_mat, setid_mat, split,
+                       is_train=False, seed=0):
+        import io
+
+        import scipy.io as scio
+
+        def reader():
+            from PIL import Image
+
+            from .image import simple_transform
+
+            rng = np.random.RandomState(seed)
+            labels = scio.loadmat(label_mat)["labels"][0]
+            idxs = scio.loadmat(setid_mat)[split][0]
+            wanted = {"image_%05d.jpg" % i: int(i) for i in idxs}
+            seen = 0
+            # ONE forward pass over the gzip stream, yielding split
+            # members in ARCHIVE order — random access on a 'r:gz' tar
+            # re-inflates from byte 0 per backward seek (~N full
+            # decompressions per epoch on the real 330 MB archive).
+            # Order divergence vs the reference's index order is
+            # documented; shuffle in the reader pipeline as usual.
+            with tarfile.open(tgz) as t:
+                for m in t:
+                    i = wanted.get(os.path.basename(m.name))
+                    if i is None:
+                        continue
+                    seen += 1
+                    raw = t.extractfile(m).read()
+                    im = np.asarray(
+                        Image.open(io.BytesIO(raw)).convert("RGB"),
+                        np.float32) / 255.0
+                    # train: random crop + flip (the reference
+                    # train_mapper); eval: center crop
+                    im = simple_transform(im, 256, 224,
+                                          is_train=is_train, rng=rng)
+                    yield im.astype(np.float32), int(labels[i - 1]) - 1
+            if seen != len(wanted):
+                raise IOError(
+                    f"flowers: {len(wanted) - seen} of {len(wanted)} "
+                    f"{split} images missing from {tgz!r}")
+
+        return reader
+
+    @staticmethod
+    def train(n=6149, seed=3, data_dir=None):
+        real = flowers._files(data_dir)
+        if real:
+            return flowers.reader_creator(*real, split="trnid",
+                                          is_train=True, seed=seed)
         return _synthetic_classification(n, (3, 224, 224), 102, seed)
 
     @staticmethod
-    def test(n=1020, seed=9):
+    def test(n=1020, seed=9, data_dir=None):
+        real = flowers._files(data_dir)
+        if real:
+            return flowers.reader_creator(*real, split="tstid")
+        return _synthetic_classification(n, (3, 224, 224), 102, seed)
+
+    @staticmethod
+    def valid(n=1020, seed=10, data_dir=None):
+        real = flowers._files(data_dir)
+        if real:
+            return flowers.reader_creator(*real, split="valid")
         return _synthetic_classification(n, (3, 224, 224), 102, seed)
 
 
@@ -361,22 +436,108 @@ class imdb:
 
 
 class imikolov:
-    """N-gram LM windows (dataset/imikolov.py)."""
+    """PTB n-gram LM windows (dataset/imikolov.py): simple-examples.tgz
+    holding ./simple-examples/data/ptb.{train,valid}.txt.  The dict is
+    built from train+valid counts, words with freq > min_word_freq
+    sorted by (-freq, word), '<unk>' appended LAST (imikolov.py:53-80);
+    NGRAM mode yields n-windows over <s> line <e>, SEQ mode yields
+    (<s>+ids, ids+<e>) pairs dropping lines longer than n
+    (imikolov.py:83-109)."""
+
+    NGRAM, SEQ = "NGRAM", "SEQ"
+    TRAIN = "./simple-examples/data/ptb.train.txt"
+    VALID = "./simple-examples/data/ptb.valid.txt"
 
     @staticmethod
-    def build_dict(min_word_freq=50):
-        return {i: i for i in range(2073)}
+    def _tar(data_dir):
+        return _find_archive(
+            data_dir, "imikolov",
+            ("simple-examples.tgz", "simple-examples.tar.gz"))
 
     @staticmethod
-    def train(word_dict=None, n=5, seed=6, samples=100000):
+    def _member(tf, name):
+        # tar member names may or may not carry the leading "./"
+        try:
+            return tf.extractfile(name)
+        except KeyError:
+            return tf.extractfile(name[2:])
+
+    @staticmethod
+    def build_dict(min_word_freq=50, data_dir=None):
+        tp = imikolov._tar(data_dir)
+        if tp is None:
+            # zero-egress fallback: fixed-size synthetic id space
+            return {i: i for i in range(2073)}
+        from collections import Counter
+
+        freq = Counter()
+        with tarfile.open(tp) as tf:
+            for member in (imikolov.TRAIN, imikolov.VALID):
+                for line in imikolov._member(tf, member):
+                    words = line.decode("utf-8").strip().split()
+                    freq.update(["<s>", "<e>"] + words)
+        freq.pop("<unk>", None)
+        kept = sorted(
+            (x for x in freq.items() if x[1] > min_word_freq),
+            key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _c) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    @staticmethod
+    def reader_creator(tar_path, member, word_idx, n, data_type):
+        def reader():
+            unk = word_idx["<unk>"]
+            with tarfile.open(tar_path) as tf:
+                for line in imikolov._member(tf, member):
+                    words = line.decode("utf-8").strip().split()
+                    if data_type == imikolov.NGRAM:
+                        l = ["<s>"] + words + ["<e>"]
+                        if len(l) >= n:
+                            ids = [word_idx.get(w, unk) for w in l]
+                            for i in range(n, len(ids) + 1):
+                                yield tuple(ids[i - n:i])
+                    elif data_type == imikolov.SEQ:
+                        ids = [word_idx.get(w, unk) for w in words]
+                        src = [word_idx.get("<s>", unk)] + ids
+                        if n > 0 and len(src) > n:
+                            continue
+                        yield src, ids + [word_idx.get("<e>", unk)]
+                    else:
+                        raise ValueError(
+                            f"imikolov: unknown data_type {data_type!r}")
+
+        return reader
+
+    @staticmethod
+    def _creator(member, word_dict, n, data_type, data_dir, samples,
+                 seed):
+        tp = imikolov._tar(data_dir)
+        if tp is not None:
+            wd = word_dict or imikolov.build_dict(data_dir=data_dir)
+            return imikolov.reader_creator(tp, member, wd, n,
+                                           data_type or imikolov.NGRAM)
         vocab = len(word_dict) if word_dict else 2073
 
         def reader():
             r = np.random.RandomState(seed)
             for _ in range(samples):
-                yield tuple(int(x) for x in r.randint(0, vocab, size=(n,)))
+                yield tuple(int(x)
+                            for x in r.randint(0, vocab, size=(n,)))
 
         return reader
+
+    @staticmethod
+    def train(word_dict=None, n=5, data_type=None, data_dir=None,
+              seed=6, samples=100000):
+        return imikolov._creator(imikolov.TRAIN, word_dict, n,
+                                 data_type, data_dir, samples, seed)
+
+    @staticmethod
+    def test(word_dict=None, n=5, data_type=None, data_dir=None,
+             seed=13, samples=10000):
+        return imikolov._creator(imikolov.VALID, word_dict, n,
+                                 data_type, data_dir, samples, seed)
 
 class movielens:
     """MovieLens 1-M (dataset/movielens.py): `ml-1m.zip` holding
@@ -873,3 +1034,528 @@ def _nmt_feed(buf, max_src_len, max_trg_len):
     return {"src_word_id": src, "src_word_id.seq_len": slen,
             "trg_word_id": trg, "trg_word_id.seq_len": tlen,
             "trg_next_id": nxt}
+
+class conll05:
+    """CoNLL-2005 SRL (dataset/conll05.py): a tarball holding gzipped
+    parallel `words` / `props` members (one token per line, blank line
+    = sentence break).  Props columns are bracket-tagged spans parsed
+    to B-/I-/O labels (conll05.py:108-133); one sample PER PREDICATE:
+
+        (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+         pred_ids, mark, label_ids)
+
+    where the five ctx slots broadcast the predicate-window words over
+    the sentence, `mark` flags the window positions, and OOV maps to
+    UNK_IDX=0 (conll05.py:150-200).  Dicts load from plain text files
+    (one entry per line); the label dict derives classes from B-/I-
+    prefixes (conll05.py:54-70), SORTED here for determinism (the
+    reference enumerates set order)."""
+
+    UNK_IDX = 0
+    WORDS_MEMBER = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+    PROPS_MEMBER = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+    @staticmethod
+    def load_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)
+                    if line.strip()}
+
+    @staticmethod
+    def load_label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        for tag in sorted(tags):
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    @staticmethod
+    def corpus_reader(tar_path, words_name=WORDS_MEMBER,
+                      props_name=PROPS_MEMBER):
+        """Yield (sentence words, predicate word, BIO labels) per
+        predicate column."""
+
+        def parse_props_column(col):
+            lbl_seq, cur, inside = [], "O", False
+            for l in col:
+                if l == "*" and not inside:
+                    lbl_seq.append("O")
+                elif l == "*" and inside:
+                    lbl_seq.append("I-" + cur)
+                elif l == "*)":
+                    lbl_seq.append("I-" + cur)
+                    inside = False
+                elif "(" in l and ")" in l:
+                    cur = l[1:l.find("*")]
+                    lbl_seq.append("B-" + cur)
+                    inside = False
+                elif "(" in l:
+                    cur = l[1:l.find("*")]
+                    lbl_seq.append("B-" + cur)
+                    inside = True
+                else:
+                    raise IOError(f"conll05: unexpected prop tag {l!r}")
+            return lbl_seq
+
+        def flush(sentence, seg):
+            if seg:
+                cols = list(zip(*seg))
+                verbs = [v for v in cols[0] if v != "-"]
+                for i, col in enumerate(cols[1:]):
+                    yield (list(sentence), verbs[i],
+                           parse_props_column(list(col)))
+
+        def reader():
+            with tarfile.open(tar_path) as tf:
+                wf = gzip.GzipFile(fileobj=tf.extractfile(words_name))
+                pf = gzip.GzipFile(fileobj=tf.extractfile(props_name))
+                sentence, seg = [], []
+                for wline, pline in zip(wf, pf):
+                    word = wline.decode("utf-8").strip()
+                    props = pline.decode("utf-8").strip().split()
+                    if not props:  # sentence boundary
+                        yield from flush(sentence, seg)
+                        sentence, seg = [], []
+                    else:
+                        sentence.append(word)
+                        seg.append(props)
+                # a file ending at EOF without a trailing blank line
+                # must not drop its last sentence
+                yield from flush(sentence, seg)
+
+        return reader
+
+    @staticmethod
+    def reader_creator(corpus_reader, word_dict, predicate_dict,
+                       label_dict):
+        def reader():
+            for sentence, predicate, labels in corpus_reader():
+                n = len(sentence)
+                v = labels.index("B-V")
+                mark = [0] * n
+                ctx = {}
+                for off, name in ((-2, "n2"), (-1, "n1"), (0, "0"),
+                                  (1, "p1"), (2, "p2")):
+                    j = v + off
+                    if 0 <= j < n:
+                        mark[j] = 1
+                        ctx[name] = sentence[j]
+                    else:
+                        ctx[name] = "bos" if off < 0 else "eos"
+                get = lambda w: word_dict.get(w, conll05.UNK_IDX)
+                yield (
+                    [get(w) for w in sentence],
+                    [get(ctx["n2"])] * n, [get(ctx["n1"])] * n,
+                    [get(ctx["0"])] * n, [get(ctx["p1"])] * n,
+                    [get(ctx["p2"])] * n,
+                    [predicate_dict[predicate]] * n,
+                    mark,
+                    [label_dict[l] for l in labels],
+                )
+
+        return reader
+
+    @staticmethod
+    def _files(data_dir):
+        if data_dir is None:
+            data_dir = _dataset_home("conll05st")
+        if data_dir is None:
+            return None
+        paths = [os.path.join(data_dir, f) for f in
+                 ("conll05st-tests.tar.gz", "wordDict.txt",
+                  "verbDict.txt", "targetDict.txt")]
+        return paths if all(os.path.exists(p) for p in paths) else None
+
+    @staticmethod
+    def get_dict(data_dir=None):
+        files = conll05._files(conll05._dir(data_dir))
+        if files is None:
+            raise IOError(
+                "conll05.get_dict needs conll05st-tests.tar.gz + "
+                "wordDict/verbDict/targetDict.txt (data_dir= or "
+                "$PADDLE_DATASET_HOME/conll05st)")
+        _tar, wd, vd, td = files
+        return (conll05.load_dict(wd), conll05.load_dict(vd),
+                conll05.load_label_dict(td))
+
+    @staticmethod
+    def _dir(data_dir):
+        return data_dir
+
+    @staticmethod
+    def _synthetic(n, seed, vocab=200, n_labels=9):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = int(r.randint(4, 12))
+                sent = [int(x) for x in r.randint(1, vocab, ln)]
+                v = int(r.randint(0, ln))
+                mark = [0] * ln
+                for j in range(max(0, v - 2), min(ln, v + 3)):
+                    mark[j] = 1
+                lbl = [int(x) for x in r.randint(0, n_labels, ln)]
+                yield (sent, [sent[max(v - 2, 0)]] * ln,
+                       [sent[max(v - 1, 0)]] * ln, [sent[v]] * ln,
+                       [sent[min(v + 1, ln - 1)]] * ln,
+                       [sent[min(v + 2, ln - 1)]] * ln,
+                       [int(r.randint(0, 50))] * ln, mark, lbl)
+
+        return reader
+
+    @staticmethod
+    def test(n=500, seed=21, data_dir=None):
+        """The reference trains on the freely-available TEST split
+        (conll05.py docstring: 'Because the training dataset is not
+        free, the test dataset is used for training')."""
+        files = conll05._files(data_dir)
+        if files:
+            tar, wd, vd, td = files
+            return conll05.reader_creator(
+                conll05.corpus_reader(tar), conll05.load_dict(wd),
+                conll05.load_dict(vd), conll05.load_label_dict(td))
+        return conll05._synthetic(n, seed)
+
+class mq2007:
+    """LETOR 4.0 MQ2007 learning-to-rank (dataset/mq2007.py): text
+    lines `rel qid:N 1:v 2:v ... 46:v #docid...` (48 space-split parts
+    before the comment, mq2007.py:92-103).  Queries group by qid,
+    docs sort by relevance desc; query_filter keeps only queries whose
+    docs all have labels in {0,1,2} with at least one positive pair
+    (the reference filter drops degenerate querylists).  Formats:
+    pointwise (rel, vec), pairwise (1, better_vec, worse_vec) over all
+    C(n,2) ordered pairs, listwise ((n,1) rels, (n,46) vecs)."""
+
+    N_FEATURES = 46
+
+    @staticmethod
+    def parse_line(text):
+        comment = text.find("#")
+        line = (text[:comment] if comment != -1 else text).strip()
+        parts = line.split()
+        if len(parts) != 2 + mq2007.N_FEATURES:
+            raise IOError(
+                f"mq2007: expect {2 + mq2007.N_FEATURES} space-split "
+                f"parts, got {len(parts)}: {text[:60]!r}")
+        rel = int(parts[0])
+        qid = int(parts[1].split(":")[1])
+        vec = [float(p.split(":")[1]) for p in parts[2:]]
+        return rel, qid, vec
+
+    @staticmethod
+    def load_from_text(path):
+        """→ list of (qid, [(rel, vec), ...]) in file order."""
+        groups, order = {}, []
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                rel, qid, vec = mq2007.parse_line(line)
+                if qid not in groups:
+                    groups[qid] = []
+                    order.append(qid)
+                groups[qid].append((rel, vec))
+        return [(q, groups[q]) for q in order]
+
+    FORMATS = ("pointwise", "pairwise", "listwise")
+
+    @staticmethod
+    def query_filter(groups):
+        """Drop queries whose documents are ALL relevance 0 (the
+        reference query_filter, mq2007.py:250 — a zero-sum querylist
+        has no ranking signal)."""
+        return [(q, docs) for q, docs in groups
+                if sum(d[0] for d in docs) != 0]
+
+    @staticmethod
+    def reader_creator(path, format="pairwise"):
+        mq2007._check_format(format)
+
+        def reader():
+            for _qid, docs in mq2007.query_filter(
+                    mq2007.load_from_text(path)):
+                docs = sorted(docs, key=lambda d: d[0], reverse=True)
+                if format == "pointwise":
+                    for rel, vec in docs:
+                        yield rel, np.asarray(vec, np.float32)
+                elif format == "pairwise":
+                    for i in range(len(docs)):
+                        for j in range(i + 1, len(docs)):
+                            if docs[i][0] > docs[j][0]:
+                                yield (np.asarray([1], np.float32),
+                                       np.asarray(docs[i][1],
+                                                  np.float32),
+                                       np.asarray(docs[j][1],
+                                                  np.float32))
+                elif format == "listwise":
+                    yield (np.asarray([[d[0]] for d in docs],
+                                      np.float32),
+                           np.asarray([d[1] for d in docs],
+                                      np.float32))
+                else:  # pragma: no cover — _check_format guards
+                    raise ValueError(
+                        f"mq2007: unknown format {format!r}")
+
+        return reader
+
+    @staticmethod
+    def _check_format(format):
+        if format not in mq2007.FORMATS:
+            raise ValueError(
+                f"mq2007: unknown format {format!r} (use "
+                f"{'/'.join(mq2007.FORMATS)})")
+
+    @staticmethod
+    def _file(data_dir, name):
+        if data_dir is None:
+            data_dir = _dataset_home("MQ2007")
+        if data_dir is None:
+            return None
+        for cand in (os.path.join(data_dir, name),
+                     os.path.join(data_dir, "MQ2007", "Fold1", name)):
+            if os.path.exists(cand):
+                return cand
+        return None
+
+    @staticmethod
+    def _synthetic(n_queries, seed, format):
+        mq2007._check_format(format)
+
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n_queries):
+                n = int(r.randint(3, 8))
+                docs = [(int(r.randint(0, 3)),
+                         r.randn(mq2007.N_FEATURES).tolist())
+                        for _ in range(n)]
+                docs.sort(key=lambda d: d[0], reverse=True)
+                if format == "pointwise":
+                    for rel, vec in docs:
+                        yield rel, np.asarray(vec, np.float32)
+                elif format == "pairwise":
+                    for i in range(len(docs)):
+                        for j in range(i + 1, len(docs)):
+                            if docs[i][0] > docs[j][0]:
+                                yield (np.asarray([1], np.float32),
+                                       np.asarray(docs[i][1],
+                                                  np.float32),
+                                       np.asarray(docs[j][1],
+                                                  np.float32))
+                else:
+                    yield (np.asarray([[d[0]] for d in docs],
+                                      np.float32),
+                           np.asarray([d[1] for d in docs],
+                                      np.float32))
+
+        return reader
+
+    @staticmethod
+    def train(format="pairwise", data_dir=None, n_queries=200, seed=22):
+        p = mq2007._file(data_dir, "train.txt")
+        if p:
+            return mq2007.reader_creator(p, format)
+        return mq2007._synthetic(n_queries, seed, format)
+
+    @staticmethod
+    def test(format="pairwise", data_dir=None, n_queries=40, seed=23):
+        p = mq2007._file(data_dir, "test.txt")
+        if p:
+            return mq2007.reader_creator(p, format)
+        return mq2007._synthetic(n_queries, seed, format)
+
+
+class sentiment:
+    """NLTK movie_reviews sentiment corpus (dataset/sentiment.py): a
+    directory (or zip) of pos/*.txt and neg/*.txt reviews.  The word
+    dict orders ALL corpus words by descending frequency
+    (sentiment.py:56-74); samples are (word id list, 0=pos|1=neg)
+    following the reference's category indexing."""
+
+    @staticmethod
+    def _root(data_dir):
+        if data_dir is None:
+            data_dir = _dataset_home("sentiment")
+        if data_dir is None:
+            return None
+        for cand in (data_dir, os.path.join(data_dir, "movie_reviews")):
+            if (os.path.isdir(os.path.join(cand, "pos"))
+                    and os.path.isdir(os.path.join(cand, "neg"))):
+                return cand
+        return None
+
+    @staticmethod
+    def _tokenize(text):
+        import re
+
+        return re.findall(r"[a-z0-9']+|[^\sa-z0-9']", text.lower())
+
+    @staticmethod
+    def _iter_files(root, cat):
+        d = os.path.join(root, cat)
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".txt"):
+                with open(os.path.join(d, name), encoding="latin-1") as f:
+                    yield sentiment._tokenize(f.read())
+
+    # one read+tokenize scan of the 2000-file corpus shared by
+    # get_word_dict and every train/test reader, keyed by corpus root
+    _corpus_cache: dict = {}
+
+    @staticmethod
+    def _load_corpus(root):
+        if root not in sentiment._corpus_cache:
+            from collections import Counter
+
+            freq = Counter()
+            per_cat = {}
+            for cat in ("pos", "neg"):
+                per_cat[cat] = list(sentiment._iter_files(root, cat))
+                for words in per_cat[cat]:
+                    freq.update(words)
+            ranked = sorted(freq.items(), key=lambda kv: -kv[1])
+            word_dict = [(w, i) for i, (w, _c) in enumerate(ranked)]
+            sentiment._corpus_cache[root] = (per_cat, word_dict)
+        return sentiment._corpus_cache[root]
+
+    @staticmethod
+    def get_word_dict(data_dir=None):
+        """[(word, id)] ordered by descending corpus frequency (ties by
+        first-seen order, matching the reference's stable sort)."""
+        root = sentiment._root(data_dir)
+        if root is None:
+            raise IOError(
+                "sentiment.get_word_dict needs a movie_reviews dir "
+                "with pos/ and neg/ (data_dir= or "
+                "$PADDLE_DATASET_HOME/sentiment)")
+        return sentiment._load_corpus(root)[1]
+
+    @staticmethod
+    def reader_creator(data_dir, is_test, test_ratio=0.1):
+        def reader():
+            root = sentiment._root(data_dir)
+            per_cat, word_dict = sentiment._load_corpus(root)
+            ids = dict(word_dict)
+            # split WITHIN each category so both splits keep the
+            # pos/neg balance (a tail slice of the pos-then-neg list
+            # would make the test split all-negative)
+            for label, cat in enumerate(("pos", "neg")):
+                docs = per_cat[cat]
+                n_test = max(1, int(len(docs) * test_ratio))
+                picked = docs[-n_test:] if is_test else docs[:-n_test]
+                for words in picked:
+                    yield [ids[w] for w in words], label
+
+        return reader
+
+    @staticmethod
+    def _synthetic(n, seed, vocab=5000):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = int(r.randint(20, 120))
+                label = int(r.randint(0, 2))
+                # learnable: polarity words drawn from disjoint ranges
+                base = 100 + label * 200
+                yield ([int(x) for x in r.randint(base, base + 200,
+                                                  ln)], label)
+
+        return reader
+
+    @staticmethod
+    def train(n=1800, seed=24, data_dir=None):
+        if sentiment._root(data_dir or _dataset_home("sentiment")):
+            return sentiment.reader_creator(data_dir, is_test=False)
+        return sentiment._synthetic(n, seed)
+
+    @staticmethod
+    def test(n=200, seed=25, data_dir=None):
+        if sentiment._root(data_dir or _dataset_home("sentiment")):
+            return sentiment.reader_creator(data_dir, is_test=True)
+        return sentiment._synthetic(n, seed)
+
+
+class voc2012:
+    """PASCAL VOC2012 segmentation (dataset/voc2012.py): the VOCdevkit
+    tar with ImageSets/Segmentation/{train,val,trainval}.txt name
+    lists, JPEGImages/<name>.jpg and SegmentationClass/<name>.png
+    (voc2012.py:37-39).  Yields (HWC uint8 image, HW uint8 class-index
+    mask) — the palette png decodes to class indices."""
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+    @staticmethod
+    def _tar(data_dir):
+        return _find_archive(
+            data_dir, "voc2012",
+            ("VOCtrainval_11-May-2012.tar", "VOC2012.tar",
+             "voc2012.tar"))
+
+    @staticmethod
+    def reader_creator(tar_path, sub_name):
+        import io
+
+        def reader():
+            from PIL import Image
+
+            with tarfile.open(tar_path) as t:
+                names = t.extractfile(
+                    voc2012.SET_FILE.format(sub_name)).read()
+                for name in names.decode("utf-8").split():
+                    img = t.extractfile(
+                        voc2012.DATA_FILE.format(name)).read()
+                    lbl = t.extractfile(
+                        voc2012.LABEL_FILE.format(name)).read()
+                    im = np.asarray(
+                        Image.open(io.BytesIO(img)).convert("RGB"),
+                        np.uint8)
+                    # palette png: pixel values ARE the class indices
+                    mask = np.asarray(Image.open(io.BytesIO(lbl)),
+                                      np.uint8)
+                    yield im, mask
+
+        return reader
+
+    @staticmethod
+    def _synthetic(n, seed, size=64, n_classes=21):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                im = r.randint(0, 256, (size, size, 3)).astype(np.uint8)
+                mask = r.randint(0, n_classes,
+                                 (size, size)).astype(np.uint8)
+                yield im, mask
+
+        return reader
+
+    @staticmethod
+    def _split(sub, n, seed, data_dir):
+        tp = voc2012._tar(data_dir)
+        if tp:
+            return voc2012.reader_creator(tp, sub)
+        return voc2012._synthetic(n, seed)
+
+    # NOTE the reference's own split mapping is train->'trainval',
+    # test->'train', val->'val' (voc2012.py:69-87 — VOC's real test
+    # labels are not public, so its "test" reuses the train list and
+    # OVERLAPS train).  Kept verbatim for parity; use val() for an
+    # untainted eval split.
+
+    @staticmethod
+    def train(n=100, seed=26, data_dir=None):
+        return voc2012._split("trainval", n, seed, data_dir)
+
+    @staticmethod
+    def test(n=20, seed=27, data_dir=None):
+        return voc2012._split("train", n, seed, data_dir)
+
+    @staticmethod
+    def val(n=20, seed=28, data_dir=None):
+        return voc2012._split("val", n, seed, data_dir)

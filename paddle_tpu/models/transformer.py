@@ -150,7 +150,8 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
 def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
                   d_model, d_inner, dropout, use_flash=False,
                   fused_qkv=False, moe_experts=0, aux_list=None,
-                  flash_pallas=None, self_causal=False):
+                  flash_pallas=None, self_causal=False,
+                  flash_cross=False):
     self_attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, self_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
@@ -158,8 +159,16 @@ def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
         causal=self_causal)
     self_attn = pre_post_process(x, self_attn, "ad", dropout)
     q = pre_post_process(None, self_attn, "n")
+    # flash_cross routes CROSS attention through the flash op too
+    # (key-padding bias, non-causal) — required at long sequence
+    # lengths where the composed path would materialize the
+    # (N, H, T, T) weight tensor; default off to keep the historically
+    # benched short-sequence program unchanged
     cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
-                                 d_value, d_model, n_head, dropout)
+                                 d_value, d_model, n_head, dropout,
+                                 use_flash=flash_cross,
+                                 flash_pallas=(flash_pallas
+                                               if flash_cross else None))
     cross = pre_post_process(self_attn, cross, "ad", dropout)
     ff = _ffn_or_moe(pre_post_process(None, cross, "n"), d_inner,
                      d_model, moe_experts, aux_list)
@@ -216,7 +225,7 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
                 use_flash=False, use_fused_ce=False, fused_qkv=False,
                 moe_experts=0, moe_aux_weight=0.01, flash_pallas=None,
-                recompute=False, pipeline=False):
+                recompute=False, pipeline=False, flash_cross=False):
     """Build the full training graph; returns (avg_cost, logits, feeds).
     moe_experts > 0 swaps every FFN sublayer for a switch-MoE block
     (experts sharded over mp/ep) and folds the load-balance aux losses
@@ -297,7 +306,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                                   moe_experts=moe_experts,
                                   aux_list=moe_aux,
                                   flash_pallas=flash_pallas,
-                                  self_causal=self_causal)
+                                  self_causal=self_causal,
+                                  flash_cross=flash_cross)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -360,14 +370,15 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
                 use_amp=False, use_fused_ce=False, fused_qkv=False,
                 moe_experts=0, flash_pallas=None, recompute=False,
-                pipeline=False):
+                pipeline=False, flash_cross=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
         dropout, label_smooth_eps, use_flash=use_flash,
         use_fused_ce=use_fused_ce, fused_qkv=fused_qkv,
         moe_experts=moe_experts, flash_pallas=flash_pallas,
-        recompute=recompute, pipeline=pipeline)
+        recompute=recompute, pipeline=pipeline,
+        flash_cross=flash_cross)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

@@ -646,3 +646,276 @@ def test_machine_translation_trains_from_wmt16_files(tmp_path):
                 losses.append(float(np.ravel(lv)[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+# --- round-5 dataset breadth: imikolov/conll05/mq2007/sentiment/
+#     voc2012/flowers real-format parsers ---------------------------------
+
+def _write_imikolov_fixture(d):
+    import io as _io
+    import tarfile as _tf
+
+    train = ("the cat sat on the mat\n"
+             "the dog sat on the log\n"
+             "the cat ran\n")
+    valid = "the dog ran rarewordhere\n"
+    p = os.path.join(d, "simple-examples.tgz")
+    with _tf.open(p, "w:gz") as t:
+        for name, text in (
+                ("./simple-examples/data/ptb.train.txt", train),
+                ("./simple-examples/data/ptb.valid.txt", valid)):
+            blob = text.encode("utf-8")
+            info = _tf.TarInfo(name)
+            info.size = len(blob)
+            t.addfile(info, _io.BytesIO(blob))
+
+
+def test_imikolov_ptb_parses(tmp_path):
+    from paddle_tpu.data import dataset
+
+    d = str(tmp_path)
+    _write_imikolov_fixture(d)
+    wd = dataset.imikolov.build_dict(min_word_freq=1, data_dir=d)
+    # 'the' appears 6x -> most frequent -> id 0; <unk> is LAST
+    assert wd["the"] == 0
+    assert wd["<unk>"] == len(wd) - 1
+    # freq > 1 cut: 'rarewordhere' and 'log'/'mat'/'ran'... appear once
+    assert "rarewordhere" not in wd
+    grams = list(dataset.imikolov.train(wd, n=3, data_dir=d)())
+    # every 3-gram over <s> line <e>; first line has 8 tokens -> 6 grams
+    assert len(grams[0]) == 3
+    s_id, e_id = wd["<s>"], wd["<e>"]
+    assert grams[0][0] == s_id
+    unk = wd["<unk>"]
+    # SEQ mode
+    seqs = list(dataset.imikolov.train(
+        wd, n=0, data_type=dataset.imikolov.SEQ, data_dir=d)())
+    assert len(seqs) == 3
+    src, trg = seqs[0]
+    assert src[0] == s_id and trg[-1] == e_id
+    assert src[1:] == trg[:-1]
+
+
+def _write_conll05_fixture(d):
+    import gzip as _gz
+    import io as _io
+    import tarfile as _tf
+
+    # two sentences; sentence 1 has 2 predicates (lemma rows 1 and 2 in
+    # column 0, one bracket-tag column per predicate), sentence 2 one
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    props = ("-\t(A0*)\t(A0*\n"
+             "meow\t(V*)\t*)\n"
+             "sit\t(A1*)\t(V*)\n"
+             "\n"
+             "-\t(A0*)\n"
+             "bark\t(V*)\n"
+             "\n").replace("\t", " ")
+    wbuf, pbuf = _io.BytesIO(), _io.BytesIO()
+    with _gz.GzipFile(fileobj=wbuf, mode="wb") as g:
+        g.write(words.encode())
+    with _gz.GzipFile(fileobj=pbuf, mode="wb") as g:
+        g.write(props.encode())
+    p = os.path.join(d, "conll05st-tests.tar.gz")
+    from paddle_tpu.data.dataset import conll05
+
+    with _tf.open(p, "w:gz") as t:
+        for name, blob in ((conll05.WORDS_MEMBER, wbuf.getvalue()),
+                           (conll05.PROPS_MEMBER, pbuf.getvalue())):
+            info = _tf.TarInfo(name)
+            info.size = len(blob)
+            t.addfile(info, _io.BytesIO(blob))
+    with open(os.path.join(d, "wordDict.txt"), "w") as f:
+        f.write("bos\neos\nThe\ncat\nsat\nDogs\nbark\n")
+    with open(os.path.join(d, "verbDict.txt"), "w") as f:
+        f.write("meow\nsit\nbark\n")
+    with open(os.path.join(d, "targetDict.txt"), "w") as f:
+        f.write("B-A0\nI-A0\nB-A1\nI-A1\nB-V\nI-V\nO\n")
+
+
+def test_conll05_props_parse_and_windows(tmp_path):
+    from paddle_tpu.data import dataset
+
+    d = str(tmp_path)
+    _write_conll05_fixture(d)
+    wd, vd, ld = dataset.conll05.get_dict(d)
+    assert set(vd) == {"meow", "sit", "bark"}
+    # label dict: sorted tags A0, A1, V -> B-A0=0 I-A0=1 ... O=6
+    assert ld["B-A0"] == 0 and ld["B-V"] == 4 and ld["O"] == 6
+    samples = list(dataset.conll05.test(data_dir=d)())
+    # sentence 1 contributes 2 predicate samples, sentence 2 one
+    assert len(samples) == 3
+    words, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark, labels = samples[0]
+    # predicate col 1 of sentence 1: V at token 1 ('cat'), A0 at 0
+    assert labels == [ld["B-A0"], ld["B-V"], ld["B-A1"]]
+    assert pred == [vd["meow"]] * 3
+    # window around verb_index=1: positions 0,1,2 (+2 clipped) marked
+    assert mark == [1, 1, 1]
+    assert c_0 == [wd["cat"]] * 3 and c_n1 == [wd["The"]] * 3
+    assert c_n2 == [wd["bos"]] * 3  # off the left edge
+    # multi-token span: second predicate of sentence 1
+    _w, _n2, _n1, _c0, _p1, _p2, _pr, _mk, labels2 = samples[1]
+    assert labels2 == [ld["B-A0"], ld["I-A0"], ld["B-V"]]
+
+
+def _write_mq2007_fixture(d):
+    lines = []
+    rng = np.random.RandomState(0)
+    # qid 12 is all-zero relevance: query_filter must drop it
+    for qid, rels in ((10, [2, 0, 1]), (11, [0, 0, 1]),
+                      (12, [0, 0, 0])):
+        for r in rels:
+            feats = " ".join(f"{i + 1}:{rng.rand():.6f}"
+                             for i in range(46))
+            lines.append(f"{r} qid:{qid} {feats} #docid = GX{qid}\n")
+    with open(os.path.join(d, "train.txt"), "w") as f:
+        f.writelines(lines)
+
+
+def test_mq2007_letor_parses(tmp_path):
+    from paddle_tpu.data import dataset
+
+    d = str(tmp_path)
+    _write_mq2007_fixture(d)
+    # the all-zero qid 12 is filtered (reference query_filter)
+    pts = list(dataset.mq2007.train("pointwise", data_dir=d)())
+    assert len(pts) == 6
+    rel0, vec0 = pts[0]
+    assert rel0 == 2 and vec0.shape == (46,)  # sorted desc per query
+    pairs = list(dataset.mq2007.train("pairwise", data_dir=d)())
+    # qid 10 rels [2,1,0] -> 3 ordered pairs; qid 11 [1,0,0] -> 2
+    assert len(pairs) == 5
+    lbl, better, worse = pairs[0]
+    assert lbl[0] == 1 and better.shape == worse.shape == (46,)
+    lists = list(dataset.mq2007.train("listwise", data_dir=d)())
+    assert len(lists) == 2
+    rels, vecs = lists[0]
+    assert rels.shape == (3, 1) and vecs.shape == (3, 46)
+    assert rels[0, 0] >= rels[1, 0] >= rels[2, 0]
+    with pytest.raises(ValueError, match="format"):
+        list(dataset.mq2007.train("bogus", data_dir=d)())
+    # the synthetic fallback validates the format too (a typo must not
+    # silently degrade to listwise on machines without the files)
+    with pytest.raises(ValueError, match="format"):
+        dataset.mq2007.train("listwse", data_dir=str(tmp_path / "no"))
+
+
+def _write_sentiment_fixture(d):
+    root = os.path.join(d, "movie_reviews")
+    for cat, texts in (("pos", ["a great great film .",
+                                "great fun movie !"]),
+                       ("neg", ["a terrible film .",
+                                "boring boring movie ."])):
+        os.makedirs(os.path.join(root, cat))
+        for i, t in enumerate(texts):
+            with open(os.path.join(root, cat, f"cv{i}.txt"), "w") as f:
+                f.write(t)
+
+
+def test_sentiment_movie_reviews_parse(tmp_path):
+    from paddle_tpu.data import dataset
+
+    d = str(tmp_path)
+    _write_sentiment_fixture(d)
+    wd = dict(dataset.sentiment.get_word_dict(data_dir=d))
+    # 'great' (3) and '.' (3) are the most frequent words
+    assert wd["great"] in (0, 1) and wd["."] in (0, 1)
+    train = list(dataset.sentiment.reader_creator(d, is_test=False)())
+    test = list(dataset.sentiment.reader_creator(d, is_test=True)())
+    assert len(train) + len(test) == 4
+    ids, label = train[0]
+    assert label in (0, 1)
+    assert all(isinstance(i, int) for i in ids)
+
+
+def _write_voc2012_fixture(d):
+    import io as _io
+    import tarfile as _tf
+
+    from PIL import Image
+
+    from paddle_tpu.data.dataset import voc2012
+
+    rng = np.random.RandomState(5)
+    p = os.path.join(d, "VOCtrainval_11-May-2012.tar")
+    ims = {}
+    with _tf.open(p, "w") as t:
+        def add(name, blob):
+            info = _tf.TarInfo(name)
+            info.size = len(blob)
+            t.addfile(info, _io.BytesIO(blob))
+
+        names = ["2007_000001", "2007_000002"]
+        # the reference maps train()->'trainval' and test()->'train'
+        add(voc2012.SET_FILE.format("trainval"),
+            "\n".join(names).encode())
+        add(voc2012.SET_FILE.format("train"),
+            names[0].encode())
+        for name in names:
+            im = rng.randint(0, 256, (20, 24, 3)).astype(np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(im).save(buf, "JPEG")
+            add(voc2012.DATA_FILE.format(name), buf.getvalue())
+            mask = rng.randint(0, 21, (20, 24)).astype(np.uint8)
+            pim = Image.fromarray(mask, mode="P")
+            pim.putpalette([i for _ in range(85) for i in (0, 0, 0)])
+            buf = _io.BytesIO()
+            pim.save(buf, "PNG")
+            add(voc2012.LABEL_FILE.format(name), buf.getvalue())
+            ims[name] = mask
+    return ims
+
+
+def test_voc2012_tar_parses(tmp_path):
+    from paddle_tpu.data import dataset
+
+    d = str(tmp_path)
+    masks = _write_voc2012_fixture(d)
+    samples = list(dataset.voc2012.train(data_dir=d)())
+    assert len(samples) == 2
+    im, mask = samples[0]
+    assert im.shape == (20, 24, 3) and im.dtype == np.uint8
+    assert mask.shape == (20, 24) and mask.dtype == np.uint8
+    np.testing.assert_array_equal(mask, masks["2007_000001"])
+    # test() follows the reference's 'train' list mapping
+    assert len(list(dataset.voc2012.test(data_dir=d)())) == 1
+
+
+def _write_flowers_fixture(d):
+    import io as _io
+    import tarfile as _tf
+
+    import scipy.io as scio
+    from PIL import Image
+
+    rng = np.random.RandomState(6)
+    n = 4
+    with _tf.open(os.path.join(d, "102flowers.tgz"), "w:gz") as t:
+        for i in range(1, n + 1):
+            im = rng.randint(0, 256, (40, 30, 3)).astype(np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(im).save(buf, "JPEG")
+            blob = buf.getvalue()
+            info = _tf.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(blob)
+            t.addfile(info, _io.BytesIO(blob))
+    scio.savemat(os.path.join(d, "imagelabels.mat"),
+                 {"labels": np.array([[5, 3, 5, 1]], np.uint8)})
+    scio.savemat(os.path.join(d, "setid.mat"),
+                 {"trnid": np.array([[1, 3]], np.uint16),
+                  "tstid": np.array([[2]], np.uint16),
+                  "valid": np.array([[4]], np.uint16)})
+
+
+def test_flowers_real_format_parses(tmp_path):
+    from paddle_tpu.data import dataset
+
+    d = str(tmp_path)
+    _write_flowers_fixture(d)
+    train = list(dataset.flowers.train(data_dir=d)())
+    assert len(train) == 2
+    im, lbl = train[0]
+    assert im.shape == (3, 224, 224) and im.dtype == np.float32
+    assert lbl == 4  # 1-based label 5 -> 0-based 4
+    test = list(dataset.flowers.test(data_dir=d)())
+    assert len(test) == 1 and test[0][1] == 2

@@ -189,3 +189,36 @@ def test_resnet_nhwc_matches_nchw():
 
     np.testing.assert_allclose(run("NCHW"), run("NHWC"), rtol=2e-3,
                                atol=1e-4)
+
+
+def test_transformer_flash_cross_parity():
+    """flash_cross=True (cross attention through the flash op — the
+    long-context path) matches the composed-cross program's loss."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    def run(flash_cross):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            model = transformer.build_model(
+                src_vocab_size=64, trg_vocab_size=64, max_length=16,
+                n_layer=2, n_head=2, d_model=32, d_inner_hid=64,
+                dropout=0.0, with_optimizer=True, learning_rate=0.5,
+                warmup_steps=10, use_flash=True,
+                flash_cross=flash_cross)
+            exe = fluid.Executor()
+            exe.run(startup)
+            batch = transformer.make_fake_batch(
+                4, max_length=16, src_vocab=64, trg_vocab=64)
+            losses = []
+            for _ in range(3):
+                (lv,) = exe.run(main, feed=batch,
+                                fetch_list=[model["loss"]])
+                losses.append(float(np.ravel(lv)[0]))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-4,
+                               atol=2e-4)
