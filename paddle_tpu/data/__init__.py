@@ -6,7 +6,8 @@ buffered/firstn/map_readers/xmap_readers:58-338), python/paddle/dataset/
 """
 
 from .data_feeder import DataFeeder  # noqa: F401
-from .decorator import (batch, buffered, chain, compose, firstn,  # noqa: F401
-                        map_readers, shuffle, xmap_readers)
+from .decorator import (Fake, batch, buffered, chain, compose, firstn,  # noqa: F401
+                        map_readers, multiprocess_reader, shuffle,
+                        xmap_readers)
 from . import dataset  # noqa: F401
 from . import image  # noqa: F401

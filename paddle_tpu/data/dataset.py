@@ -205,12 +205,14 @@ class flowers:
 
         import scipy.io as scio
 
+        # ONE augmentation stream across epochs: reseeding inside
+        # reader() would give every epoch identical "random" crops
+        rng = np.random.RandomState(seed)
+
         def reader():
             from PIL import Image
 
             from .image import simple_transform
-
-            rng = np.random.RandomState(seed)
             labels = scio.loadmat(label_mat)["labels"][0]
             idxs = scio.loadmat(setid_mat)[split][0]
             wanted = {"image_%05d.jpg" % i: int(i) for i in idxs}
@@ -512,18 +514,30 @@ class imikolov:
     @staticmethod
     def _creator(member, word_dict, n, data_type, data_dir, samples,
                  seed):
+        data_type = data_type or imikolov.NGRAM
         tp = imikolov._tar(data_dir)
         if tp is not None:
             wd = word_dict or imikolov.build_dict(data_dir=data_dir)
-            return imikolov.reader_creator(tp, member, wd, n,
-                                           data_type or imikolov.NGRAM)
+            return imikolov.reader_creator(tp, member, wd, n, data_type)
         vocab = len(word_dict) if word_dict else 2073
 
         def reader():
+            # the zero-egress fallback must match the real path's
+            # sample shape per data_type
             r = np.random.RandomState(seed)
-            for _ in range(samples):
-                yield tuple(int(x)
-                            for x in r.randint(0, vocab, size=(n,)))
+            if data_type == imikolov.NGRAM:
+                for _ in range(samples):
+                    yield tuple(int(x)
+                                for x in r.randint(0, vocab,
+                                                   size=(max(n, 1),)))
+            elif data_type == imikolov.SEQ:
+                for _ in range(samples):
+                    ln = int(r.randint(3, max(n, 4) if n > 0 else 12))
+                    ids = [int(x) for x in r.randint(3, vocab, ln)]
+                    yield [0] + ids, ids + [1]
+            else:
+                raise ValueError(
+                    f"imikolov: unknown data_type {data_type!r}")
 
         return reader
 
@@ -1175,7 +1189,7 @@ class conll05:
 
     @staticmethod
     def get_dict(data_dir=None):
-        files = conll05._files(conll05._dir(data_dir))
+        files = conll05._files(data_dir)
         if files is None:
             raise IOError(
                 "conll05.get_dict needs conll05st-tests.tar.gz + "
@@ -1184,10 +1198,6 @@ class conll05:
         _tar, wd, vd, td = files
         return (conll05.load_dict(wd), conll05.load_dict(vd),
                 conll05.load_label_dict(td))
-
-    @staticmethod
-    def _dir(data_dir):
-        return data_dir
 
     @staticmethod
     def _synthetic(n, seed, vocab=200, n_labels=9):
@@ -1226,11 +1236,13 @@ class mq2007:
     """LETOR 4.0 MQ2007 learning-to-rank (dataset/mq2007.py): text
     lines `rel qid:N 1:v 2:v ... 46:v #docid...` (48 space-split parts
     before the comment, mq2007.py:92-103).  Queries group by qid,
-    docs sort by relevance desc; query_filter keeps only queries whose
-    docs all have labels in {0,1,2} with at least one positive pair
-    (the reference filter drops degenerate querylists).  Formats:
-    pointwise (rel, vec), pairwise (1, better_vec, worse_vec) over all
-    C(n,2) ordered pairs, listwise ((n,1) rels, (n,46) vecs)."""
+    docs sort by relevance desc; query_filter drops queries whose
+    relevances are ALL zero (the reference filter, mq2007.py:250 —
+    note it does NOT validate the {0,1,2} label range, and a
+    constant-positive query legally yields zero pairwise pairs).
+    Formats: pointwise (rel, vec), pairwise (1, better_vec, worse_vec)
+    over all C(n,2) ordered pairs, listwise ((n,1) rels, (n,46)
+    vecs)."""
 
     N_FEATURES = 46
 
@@ -1274,33 +1286,34 @@ class mq2007:
                 if sum(d[0] for d in docs) != 0]
 
     @staticmethod
+    def _emit(docs, format):
+        """One query's docs → samples for the chosen format (shared by
+        the real and synthetic paths so they can never drift)."""
+        docs = sorted(docs, key=lambda d: d[0], reverse=True)
+        if format == "pointwise":
+            for rel, vec in docs:
+                yield rel, np.asarray(vec, np.float32)
+        elif format == "pairwise":
+            for i in range(len(docs)):
+                for j in range(i + 1, len(docs)):
+                    if docs[i][0] > docs[j][0]:
+                        yield (np.asarray([1], np.float32),
+                               np.asarray(docs[i][1], np.float32),
+                               np.asarray(docs[j][1], np.float32))
+        elif format == "listwise":
+            yield (np.asarray([[d[0]] for d in docs], np.float32),
+                   np.asarray([d[1] for d in docs], np.float32))
+        else:  # pragma: no cover — _check_format guards
+            raise ValueError(f"mq2007: unknown format {format!r}")
+
+    @staticmethod
     def reader_creator(path, format="pairwise"):
         mq2007._check_format(format)
 
         def reader():
             for _qid, docs in mq2007.query_filter(
                     mq2007.load_from_text(path)):
-                docs = sorted(docs, key=lambda d: d[0], reverse=True)
-                if format == "pointwise":
-                    for rel, vec in docs:
-                        yield rel, np.asarray(vec, np.float32)
-                elif format == "pairwise":
-                    for i in range(len(docs)):
-                        for j in range(i + 1, len(docs)):
-                            if docs[i][0] > docs[j][0]:
-                                yield (np.asarray([1], np.float32),
-                                       np.asarray(docs[i][1],
-                                                  np.float32),
-                                       np.asarray(docs[j][1],
-                                                  np.float32))
-                elif format == "listwise":
-                    yield (np.asarray([[d[0]] for d in docs],
-                                      np.float32),
-                           np.asarray([d[1] for d in docs],
-                                      np.float32))
-                else:  # pragma: no cover — _check_format guards
-                    raise ValueError(
-                        f"mq2007: unknown format {format!r}")
+                yield from mq2007._emit(docs, format)
 
         return reader
 
@@ -1334,24 +1347,9 @@ class mq2007:
                 docs = [(int(r.randint(0, 3)),
                          r.randn(mq2007.N_FEATURES).tolist())
                         for _ in range(n)]
-                docs.sort(key=lambda d: d[0], reverse=True)
-                if format == "pointwise":
-                    for rel, vec in docs:
-                        yield rel, np.asarray(vec, np.float32)
-                elif format == "pairwise":
-                    for i in range(len(docs)):
-                        for j in range(i + 1, len(docs)):
-                            if docs[i][0] > docs[j][0]:
-                                yield (np.asarray([1], np.float32),
-                                       np.asarray(docs[i][1],
-                                                  np.float32),
-                                       np.asarray(docs[j][1],
-                                                  np.float32))
-                else:
-                    yield (np.asarray([[d[0]] for d in docs],
-                                      np.float32),
-                           np.asarray([d[1] for d in docs],
-                                      np.float32))
+                if sum(d[0] for d in docs) == 0:
+                    continue  # mirror query_filter
+                yield from mq2007._emit(docs, format)
 
         return reader
 
@@ -1559,3 +1557,79 @@ class voc2012:
     @staticmethod
     def val(n=20, seed=28, data_dir=None):
         return voc2012._split("val", n, seed, data_dir)
+
+def padded_text_batches(reader, batch_size, max_len, drop_too_long=False):
+    """Adapt (word id list, label) text-classification samples
+    (sentiment / imdb) to the stacked_dynamic_lstm model feeds:
+    {words (B, max_len) int64 padded, words.seq_len (B,) int32,
+    label (B, 1) int64}.  Over-length samples truncate (or drop)."""
+
+    def gen():
+        buf = []
+        for ids, label in reader():
+            if drop_too_long and len(ids) > max_len:
+                continue
+            buf.append((ids[:max_len], label))
+            if len(buf) == batch_size:
+                words = np.zeros((batch_size, max_len), np.int64)
+                lens = np.zeros((batch_size,), np.int32)
+                lbl = np.zeros((batch_size, 1), np.int64)
+                for i, (ids_i, y) in enumerate(buf):
+                    words[i, :len(ids_i)] = ids_i
+                    lens[i] = max(1, len(ids_i))
+                    lbl[i, 0] = y
+                yield {"words": words, "words.seq_len": lens,
+                       "label": lbl}
+                buf = []
+
+    return gen
+
+
+def ngram_batches(reader, batch_size, window):
+    """Adapt imikolov NGRAM samples ((n,) id tuples, n = window + 1) to
+    the word2vec model feeds: {context_words (B, window) int64,
+    target_word (B, 1) int64} — context predicts the LAST word."""
+
+    def gen():
+        buf = []
+        for gram in reader():
+            if len(gram) != window + 1:
+                raise ValueError(
+                    f"ngram_batches(window={window}) needs "
+                    f"{window + 1}-grams, got {len(gram)}")
+            buf.append(gram)
+            if len(buf) == batch_size:
+                arr = np.asarray(buf, np.int64)
+                yield {"context_words": arr[:, :window],
+                       "target_word": arr[:, window:]}
+                buf = []
+
+    return gen
+
+def srl_batches(reader, batch_size, max_length):
+    """Adapt conll05 9-slot samples to the models/sequence_tagging SRL
+    feeds: the 6 word-feature slots + verb + mark + target, each padded
+    (B, max_length) int64 with a shared per-feature .seq_len companion.
+    Over-length sentences drop (static shapes under jit)."""
+    names = ("word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+             "verb", "mark", "target")
+
+    def gen():
+        buf = []
+        for sample in reader():
+            if len(sample[0]) > max_length:
+                continue
+            buf.append(sample)
+            if len(buf) == batch_size:
+                feed = {}
+                lens = np.asarray([len(s[0]) for s in buf], np.int32)
+                for j, name in enumerate(names):
+                    arr = np.zeros((batch_size, max_length), np.int64)
+                    for i, s in enumerate(buf):
+                        arr[i, :len(s[j])] = s[j]
+                    feed[name] = arr
+                    feed[f"{name}.seq_len"] = lens
+                yield feed
+                buf = []
+
+    return gen

@@ -188,3 +188,100 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                 yield pending[i]
 
     return reader_
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run each reader in its OWN process and interleave their samples
+    (reference: python/paddle/reader/decorator.py multiprocess_reader —
+    process count == reader count, merged through a queue or pipes).
+    Readers must be picklable (top-level functions / closures over
+    picklable state).  Samples pass through a multiprocessing.Queue
+    (use_pipe=False) or one Pipe per reader (use_pipe=True, the
+    reference default); order across readers is arrival order."""
+    import multiprocessing
+
+    if not isinstance(readers, (list, tuple)) or not readers:
+        raise ValueError("multiprocess_reader needs a non-empty list "
+                         "of readers")
+    _END = "__multiprocess_reader_end__"
+    _ERR = "__multiprocess_reader_err__"
+
+    def _work(r, emit):
+        # a crashed child must SURFACE, not masquerade as exhaustion —
+        # the parent re-raises instead of training on truncated data
+        try:
+            for sample in r():
+                emit(sample)
+            emit(_END)
+        except Exception as e:  # noqa: BLE001 — crossing processes
+            emit((_ERR, f"{type(e).__name__}: {e}"))
+
+    def _handle(item):
+        """→ ('end'|'err'|'sample', payload)."""
+        if isinstance(item, str) and item == _END:
+            return "end", None
+        if (isinstance(item, tuple) and len(item) == 2
+                and item[0] == _ERR):
+            raise RuntimeError(
+                f"multiprocess_reader: child reader failed: {item[1]}")
+        return "sample", item
+
+    def _queue_reader():
+        q = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(target=_work,
+                                         args=(r, q.put), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            kind, item = _handle(q.get())
+            if kind == "end":
+                finished += 1
+            else:
+                yield item
+        for p in procs:
+            p.join()
+
+    def _pipe_reader():
+        conns, procs = [], []
+        for r in readers:
+            parent, child = multiprocessing.Pipe(duplex=False)
+            p = multiprocessing.Process(target=_work,
+                                        args=(r, child.send),
+                                        daemon=True)
+            p.start()
+            conns.append(parent)
+            procs.append(p)
+        live = list(conns)
+        while live:
+            for conn in list(live):
+                if not conn.poll(0.01):
+                    continue
+                kind, item = _handle(conn.recv())
+                if kind == "end":
+                    live.remove(conn)
+                else:
+                    yield item
+        for p in procs:
+            p.join()
+
+    return _pipe_reader if use_pipe else _queue_reader
+
+
+class Fake:
+    """Cache the FIRST sample of a reader and replay it `data_num`
+    times (reference decorator.py:509 — frozen-feed speed testing;
+    bench.py's data_mode="frozen" is the device-side analog)."""
+
+    def __init__(self):
+        self.data = None
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            for _ in range(data_num):
+                yield self.data
+
+        return fake_reader

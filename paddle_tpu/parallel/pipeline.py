@@ -30,6 +30,15 @@ Constraints (documented, enforced):
   (transformer-block pipelines satisfy this; embed/head layers run
   outside the pipelined region),
 - stage_params is a pytree whose every leaf has leading dim S.
+
+Memory strategy: the schedule is GPipe (all-forward, then AD's
+transpose runs all-backward), NOT 1F1B.  The TPU-first answer to
+GPipe's activation footprint is REMAT, not schedule surgery: wrap
+stage_fn in jax.checkpoint (the pipeline engine does this when the
+layers carry fluid.recompute_scope tags) and the backward re-runs each
+tick's forward from its input — per-rank live activations drop to the
+O(n_micro) tick inputs, the same asymptotics 1F1B buys, traded for
+one extra forward pass of FLOPs that XLA overlaps well on the MXU.
 """
 
 from __future__ import annotations

@@ -919,3 +919,141 @@ def test_flowers_real_format_parses(tmp_path):
     assert lbl == 4  # 1-based label 5 -> 0-based 4
     test = list(dataset.flowers.test(data_dir=d)())
     assert len(test) == 1 and test[0][1] == 2
+
+
+def test_sentiment_model_trains_from_movie_reviews_files(tmp_path):
+    """The sentiment book model (stacked dynamic LSTM) trains from a
+    real-format movie_reviews directory end to end."""
+    import paddle_tpu as fluid
+    from paddle_tpu.data import dataset
+    from paddle_tpu.models import stacked_dynamic_lstm
+
+    d = str(tmp_path)
+    _write_sentiment_fixture(d)
+    vocab = len(dataset.sentiment.get_word_dict(data_dir=d))
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        model = stacked_dynamic_lstm.build_model(
+            vocab_size=vocab, emb_dim=16, hidden_dim=16,
+            stacked_num=2, max_len=8, learning_rate=5e-2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        batches = dataset.padded_text_batches(
+            dataset.sentiment.reader_creator(d, is_test=False),
+            batch_size=2, max_len=8)
+        losses = []
+        for _ in range(10):
+            for feed in batches():
+                (lv,) = exe.run(main, feed=feed,
+                                fetch_list=[model["loss"]])
+                losses.append(float(np.ravel(lv)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_word2vec_trains_from_imikolov_files(tmp_path):
+    """The word2vec book model trains from real-format PTB files."""
+    import paddle_tpu as fluid
+    from paddle_tpu.data import dataset
+    from paddle_tpu.models import word2vec
+
+    d = str(tmp_path)
+    _write_imikolov_fixture(d)
+    wd = dataset.imikolov.build_dict(min_word_freq=0, data_dir=d)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        model = word2vec.build_model(
+            dict_size=len(wd), embed_dim=8, hidden_dim=16, window=2,
+            batch_size=4, use_nce=False, learning_rate=5e-2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        batches = dataset.ngram_batches(
+            dataset.imikolov.train(wd, n=3, data_dir=d),
+            batch_size=4, window=2)
+        losses = []
+        for _ in range(15):
+            for feed in batches():
+                (lv,) = exe.run(main, feed=feed,
+                                fetch_list=[model["loss"]])
+                losses.append(float(np.ravel(lv)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_srl_model_trains_from_conll05_files(tmp_path):
+    """The SRL book model (db_lstm + CRF) trains from real-format
+    conll05 files end to end."""
+    import paddle_tpu as fluid
+    from paddle_tpu.data import dataset
+    from paddle_tpu.models import sequence_tagging
+
+    d = str(tmp_path)
+    _write_conll05_fixture(d)
+    wd, vd, ld = dataset.conll05.get_dict(d)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        model = sequence_tagging.build_model(
+            word_dict_len=len(wd), label_dict_len=len(ld),
+            pred_dict_len=len(vd), max_length=8, word_dim=8,
+            hidden_dim=8, depth=2, learning_rate=0.05)
+        exe = fluid.Executor()
+        exe.run(startup)
+        batches = dataset.srl_batches(
+            dataset.conll05.test(data_dir=d), batch_size=3,
+            max_length=8)
+        losses = []
+        for _ in range(12):
+            for feed in batches():
+                (lv,) = exe.run(main, feed=feed,
+                                fetch_list=[model["loss"]])
+                losses.append(float(np.ravel(lv)[0]))
+    assert losses, "fixture produced no full batch"
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def _mp_range_reader_a():
+    yield from range(0, 5)
+
+
+def _mp_range_reader_b():
+    yield from range(100, 103)
+
+
+def test_multiprocess_reader_merges_both_modes():
+    """multiprocess_reader: one process per reader, samples merged
+    (pipe and queue transports)."""
+    from paddle_tpu.data import multiprocess_reader
+
+    for use_pipe in (True, False):
+        got = sorted(multiprocess_reader(
+            [_mp_range_reader_a, _mp_range_reader_b],
+            use_pipe=use_pipe)())
+        assert got == [0, 1, 2, 3, 4, 100, 101, 102], (use_pipe, got)
+    with pytest.raises(ValueError):
+        multiprocess_reader([])
+
+
+def _mp_crashing_reader():
+    yield 1
+    raise IOError("corrupt shard")
+
+
+def test_multiprocess_reader_surfaces_child_crash():
+    """A crashed child must raise in the parent, not masquerade as
+    normal exhaustion (silently truncated data)."""
+    from paddle_tpu.data import multiprocess_reader
+
+    for use_pipe in (True, False):
+        with pytest.raises(RuntimeError, match="corrupt shard"):
+            list(multiprocess_reader([_mp_crashing_reader],
+                                     use_pipe=use_pipe)())
