@@ -584,6 +584,10 @@ def main():
                    help="transformer: route flash attention through "
                         "the tiled Pallas kernel instead of the XLA "
                         "composition (A/B candidate)")
+    p.add_argument("--xla-attn", action="store_true",
+                   help="longctx: force the XLA flash composition "
+                        "instead of the Pallas kernel (the longctx "
+                        "default is Pallas; this is its A/B twin)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of each timed "
                         "window into DIR (feeds the MFU-gap analysis)")
@@ -727,7 +731,8 @@ def main():
         _run("longctx_8k", bench_transformer,
              args.batch or 2, max(args.steps // 4, 3), 1,
              max_length=args.seq or 8192, use_amp=amp, use_flash=True,
-             use_fused_ce=True, flash_pallas=True, recompute=True)
+             use_fused_ce=True, flash_pallas=not args.xla_attn,
+             recompute=True)
 
     # headline = min MFU across the two NORTH-STAR models (BASELINE.json
     # names ResNet-50 + Transformer for the >=35% bar); bert/lstm/deepfm

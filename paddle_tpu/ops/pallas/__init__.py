@@ -4,3 +4,28 @@ Analog of the reference's hand-written CUDA kernels and JIT codegen tier
 (operators/math/*.cu, operators/jit/ xbyak codegen, SURVEY.md §2.2): ops
 whose fusion XLA can't do on its own get tiled Pallas implementations.
 """
+
+import contextlib
+
+# tests/test_pallas_lowering.py exports these kernels with
+# jax.export(platforms=["tpu"]) FROM a CPU host to validate the
+# Pallas->Mosaic lowering without a chip.  The interpret gate resolves
+# from the CURRENT backend at trace time, so without an override the
+# export would serialize the interpreter path and the check would be
+# vacuous.
+_force_mosaic = [False]
+
+
+def mosaic_forced() -> bool:
+    return _force_mosaic[0]
+
+
+@contextlib.contextmanager
+def force_mosaic_lowering():
+    """Force interpret=False regardless of backend, so a cross-platform
+    jax.export actually runs the Mosaic lowering rules."""
+    _force_mosaic[0] = True
+    try:
+        yield
+    finally:
+        _force_mosaic[0] = False

@@ -41,7 +41,12 @@ NEG_INF = -1e30
 def _interpret() -> bool:
     """Pallas kernels compile only on TPU; on the CPU backend (tests,
     virtual meshes) run them through the Pallas interpreter so the same
-    code path is exercised everywhere."""
+    code path is exercised everywhere.  force_mosaic_lowering()
+    overrides for cross-platform jax.export TPU-lowering checks."""
+    from . import mosaic_forced
+
+    if mosaic_forced():
+        return False
     return jax.default_backend() != "tpu"
 
 
@@ -268,7 +273,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p, ds = _bwd_p_ds(
             q.astype(jnp.float32), k.astype(jnp.float32),
             v.astype(jnp.float32), do.astype(jnp.float32),
-            lse_ref[0][None, :], delta_ref[0][None, :], bias_col,
+            lse_ref[0, 0][None, :], delta_ref[0, 0][None, :], bias_col,
             q_off, k_off, scale=scale, causal=causal, kb=kb, qb=qb,
             block_q=block_q, block_k=block_k, t_q=t_q, t_k=t_k)
         dv_scr[:] += jax.lax.dot_general(
@@ -316,7 +321,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, ds = _bwd_p_ds(
             q.astype(jnp.float32), k.astype(jnp.float32),
             v.astype(jnp.float32), do.astype(jnp.float32),
-            lse_ref[0][None, :], delta_ref[0][None, :], bias_col,
+            lse_ref[0, 0][None, :], delta_ref[0, 0][None, :], bias_col,
             q_off, k_off, scale=scale, causal=causal, kb=kb, qb=qb,
             block_q=block_q, block_k=block_k, t_q=t_q, t_k=t_k)
         # dq[q,d] = scale * sum_k ds[k,q] * k[k,d]
@@ -348,6 +353,13 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
                     axis=-1)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
+    # lse/delta enter the kernels replicated over 8 sublanes —
+    # (nh, 8, t_q) with (1, 8, block_q) blocks — because Mosaic rejects
+    # a (1, block_q) block on a (nh, t_q) array (sublane dim must be
+    # 8-divisible or full; the fwd's lse OUTPUT uses the same layout)
+    lse8 = jnp.broadcast_to(lse.astype(jnp.float32)[:, None, :],
+                            (nh, 8, t_q))
+    delta8 = jnp.broadcast_to(delta[:, None, :], (nh, 8, t_q))
     # bias arrives (nh, 1, 1, t_k); kernels want it as a (block_k, 1)
     # column so it broadcasts over the lane (q) dimension
     bias_t = None if bias is None else bias.reshape(nh, t_k, 1)
@@ -359,20 +371,20 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
         if order == "kq":
             qi = lambda h, a, b: (h, b, 0)     # noqa: E731
             ki = lambda h, a, b: (h, a, 0)     # noqa: E731
-            vi = lambda h, a, b: (h, b)        # noqa: E731  (lse/delta by q)
+            vi = lambda h, a, b: (h, 0, b)     # noqa: E731  (lse/delta by q)
             bi = lambda h, a, b: (h, a, 0)     # noqa: E731  (bias by k)
         else:
             qi = lambda h, a, b: (h, a, 0)     # noqa: E731
             ki = lambda h, a, b: (h, b, 0)     # noqa: E731
-            vi = lambda h, a, b: (h, a)        # noqa: E731
+            vi = lambda h, a, b: (h, 0, a)     # noqa: E731
             bi = lambda h, a, b: (h, b, 0)     # noqa: E731
         sp = [
             pl.BlockSpec((1, block_q, d), qi),
             pl.BlockSpec((1, block_k, d), ki),
             pl.BlockSpec((1, block_k, d), ki),
             pl.BlockSpec((1, block_q, d), qi),
-            pl.BlockSpec((1, block_q), vi),
-            pl.BlockSpec((1, block_q), vi),
+            pl.BlockSpec((1, 8, block_q), vi),
+            pl.BlockSpec((1, 8, block_q), vi),
         ]
         if has_bias:
             sp.append(pl.BlockSpec((1, block_k, 1), bi))
@@ -380,7 +392,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
             sp.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return sp
 
-    args = [q, k, v, do, lse, delta]
+    args = [q, k, v, do, lse8, delta8]
     if has_bias:
         args.append(bias_t)
     if has_offs:

@@ -35,6 +35,10 @@ DEFAULT_BLOCK_V = 2048
 
 
 def _interpret() -> bool:
+    from . import mosaic_forced
+
+    if mosaic_forced():
+        return False
     return jax.default_backend() != "tpu"
 
 

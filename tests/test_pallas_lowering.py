@@ -1,0 +1,82 @@
+"""Cross-platform TPU lowering of the Pallas kernels.
+
+The CPU test suite exercises these kernels through the Pallas
+INTERPRETER, which proves numerics but not that the kernel IR lowers
+for the real TPU target (r4 finding: interpreter != Mosaic).
+jax.export with platforms=["tpu"] runs the actual Pallas->Mosaic
+lowering rules on any host, so block-spec/primitive errors surface
+here instead of on the first chip contact.  (The Mosaic->LLO compile
+itself still happens on hardware — this pins everything before it.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _export_tpu(fn, *args):
+    """Export for the TPU target with the interpret gate overridden —
+    otherwise the CPU host would serialize the INTERPRETER path and
+    the check would be vacuous."""
+    from paddle_tpu.ops.pallas import force_mosaic_lowering
+
+    with force_mosaic_lowering():
+        exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    # prove the Mosaic custom call is actually in the artifact
+    mlir = exp.mlir_module()
+    assert "tpu_custom_call" in mlir, \
+        "export did not contain the Mosaic kernel (interpreter path?)"
+    return exp
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(2, 4, 256, 64), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def test_flash_attention_fwd_lowers_for_tpu(qkv):
+    from paddle_tpu.ops.pallas.flash_attention import \
+        pallas_flash_attention
+
+    q, k, v = qkv
+    exp = _export_tpu(
+        lambda q, k, v: pallas_flash_attention(q, k, v, None, 0.125,
+                                               True), q, k, v)
+    assert len(exp.mlir_module_serialized) > 0
+    assert "tpu" in exp.platforms
+
+
+def test_flash_attention_bwd_lowers_for_tpu(qkv):
+    from paddle_tpu.ops.pallas.flash_attention import \
+        pallas_flash_attention
+
+    q, k, v = qkv
+
+    def loss(q, k, v):
+        return jnp.sum(
+            pallas_flash_attention(q, k, v, None, 0.125, True) ** 2)
+
+    exp = _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_vocab_ce_fwd_and_bwd_lower_for_tpu():
+    from paddle_tpu.ops.pallas.vocab_ce import fused_vocab_ce
+
+    rng = np.random.RandomState(1)
+    h = jnp.asarray(rng.randn(8, 128, 256), jnp.float32)
+    w = jnp.asarray(rng.randn(256, 4096) * 0.02, jnp.float32)
+    lbl = jnp.asarray(rng.randint(0, 4096, (8, 128)), jnp.int32)
+
+    def loss(h, w):
+        return jnp.sum(fused_vocab_ce(h, w, lbl, 0.1, 1024, 2048))
+
+    assert len(_export_tpu(loss, h, w).mlir_module_serialized) > 0
+    assert len(_export_tpu(jax.grad(loss, argnums=(0, 1)), h,
+                           w).mlir_module_serialized) > 0

@@ -1,9 +1,9 @@
 """A/B benchmark driver (VERDICT r3 item 1b): run bench.py once per
 perf-feature configuration on the real chip and write a combined
-AB_r04.json artifact with the winners, so every bench default reflects a
+AB_r05.json artifact with the winners, so every bench default reflects a
 measured win.
 
-Usage: python tools/run_ab.py [--steps N] [--out AB_r04.json]
+Usage: python tools/run_ab.py [--steps N] [--out AB_r05.json]
 Each variant is a separate bench.py subprocess (fresh backend, no cache
 cross-talk); the probe inside bench.py keeps a dead backend from
 burning the timeout.
@@ -29,6 +29,12 @@ VARIANTS = [
                                 "--fused-qkv"]),
     ("transformer_pallas_attn", ["--model", "transformer",
                                  "--pallas-attn"]),
+    # long-context (VERDICT r4 item 7): Pallas flash (self+cross) +
+    # fused-CE + recompute is the default longctx stack; the xla twin
+    # runs the same shape through the XLA flash composition to check
+    # the kernel actually pays at 8k
+    ("longctx_8k_pallas", ["--model", "longctx"]),
+    ("longctx_8k_xla", ["--model", "longctx", "--xla-attn"]),
 ]
 
 
@@ -60,7 +66,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r04.json")
+    p.add_argument("--out", default="AB_r05.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     args = p.parse_args()
@@ -88,6 +94,8 @@ def main():
         > (mfu("transformer_base") or 0),
         "pallas_attn_wins": (mfu("transformer_pallas_attn") or 0)
         > (mfu("transformer_base") or 0),
+        "longctx_pallas_wins": (mfu("longctx_8k_pallas") or 0)
+        > (mfu("longctx_8k_xla") or 0),
     }
     results["summary"] = summary
     with open(args.out, "w") as f:
