@@ -29,3 +29,22 @@ def force_mosaic_lowering():
         yield
     finally:
         _force_mosaic[0] = False
+
+
+def interpret() -> bool:
+    """Pallas kernels compile only on TPU; on the CPU backend (tests,
+    virtual meshes) they run through the Pallas interpreter so the same
+    code path is exercised everywhere.  force_mosaic_lowering()
+    overrides for cross-platform jax.export TPU-lowering checks."""
+    import jax
+
+    if _force_mosaic[0]:
+        return False
+    return jax.default_backend() != "tpu"
+
+
+def pallas_call(*args, **kw):
+    """pl.pallas_call with the shared interpret gate applied."""
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(*args, interpret=interpret(), **kw)

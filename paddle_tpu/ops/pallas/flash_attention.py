@@ -38,22 +38,10 @@ DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
-def _interpret() -> bool:
-    """Pallas kernels compile only on TPU; on the CPU backend (tests,
-    virtual meshes) run them through the Pallas interpreter so the same
-    code path is exercised everywhere.  force_mosaic_lowering()
-    overrides for cross-platform jax.export TPU-lowering checks."""
-    from . import mosaic_forced
-
-    if mosaic_forced():
-        return False
-    return jax.default_backend() != "tpu"
-
-
 def _pallas_call(*args, **kw):
-    from jax.experimental import pallas as pl
+    from . import pallas_call  # shared interpret gate (package init)
 
-    return pl.pallas_call(*args, interpret=_interpret(), **kw)
+    return pallas_call(*args, **kw)
 
 
 def _offs(offs_ref):

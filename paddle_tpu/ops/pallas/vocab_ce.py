@@ -34,18 +34,10 @@ DEFAULT_BLOCK_T = 1024
 DEFAULT_BLOCK_V = 2048
 
 
-def _interpret() -> bool:
-    from . import mosaic_forced
-
-    if mosaic_forced():
-        return False
-    return jax.default_backend() != "tpu"
-
-
 def _pallas_call(*args, **kw):
-    from jax.experimental import pallas as pl
+    from . import pallas_call  # shared interpret gate (package init)
 
-    return pl.pallas_call(*args, interpret=_interpret(), **kw)
+    return pallas_call(*args, **kw)
 
 
 def _z_block(h_ref, w_ref, vb, block_v, n_valid_v):

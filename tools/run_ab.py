@@ -85,17 +85,24 @@ def main():
         d = results.get(k, {})
         return d.get("value") if "error" not in d else None
 
+    def wins(a, b):
+        # a missing side must yield "no data", never a vacuous win —
+        # AB wins gate bench defaults (CLAUDE.md measured-wins-only)
+        ma, mb = mfu(a), mfu(b)
+        if ma is None or mb is None:
+            return None
+        return ma > mb
+
     summary = {
-        "nhwc_wins": (mfu("resnet50_nhwc") or 0)
-        > (mfu("resnet50_nchw") or 0),
-        "fused_ce_wins": (mfu("transformer_fused_ce") or 0)
-        > (mfu("transformer_base") or 0),
-        "fused_qkv_wins": (mfu("transformer_fused_qkv") or 0)
-        > (mfu("transformer_base") or 0),
-        "pallas_attn_wins": (mfu("transformer_pallas_attn") or 0)
-        > (mfu("transformer_base") or 0),
-        "longctx_pallas_wins": (mfu("longctx_8k_pallas") or 0)
-        > (mfu("longctx_8k_xla") or 0),
+        "nhwc_wins": wins("resnet50_nhwc", "resnet50_nchw"),
+        "fused_ce_wins": wins("transformer_fused_ce",
+                              "transformer_base"),
+        "fused_qkv_wins": wins("transformer_fused_qkv",
+                               "transformer_base"),
+        "pallas_attn_wins": wins("transformer_pallas_attn",
+                                 "transformer_base"),
+        "longctx_pallas_wins": wins("longctx_8k_pallas",
+                                    "longctx_8k_xla"),
     }
     results["summary"] = summary
     with open(args.out, "w") as f:
