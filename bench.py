@@ -199,16 +199,31 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
          "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)})
 
 
-def _dense_equiv_flops(feed, build_no_flash):
+def _dense_equiv_flops(feed, build_no_flash, platform=None):
     """Flop count for a flash-attention program: XLA cost analysis of
     the SAME model compiled WITHOUT the Pallas kernel (custom calls
     report zero flops; the dense composition is the logical-math
-    equivalent the flash kernel computes)."""
+    equivalent the flash kernel computes).
+
+    platform="cpu" compiles the twin for CPU instead of the chip: at
+    long sequence the dense twin CANNOT exist on the TPU (seq 8k needs
+    a 73 GB dense-score program — XLA:TPU refuses at compile time,
+    which is the whole point of flash).  Flop counts are a property of
+    the HLO, not the backend; the dominant dot flops are identical
+    (cpu-vs-tpu twin parity is checked at seq 256 by
+    tools/check_twin_flops.py)."""
+    import contextlib
+
+    import jax
+
     import paddle_tpu as fluid
 
+    ctx = (jax.default_device(jax.devices(platform)[0]) if platform
+           else contextlib.nullcontext())
     main2, startup2 = fluid.Program(), fluid.Program()
     scope2 = fluid.Scope()
-    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+    with ctx, fluid.program_guard(main2, startup2), \
+            fluid.scope_guard(scope2):
         model2 = build_no_flash()
         exe2 = fluid.Executor()
         exe2.run(startup2)
@@ -258,7 +273,8 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
             # algorithmic flop count
             step_flops = _dense_equiv_flops(
                 feed, lambda: build(False, fused_ce=False, fq=False,
-                                    pallas=False, rc=False))
+                                    pallas=False, rc=False),
+                platform="cpu" if max_length > 1024 else None)
         else:
             cost = exe.cost_analysis(main, feed=feed,
                                      fetch_list=[model["loss"]])
@@ -274,7 +290,8 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "flash_pallas": flash_pallas, "fused_ce": use_fused_ce,
          "fused_qkv": fused_qkv, "moe_experts": moe_experts,
          "recompute": recompute,
-         "flop_count": ("dense-equivalent"
+         "flop_count": (("dense-equivalent(cpu-twin)"
+                         if max_length > 1024 else "dense-equivalent")
                         if ((use_flash and flash_pallas)
                             or use_fused_ce or recompute) else "xla"),
          "last_loss": last_loss})

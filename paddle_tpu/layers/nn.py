@@ -1521,18 +1521,25 @@ def positive_negative_pair(score, label, query_id, weight=None, column=-1,
 
 
 def fused_vocab_softmax_ce(hidden, weight, label, epsilon=0.0,
-                           use_pallas=False, block_t=1024, block_v=2048,
+                           use_pallas=False, block_t=None, block_v=None,
                            name=None):
     """Per-token label-smoothed CE of `hidden @ weight` computed WITHOUT
     materializing the (tokens, vocab) logits (ops/pallas/vocab_ce.py) —
     the fused big-vocab loss for NMT/LM heads.  hidden (..., D), weight
-    (D, V) parameter, label int ids with hidden's leading shape."""
+    (D, V) parameter, label int ids with hidden's leading shape.
+    block_t/block_v default to the kernel module's VMEM-budgeted
+    defaults (ops/pallas/vocab_ce.py DEFAULT_BLOCK_*); override only
+    with a measured win."""
     helper = LayerHelper("fused_vocab_softmax_ce", name=name)
     loss = helper.create_variable_for_type_inference("float32")
+    attrs = {"epsilon": float(epsilon), "use_pallas": bool(use_pallas)}
+    if block_t is not None:
+        attrs["block_t"] = int(block_t)
+    if block_v is not None:
+        attrs["block_v"] = int(block_v)
     helper.append_op(
         type="fused_vocab_softmax_ce",
         inputs={"Hidden": [hidden], "W": [weight], "Label": [label]},
         outputs={"Loss": [loss]},
-        attrs={"epsilon": float(epsilon), "use_pallas": bool(use_pallas),
-               "block_t": int(block_t), "block_v": int(block_v)})
+        attrs=attrs)
     return loss

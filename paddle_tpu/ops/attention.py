@@ -138,12 +138,17 @@ def fused_vocab_softmax_ce(ctx, ins, attrs):
     labels = first(ins, "Label")
     eps = float(attrs.get("epsilon", 0.0))
     if attrs.get("use_pallas", False):
-        from .pallas.vocab_ce import fused_vocab_ce
+        from .pallas.vocab_ce import (DEFAULT_BLOCK_T, DEFAULT_BLOCK_V,
+                                      fused_vocab_ce)
 
+        # fall back to the kernel module's defaults — they encode the
+        # measured on-chip VMEM budget (r05: a stale 1024/2048 fallback
+        # here kept overriding the retuned defaults and every compile
+        # failed identically)
         loss = fused_vocab_ce(
             hidden, w, labels, eps,
-            int(attrs.get("block_t", 1024)),
-            int(attrs.get("block_v", 2048)))
+            int(attrs.get("block_t", DEFAULT_BLOCK_T)),
+            int(attrs.get("block_v", DEFAULT_BLOCK_V)))
     else:
         v = w.shape[1]
         z = (hidden @ w).astype(jnp.float32)

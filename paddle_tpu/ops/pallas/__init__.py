@@ -16,10 +16,6 @@ import contextlib
 _force_mosaic = [False]
 
 
-def mosaic_forced() -> bool:
-    return _force_mosaic[0]
-
-
 @contextlib.contextmanager
 def force_mosaic_lowering():
     """Force interpret=False regardless of backend, so a cross-platform

@@ -30,8 +30,17 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG = -1e30
-DEFAULT_BLOCK_T = 1024
-DEFAULT_BLOCK_V = 2048
+# VMEM budget (~16 MB/core on v5e): the kernels hold the in-flight
+# f32 logits tile (block_t, block_v) AND ~6-8 elementwise/iota/mask
+# intermediates of the same shape ON STACK (Mosaic gives each op its
+# own slot), plus double-buffered h/W input blocks.  Real-chip compile
+# evidence (r05 A/B run): (1024, 2048) overflowed VMEM by tens of MB;
+# (512, 512) still overflowed by 3.84 MB (~20 MB working set);
+# (256, 512) fits.  block_t is the W-streaming amortizer (full W is
+# re-read once per token block), so raise block_t before block_v when
+# retuning on a bigger-VMEM part.
+DEFAULT_BLOCK_T = 256
+DEFAULT_BLOCK_V = 512
 
 
 def _pallas_call(*args, **kw):
@@ -86,9 +95,18 @@ def _fwd_kernel(h_ref, w_ref, lbl_ref, lse_ref, zt_ref, zsum_ref,
 
     @pl.when(vb == nv - 1)
     def _fin():
-        lse_ref[...] = (m_scr[:] + jnp.log(s_scr[:]))[:, 0][None, :]
-        zt_ref[...] = zt_scr[:][:, 0][None, :]
-        zsum_ref[...] = zsum_scr[:][:, 0][None, :]
+        # stats replicated over 8 sublanes (same trick as
+        # flash_attention's lse): a (1, n) output would carry a
+        # degenerate T(1,128) sublane-1 layout that XLA:TPU stack-
+        # allocates in scoped VMEM with 8x tile padding — the r05
+        # on-chip compile failed with a scoped-vmem OOM on exactly
+        # those three output buffers, at ANY block size
+        lse = (m_scr[:] + jnp.log(s_scr[:]))[:, 0][None, :]
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+        zt_ref[...] = jnp.broadcast_to(zt_scr[:][:, 0][None, :],
+                                       zt_ref.shape)
+        zsum_ref[...] = jnp.broadcast_to(zsum_scr[:][:, 0][None, :],
+                                         zsum_ref.shape)
 
 
 def _dz_block(h_ref, w_ref, lbl_ref, lse_ref, g_ref, tb, vb, *,
@@ -184,14 +202,14 @@ def _fwd(h, w, labels, block_t, block_v):
             pl.BlockSpec((1, block_t), lambda t, vb: (0, t)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_t), lambda t, vb: (0, t)),
-            pl.BlockSpec((1, block_t), lambda t, vb: (0, t)),
-            pl.BlockSpec((1, block_t), lambda t, vb: (0, t)),
+            pl.BlockSpec((8, block_t), lambda t, vb: (0, t)),
+            pl.BlockSpec((8, block_t), lambda t, vb: (0, t)),
+            pl.BlockSpec((8, block_t), lambda t, vb: (0, t)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, n), jnp.float32),
-            jax.ShapeDtypeStruct((1, n), jnp.float32),
-            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((block_t, 1), jnp.float32)] * 4,
     )(h, w, labels.astype(jnp.int32).reshape(1, -1))

@@ -72,6 +72,12 @@ def main():
     args = p.parse_args()
 
     results = {}
+    if args.only and os.path.exists(args.out):
+        # selective re-run (post-fix retest): keep the other variants'
+        # recorded entries, replace only the re-run ones
+        with open(args.out) as f:
+            results = {k: v for k, v in json.load(f).items()
+                       if k != "summary"}
     for key, extra in VARIANTS:
         if args.only and key not in args.only.split(","):
             continue
@@ -82,8 +88,14 @@ def main():
             json.dump(results, f, indent=1)
 
     def mfu(k):
+        # a failed bench prints {"metric": "bench_failed", "value": 0.0}
+        # (and run_variant itself may record {"error": ...}): both are
+        # NO DATA, never a 0.0 that hands the other side a vacuous win
         d = results.get(k, {})
-        return d.get("value") if "error" not in d else None
+        if "error" in d or "failed" in d or \
+                d.get("metric") == "bench_failed":
+            return None
+        return d.get("value")
 
     def wins(a, b):
         # a missing side must yield "no data", never a vacuous win —
