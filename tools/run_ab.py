@@ -34,7 +34,22 @@ VARIANTS = [
     # runs the same shape through the XLA flash composition to check
     # the kernel actually pays at 8k
     ("longctx_8k_pallas", ["--model", "longctx"]),
-    ("longctx_8k_xla", ["--model", "longctx", "--xla-attn"]),
+    # the XLA flash composition CANNOT fit 8k without remat (r05 chip:
+    # 38.45G HBM needed, jax AD keeps per-layer attention residuals the
+    # Pallas kernel's custom VJP recomputes from lse) — so the xla side
+    # runs its best VIABLE config (with recompute); the pallas side
+    # runs its own best (without).  Backend-best vs backend-best.
+    ("longctx_8k_xla", ["--model", "longctx", "--xla-attn",
+                        "--recompute"]),
+    # the longctx default flipped to no-recompute after this A/B
+    # measured 0.3035 vs 0.2405 (bs2/8k fits without remat); the
+    # recompute variant stays recorded for the memory-constrained case
+    ("longctx_8k_recompute", ["--model", "longctx", "--recompute"]),
+    # shape probes (r05 chip session): both LOSE to the defaults
+    # (bs4 longctx 0.2322 vs 0.2405; bs128 transformer 0.3046 vs
+    # 0.3254 — bs64/len256 confirmed as the sweet spot)
+    ("longctx_8k_bs4", ["--model", "longctx", "--batch", "4"]),
+    ("transformer_bs128", ["--model", "transformer", "--batch", "128"]),
 ]
 
 
