@@ -584,9 +584,20 @@ def main():
                    choices=["NCHW", "NHWC"],
                    help="resnet50 conv stack layout (NHWC = TPU "
                         "channels-last)")
-    p.add_argument("--fused-ce", action="store_true",
+    p.add_argument("--fused-ce", dest="fused_ce", action="store_true",
+                   default=False,
                    help="transformer: fused vocab projection+CE Pallas "
-                        "kernel (ops/pallas/vocab_ce.py)")
+                        "kernel (ops/pallas/vocab_ce.py).  Default OFF "
+                        "at len256: its reported MFU (0.3289, dense-"
+                        "equivalent numerator) exceeds base but WALL "
+                        "CLOCK loses 154.0k vs 157.1k tok/s "
+                        "(AB_r05.json) — throughput decides; the "
+                        "kernel pays at 8k where it is the longctx "
+                        "default")
+    p.add_argument("--no-fused-ce", dest="fused_ce",
+                   action="store_false",
+                   help="transformer: explicitly disable the fused "
+                        "vocab-CE kernel (the default)")
     p.add_argument("--fused-qkv", action="store_true",
                    help="transformer: Megatron-style single fused QKV "
                         "projection in self-attention")

@@ -30,17 +30,21 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG = -1e30
-# VMEM budget (~16 MB/core on v5e): the kernels hold the in-flight
-# f32 logits tile (block_t, block_v) AND ~6-8 elementwise/iota/mask
-# intermediates of the same shape ON STACK (Mosaic gives each op its
-# own slot), plus double-buffered h/W input blocks.  Real-chip compile
-# evidence (r05 A/B run): (1024, 2048) overflowed VMEM by tens of MB;
-# (512, 512) still overflowed by 3.84 MB (~20 MB working set);
-# (256, 512) fits.  block_t is the W-streaming amortizer (full W is
-# re-read once per token block), so raise block_t before block_v when
-# retuning on a bigger-VMEM part.
-DEFAULT_BLOCK_T = 256
-DEFAULT_BLOCK_V = 512
+# Block defaults — tuned ON the chip (r05, v5e, 32k vocab).  Two
+# separate VMEM constraints bit here:
+# 1. The (1, N) stat OUTPUTS (not the tiles) caused the original
+#    compile failures at every block size — a degenerate sublane-1
+#    layout that XLA stack-allocates in scoped VMEM ("exceeded scoped
+#    vmem limit by 3.84M" regardless of blocks).  Fixed by the
+#    8-sublane-replicated output layout in _fwd_kernel/_fwd.
+# 2. The f32 logits tile + its mask/exp stack intermediates bound the
+#    block product: (1024, 1024) and up fail Mosaic; (512, 1024)
+#    compiles and measured fastest — bench sweep on chip:
+#    (256,512) 0.3146 MFU < (512,512) 0.3204 ~ (1024,512) 0.3205
+#    < (512,1024) 0.3250 (ties the unfused baseline at len256 and
+#    beats it as part of the longctx stack: 0.3035 -> 0.3076).
+DEFAULT_BLOCK_T = 512
+DEFAULT_BLOCK_V = 1024
 
 
 def _pallas_call(*args, **kw):

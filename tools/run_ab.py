@@ -22,13 +22,20 @@ VARIANTS = [
     # (key, argv fragment)
     ("resnet50_nchw", ["--model", "resnet50", "--layout", "NCHW"]),
     ("resnet50_nhwc", ["--model", "resnet50", "--layout", "NHWC"]),
-    ("transformer_base", ["--model", "transformer"]),
+    # flags are explicit on both sides so the variant set stays
+    # meaningful if a default ever flips.  NOTE the r05 lesson baked
+    # into wins(): fused-CE's higher MFU at len256 was a NUMERATOR
+    # artifact (dense-equivalent twin vs the base program's own XLA
+    # count) while wall-clock lost — wins() therefore compares
+    # throughput, which is numerator-free.
+    ("transformer_base", ["--model", "transformer", "--no-fused-ce"]),
     ("transformer_fused_ce", ["--model", "transformer", "--fused-ce"]),
-    ("transformer_fused_qkv", ["--model", "transformer", "--fused-qkv"]),
+    ("transformer_fused_qkv", ["--model", "transformer", "--fused-qkv",
+                               "--no-fused-ce"]),
     ("transformer_fused_both", ["--model", "transformer", "--fused-ce",
                                 "--fused-qkv"]),
     ("transformer_pallas_attn", ["--model", "transformer",
-                                 "--pallas-attn"]),
+                                 "--pallas-attn", "--no-fused-ce"]),
     # long-context (VERDICT r4 item 7): Pallas flash (self+cross) +
     # fused-CE + recompute is the default longctx stack; the xla twin
     # runs the same shape through the XLA flash composition to check
@@ -102,20 +109,31 @@ def main():
         with open(args.out, "w") as f:
             json.dump(results, f, indent=1)
 
-    def mfu(k):
+    def measure(k):
         # a failed bench prints {"metric": "bench_failed", "value": 0.0}
         # (and run_variant itself may record {"error": ...}): both are
-        # NO DATA, never a 0.0 that hands the other side a vacuous win
+        # NO DATA, never a 0.0 that hands the other side a vacuous win.
+        # Prefer THROUGHPUT over MFU: variants can carry different MFU
+        # numerators (program's own XLA count vs dense-equivalent twin
+        # for Pallas/remat configs), and the r05 chip session caught
+        # fused-CE "winning" on MFU while losing wall-clock.  tok/s and
+        # img/s are numerator-free.
         d = results.get(k, {})
         if "error" in d or "failed" in d or \
                 d.get("metric") == "bench_failed":
             return None
+        for sub in (d.get("detail") or {}).values():
+            if isinstance(sub, dict):
+                for key in ("tokens_per_sec", "imgs_per_sec",
+                            "examples_per_sec"):
+                    if key in sub:
+                        return sub[key]
         return d.get("value")
 
     def wins(a, b):
         # a missing side must yield "no data", never a vacuous win —
         # AB wins gate bench defaults (CLAUDE.md measured-wins-only)
-        ma, mb = mfu(a), mfu(b)
+        ma, mb = measure(a), measure(b)
         if ma is None or mb is None:
             return None
         return ma > mb
