@@ -57,6 +57,10 @@ VARIANTS = [
     # 0.3254 — bs64/len256 confirmed as the sweet spot)
     ("longctx_8k_bs4", ["--model", "longctx", "--batch", "4"]),
     ("transformer_bs128", ["--model", "transformer", "--batch", "128"]),
+    # scaling proof: 16k tokens on ONE chip, MFU RISES with T (flash
+    # fraction grows; dense attention stopped existing back at 8k)
+    ("longctx_16k_bs1", ["--model", "longctx", "--seq", "16384",
+                         "--batch", "1"]),
 ]
 
 
