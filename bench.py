@@ -585,19 +585,20 @@ def main():
                    help="resnet50 conv stack layout (NHWC = TPU "
                         "channels-last)")
     p.add_argument("--fused-ce", dest="fused_ce", action="store_true",
-                   default=False,
+                   default=None,
                    help="transformer: fused vocab projection+CE Pallas "
                         "kernel (ops/pallas/vocab_ce.py).  Default OFF "
                         "at len256: its reported MFU (0.3289, dense-"
                         "equivalent numerator) exceeds base but WALL "
                         "CLOCK loses 154.0k vs 157.1k tok/s "
                         "(AB_r05.json) — throughput decides; the "
-                        "kernel pays at 8k where it is the longctx "
-                        "default")
+                        "kernel pays at 8k where it defaults ON "
+                        "(longctx)")
     p.add_argument("--no-fused-ce", dest="fused_ce",
                    action="store_false",
-                   help="transformer: explicitly disable the fused "
-                        "vocab-CE kernel (the default)")
+                   help="disable the fused vocab-CE kernel everywhere "
+                        "(incl. the longctx model, where it is "
+                        "otherwise the default)")
     p.add_argument("--fused-qkv", action="store_true",
                    help="transformer: Megatron-style single fused QKV "
                         "projection in self-attention")
@@ -733,7 +734,8 @@ def main():
     if args.model in ("all", "transformer"):
         _run("transformer", bench_transformer, args.batch or 64,
              args.steps, args.warmup, use_amp=amp,
-             use_flash=not args.no_flash, use_fused_ce=args.fused_ce,
+             use_flash=not args.no_flash,
+             use_fused_ce=bool(args.fused_ce),
              fused_qkv=args.fused_qkv, moe_experts=args.moe_experts,
              flash_pallas=args.pallas_attn, recompute=args.recompute)
     if args.model in ("all", "bert"):
@@ -757,14 +759,20 @@ def main():
         # per-layer recompute.  Runs AFTER the headline models so a
         # long-sequence OOM/compile failure can't cost their entries.
         # recompute default OFF here: bs2/8k activations fit in HBM and
-        # the A/B measured 0.3035 vs 0.2405 MFU (AB_r05.json
-        # longctx_8k_norecompute) — remat is for when memory does NOT
+        # the A/B measured 0.306 vs 0.243 MFU (AB_r05.json
+        # longctx_8k_recompute) — remat is for when memory does NOT
         # fit (--recompute re-enables; the recompute variant stays
-        # recorded in the artifact)
-        _run("longctx_8k", bench_transformer,
+        # recorded in the artifact).  fused-CE default ON at 8k+
+        # (unlike the short-seq transformer) — --no-fused-ce still
+        # turns it off for kernel A/Bs.  Entry key names the resolved
+        # sequence length so a --seq override can't mislabel its
+        # artifact entry.
+        seq = args.seq or 8192
+        _run(f"longctx_{seq // 1024}k", bench_transformer,
              args.batch or 2, max(args.steps // 4, 3), 1,
-             max_length=args.seq or 8192, use_amp=amp, use_flash=True,
-             use_fused_ce=True, flash_pallas=not args.xla_attn,
+             max_length=seq, use_amp=amp, use_flash=True,
+             use_fused_ce=args.fused_ce is not False,
+             flash_pallas=not args.xla_attn,
              recompute=args.recompute)
 
     # headline = min MFU across the two NORTH-STAR models (BASELINE.json

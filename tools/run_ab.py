@@ -132,7 +132,11 @@ def main():
                             "examples_per_sec"):
                     if key in sub:
                         return sub[key]
-        return d.get("value")
+        # NO throughput recorded -> no data.  Falling back to the MFU
+        # value here would re-open the cross-numerator comparison this
+        # function exists to prevent (tok/s vs a 0.32 fraction, or two
+        # MFUs with different flop conventions).
+        return None
 
     def wins(a, b):
         # a missing side must yield "no data", never a vacuous win —
