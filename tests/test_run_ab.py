@@ -1,0 +1,85 @@
+"""A/B summary honesty rules (tools/run_ab.py measure/wins).
+
+These lock in two failure modes caught live on the chip in round 5:
+1. A failed variant reports {"metric": "bench_failed", "value": 0.0} —
+   mistaking that 0.0 for a measurement hands the other side a vacuous
+   "win" that gates bench defaults (CLAUDE.md measured-wins-only).
+2. MFU values are NOT comparable across variants whose flop numerators
+   differ (program's own XLA count vs the dense-equivalent twin used
+   for Pallas/remat configs): fused-CE "won" on MFU while losing wall
+   clock.  wins() therefore compares throughput only, and reports
+   no-data rather than falling back to MFU.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture(scope="module")
+def run_ab():
+    spec = importlib.util.spec_from_file_location(
+        "run_ab", os.path.join(_TOOLS, "run_ab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ok(tok):
+    return {"metric": "transformer_train_mfu", "value": 0.33,
+            "detail": {"transformer": {"mfu": 0.33,
+                                       "tokens_per_sec": tok}}}
+
+
+def test_measure_prefers_throughput_over_mfu(run_ab):
+    r = {"a": _ok(157000.0)}
+    assert run_ab.measure(r, "a") == 157000.0
+
+
+def test_failed_variant_is_no_data_not_zero(run_ab):
+    r = {"a": {"metric": "bench_failed", "value": 0.0,
+               "detail": {"transformer": {"error": "boom"}}},
+         "b": _ok(150000.0)}
+    assert run_ab.measure(r, "a") is None
+    # and the healthy side must NOT get a vacuous win recorded
+    assert run_ab.wins(r, "b", "a") is None
+    assert run_ab.wins(r, "a", "b") is None
+
+
+def test_error_and_failed_keys_are_no_data(run_ab):
+    assert run_ab.measure({"a": {"error": "timeout"}}, "a") is None
+    assert run_ab.measure(
+        {"a": {"metric": "x", "value": 0.3, "failed": ["m"],
+               "detail": {}}}, "a") is None
+
+
+def test_missing_throughput_never_falls_back_to_mfu(run_ab):
+    # an entry with ONLY an MFU value (e.g. merged from a stale or
+    # foreign artifact) must be no-data: comparing a 0.33 fraction
+    # against 157000 tok/s — or two MFUs with different flop
+    # conventions — would record a confidently wrong summary
+    r = {"mfu_only": {"metric": "m", "value": 0.33,
+                      "detail": {"transformer": {"mfu": 0.33}}},
+         "with_tok": _ok(157000.0)}
+    assert run_ab.measure(r, "mfu_only") is None
+    assert run_ab.wins(r, "with_tok", "mfu_only") is None
+
+
+def test_wins_compares_wall_clock(run_ab):
+    # the live r05 case: fused-CE higher MFU, lower tok/s => loses
+    r = {"transformer_base": _ok(157129.5),
+         "transformer_fused_ce": {
+             "metric": "transformer_train_mfu", "value": 0.3289,
+             "detail": {"transformer": {"mfu": 0.3289,
+                                        "tokens_per_sec": 153963.5}}}}
+    assert run_ab.wins(r, "transformer_fused_ce",
+                       "transformer_base") is False
+    s = run_ab.compute_summary(r)
+    assert s["fused_ce_wins"] is False
+    # pairs with no data at all stay None, never False/True
+    assert s["nhwc_wins"] is None

@@ -88,6 +88,55 @@ def run_variant(args, extra):
     return out
 
 
+def measure(results, k):
+    """Comparable scalar for variant k, or None for NO DATA.
+
+    A failed bench prints {"metric": "bench_failed", "value": 0.0}
+    (and run_variant itself may record {"error": ...}): both are NO
+    DATA, never a 0.0 that hands the other side a vacuous win.
+    Prefers THROUGHPUT over MFU: variants can carry different MFU
+    numerators (the program's own XLA count vs the dense-equivalent
+    twin for Pallas/remat configs), and the r05 chip session caught
+    fused-CE "winning" on MFU while losing wall-clock.  tok/s and
+    img/s are numerator-free.  No throughput recorded -> None; falling
+    back to the MFU value would re-open the cross-numerator comparison
+    this function exists to prevent."""
+    d = results.get(k, {})
+    if "error" in d or "failed" in d or \
+            d.get("metric") == "bench_failed":
+        return None
+    for sub in (d.get("detail") or {}).values():
+        if isinstance(sub, dict):
+            for key in ("tokens_per_sec", "imgs_per_sec",
+                        "examples_per_sec"):
+                if key in sub:
+                    return sub[key]
+    return None
+
+
+def wins(results, a, b):
+    # a missing side must yield "no data", never a vacuous win —
+    # AB wins gate bench defaults (CLAUDE.md measured-wins-only)
+    ma, mb = measure(results, a), measure(results, b)
+    if ma is None or mb is None:
+        return None
+    return ma > mb
+
+
+def compute_summary(results):
+    return {
+        "nhwc_wins": wins(results, "resnet50_nhwc", "resnet50_nchw"),
+        "fused_ce_wins": wins(results, "transformer_fused_ce",
+                              "transformer_base"),
+        "fused_qkv_wins": wins(results, "transformer_fused_qkv",
+                               "transformer_base"),
+        "pallas_attn_wins": wins(results, "transformer_pallas_attn",
+                                 "transformer_base"),
+        "longctx_pallas_wins": wins(results, "longctx_8k_pallas",
+                                    "longctx_8k_xla"),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
@@ -113,50 +162,7 @@ def main():
         with open(args.out, "w") as f:
             json.dump(results, f, indent=1)
 
-    def measure(k):
-        # a failed bench prints {"metric": "bench_failed", "value": 0.0}
-        # (and run_variant itself may record {"error": ...}): both are
-        # NO DATA, never a 0.0 that hands the other side a vacuous win.
-        # Prefer THROUGHPUT over MFU: variants can carry different MFU
-        # numerators (program's own XLA count vs dense-equivalent twin
-        # for Pallas/remat configs), and the r05 chip session caught
-        # fused-CE "winning" on MFU while losing wall-clock.  tok/s and
-        # img/s are numerator-free.
-        d = results.get(k, {})
-        if "error" in d or "failed" in d or \
-                d.get("metric") == "bench_failed":
-            return None
-        for sub in (d.get("detail") or {}).values():
-            if isinstance(sub, dict):
-                for key in ("tokens_per_sec", "imgs_per_sec",
-                            "examples_per_sec"):
-                    if key in sub:
-                        return sub[key]
-        # NO throughput recorded -> no data.  Falling back to the MFU
-        # value here would re-open the cross-numerator comparison this
-        # function exists to prevent (tok/s vs a 0.32 fraction, or two
-        # MFUs with different flop conventions).
-        return None
-
-    def wins(a, b):
-        # a missing side must yield "no data", never a vacuous win —
-        # AB wins gate bench defaults (CLAUDE.md measured-wins-only)
-        ma, mb = measure(a), measure(b)
-        if ma is None or mb is None:
-            return None
-        return ma > mb
-
-    summary = {
-        "nhwc_wins": wins("resnet50_nhwc", "resnet50_nchw"),
-        "fused_ce_wins": wins("transformer_fused_ce",
-                              "transformer_base"),
-        "fused_qkv_wins": wins("transformer_fused_qkv",
-                               "transformer_base"),
-        "pallas_attn_wins": wins("transformer_pallas_attn",
-                                 "transformer_base"),
-        "longctx_pallas_wins": wins("longctx_8k_pallas",
-                                    "longctx_8k_xla"),
-    }
+    summary = compute_summary(results)
     results["summary"] = summary
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
