@@ -652,19 +652,40 @@ def main():
         jax.config.update("jax_platforms",
                           os.environ["BENCH_PLATFORM"])
 
+    # run provenance (observe pillar 3): every JSON line — including
+    # the probe-failure one — is traceable to a run-id + git sha, so
+    # mixed-run artifacts (run_ab --only merges) stay auditable
+    from paddle_tpu.observe import events as _obs_events
+
+    run_id = _obs_events.new_run_id()
+    run_sha = _obs_events.git_sha(os.path.dirname(
+        os.path.abspath(__file__)))
+
     if args.probe_timeout > 0:
         err = _probe_backend(args.probe_timeout)
         if err is not None:
             # emit the failure line IMMEDIATELY — a dead backend must
-            # never again surface as an opaque driver timeout
+            # never again surface as an opaque driver timeout.  The
+            # observability fields are present (contract: EVERY line
+            # carries them) but zero/None — the backend is dead, no
+            # devices may be touched here.
             print(json.dumps({
                 "metric": "bench_failed",
                 "value": 0.0,
                 "unit": "backend unavailable",
                 "vs_baseline": 0.0,
                 "detail": {"backend_probe": {"error": err}},
+                "compile_s": 0.0,
+                "retraces": 0,
+                "peak_mem_bytes": None,
+                "run_id": run_id,
+                "git_sha": run_sha,
             }))
             return
+
+    from paddle_tpu.observe import monitoring as _obs_monitoring
+
+    run_snap = _obs_monitoring.runtime_stats.snapshot()
 
     detail = {}
 
@@ -705,6 +726,9 @@ def main():
         import sys
         import traceback
 
+        from paddle_tpu.observe import monitoring as _obs
+
+        snap = _obs.runtime_stats.snapshot()
         try:
             if args.model_deadline > 0:
                 with _ModelDeadline(args.model_deadline):
@@ -718,6 +742,17 @@ def main():
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"warning: {name} bench failed, continuing",
                   file=sys.stderr)
+        # observability stamp (observe pillar 2): compile wall-time and
+        # retraces attributable to THIS model's region (cost_analysis
+        # twin compiles included — they are real compile time this
+        # config spends), plus the allocator's high-water mark so an
+        # almost-OOM config is visible in the artifact.  Attached even
+        # to failed entries — a compile-storm-then-die is exactly the
+        # evidence wanted.
+        delta = _obs.runtime_stats.delta(snap)
+        detail[name]["compile_s"] = round(delta["compile_time_s"], 3)
+        detail[name]["retraces"] = delta["retraces"]
+        detail[name]["peak_mem_bytes"] = _obs.peak_memory_bytes()
         _snapshot()
 
     if args.model in ("all", "resnet50"):
@@ -767,8 +802,12 @@ def main():
         # turns it off for kernel A/Bs.  Entry key names the resolved
         # sequence length so a --seq override can't mislabel its
         # artifact entry.
+        # non-multiple-of-1024 (or sub-1k) --seq values must not floor
+        # to a colliding/degenerate "longctx_0k"-style key
         seq = args.seq or 8192
-        _run(f"longctx_{seq // 1024}k", bench_transformer,
+        seq_key = (f"longctx_{seq // 1024}k" if seq % 1024 == 0
+                   else f"longctx_{seq}")
+        _run(seq_key, bench_transformer,
              args.batch or 2, max(args.steps // 4, 3), 1,
              max_length=seq, use_amp=amp, use_flash=True,
              use_fused_ce=args.fused_ce is not False,
@@ -847,6 +886,13 @@ def main():
         }
         if failed:
             result["failed"] = failed
+    # whole-run observability totals + provenance on the one JSON line
+    run_delta = _obs_monitoring.runtime_stats.delta(run_snap)
+    result["compile_s"] = round(run_delta["compile_time_s"], 3)
+    result["retraces"] = run_delta["retraces"]
+    result["peak_mem_bytes"] = _obs_monitoring.peak_memory_bytes()
+    result["run_id"] = run_id
+    result["git_sha"] = run_sha
     if args.profile:
         # profiler-inflated numbers must be distinguishable from clean
         # runs (bench-honesty gate)

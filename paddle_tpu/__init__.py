@@ -44,6 +44,7 @@ from . import debugger  # noqa: F401
 from . import imperative  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import metrics  # noqa: F401
+from . import observe  # noqa: F401
 from . import profiler  # noqa: F401
 from .data.data_feeder import DataFeeder  # noqa: F401
 from .flags import FLAGS  # noqa: F401

@@ -81,8 +81,16 @@ class Trainer:
 
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  place=None, checkpoint_config: Optional[CheckpointConfig]
-                 = None, scope: Optional[Scope] = None):
+                 = None, scope: Optional[Scope] = None, telemetry=None):
+        """telemetry: an observe.TelemetryConfig — enables the
+        device-side StepTelemetry accumulator on the train program and
+        publishes a window (telemetry means + compile/retrace/dispatch
+        runtime stats) every `interval` steps, to the configured JSONL
+        event log when one is given.  The accumulator lives inside the
+        jitted step; the only added host traffic is ONE fetch per
+        window (never per-step — CLAUDE.md tunnel-backend rule)."""
         self.checkpoint_cfg = checkpoint_config
+        self.telemetry_cfg = telemetry
         self.scope = scope or Scope()
         self.startup_program = Program()
         self.train_program = Program()
@@ -102,6 +110,16 @@ class Trainer:
                 self.train_outputs = [outs]
             optimizer = optimizer_func()
             optimizer.minimize(self.train_outputs[0])
+        self._event_log = None
+        if self.telemetry_cfg is not None:
+            from .. import observe
+
+            observe.enable_telemetry(self.train_program)
+            self._event_log = self.telemetry_cfg.event_log
+            if self._event_log is None and self.telemetry_cfg.log_path:
+                self._event_log = observe.RunEventLog(
+                    self.telemetry_cfg.log_path,
+                    meta={"source": "contrib.Trainer"})
         self.exe = Executor(place)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
@@ -184,6 +202,16 @@ class Trainer:
                   if self.checkpoint_cfg else 0)
         fetch = [o.name for o in self.train_outputs]
         skip = self._resume_step_in_epoch  # mid-epoch fast-forward
+        tel_snap = None
+        if self.telemetry_cfg is not None:
+            from ..observe import runtime_stats
+
+            tel_snap = runtime_stats.snapshot()
+            if self._event_log:
+                self._event_log.event(
+                    "train_begin", num_epochs=num_epochs,
+                    resume_epoch=self._resume_epoch,
+                    resume_step=self._resume_step_in_epoch)
         for epoch in range(self._resume_epoch, num_epochs):
             handler(BeginEpochEvent(epoch))
             step = 0
@@ -212,10 +240,18 @@ class Trainer:
                 handler(EndStepEvent(epoch, step, metrics))
                 step += 1
                 done += 1
+                if (self.telemetry_cfg is not None and
+                        done % self.telemetry_cfg.interval == 0):
+                    tel_snap = self._publish_telemetry(epoch, step,
+                                                       tel_snap)
                 if (self.checkpoint_cfg and
                         done % self.checkpoint_cfg.step_interval == 0):
                     self._save_checkpoint(serial, epoch, step)
                     serial += 1
+                    if self._event_log:
+                        self._event_log.event("checkpoint",
+                                              serial=serial - 1,
+                                              epoch=epoch, step=step)
             if skip > 0:
                 raise RuntimeError(
                     f"resume cursor expected at least {skip} more batches "
@@ -227,6 +263,37 @@ class Trainer:
                 self._save_checkpoint(serial, epoch + 1, 0)
                 serial += 1
             handler(EndEpochEvent(epoch))
+        if self.telemetry_cfg is not None:
+            # flush the partial final window so no steps go unreported
+            self._publish_telemetry(num_epochs - 1, -1, tel_snap)
+            if self._event_log:
+                self._event_log.event("train_end",
+                                      num_epochs=num_epochs)
+
+    # -- telemetry -------------------------------------------------------
+    last_telemetry = None
+
+    def _publish_telemetry(self, epoch: int, step: int, since):
+        """Fetch the device accumulator (ONE host sync), attach the
+        window's host runtime stats, and emit a `telemetry` event."""
+        from .. import observe
+
+        tel = observe.fetch_telemetry(self.scope, reset=True)
+        now = observe.runtime_stats.snapshot()
+        if tel is None or tel.steps == 0:
+            return now
+        self.last_telemetry = tel
+        if self._event_log:
+            delta = observe.runtime_stats.delta(since or {})
+            self._event_log.telemetry_window(
+                tel, epoch=epoch, step=step,
+                compiles=delta["compiles"],
+                compile_time_s=round(delta["compile_time_s"], 3),
+                retraces=delta["retraces"],
+                dispatches=delta["dispatches"],
+                dispatch_time_s=round(delta["dispatch_time_s"], 4),
+                peak_mem_bytes=observe.peak_memory_bytes())
+        return now
 
     def save_params(self, dirname: str):
         with scope_guard(self.scope):

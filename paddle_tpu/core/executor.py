@@ -258,32 +258,40 @@ def _run_checkpointed_segment(seg_ops, env, rng_key, start_index,
 
 def _run_one_op(op, env, rng_key, op_index, amp_lists=None,
                 program=None, sparse_rows=None):
+    import jax
+
     from .registry import get_macro_op_impl, is_macro_op
     from .selected_rows import densify
 
     desc = op.desc
+    # fluid-op attribution (observe pillar 1): the scope name lands in
+    # every emitted HLO instruction's metadata.op_name, so device
+    # profiles and compiled-HLO dumps carry "<op_type>:<op_index>" —
+    # trace-time only, zero runtime cost (observe/trace.py parses it
+    # back out of captured profiles)
     try:
-        if is_macro_op(desc.type):
-            ctx = OpContext(rng_key, op_index=op_index,
-                            program=program, amp_lists=amp_lists)
-            get_macro_op_impl(desc.type)(ctx, env, desc)
-            return env
-        impl = get_op_impl(desc.type)
-        ins = {
-            slot: [env[n] for n in names]
-            for slot, names in desc.inputs.items()
-        }
-        if desc.type not in SPARSE_AWARE_OPS:
-            ins = {slot: [densify(v) for v in vals]
-                   for slot, vals in ins.items()}
-        if amp_lists is not None:
-            from ..amp import cast_ins_for_op
+        with jax.named_scope(f"{desc.type}:{op_index}"):
+            if is_macro_op(desc.type):
+                ctx = OpContext(rng_key, op_index=op_index,
+                                program=program, amp_lists=amp_lists)
+                get_macro_op_impl(desc.type)(ctx, env, desc)
+                return env
+            impl = get_op_impl(desc.type)
+            ins = {
+                slot: [env[n] for n in names]
+                for slot, names in desc.inputs.items()
+            }
+            if desc.type not in SPARSE_AWARE_OPS:
+                ins = {slot: [densify(v) for v in vals]
+                       for slot, vals in ins.items()}
+            if amp_lists is not None:
+                from ..amp import cast_ins_for_op
 
-            ins = cast_ins_for_op(desc.type, ins, amp_lists)
-        ctx = OpContext(rng_key, op_index=op_index,
-                        program=program, amp_lists=amp_lists,
-                        sparse_rows=sparse_rows)
-        outs = impl(ctx, ins, desc.attrs)
+                ins = cast_ins_for_op(desc.type, ins, amp_lists)
+            ctx = OpContext(rng_key, op_index=op_index,
+                            program=program, amp_lists=amp_lists,
+                            sparse_rows=sparse_rows)
+            outs = impl(ctx, ins, desc.attrs)
     except Exception as exc:
         _reraise_with_op_context(exc, desc, op_index)
     for slot, names in desc.outputs.items():
@@ -420,6 +428,17 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     # rest_ops[0] is the `backward_marker` op itself; skip it.
     run_ops(rest_ops[1:], env, rng_key, start_index=k + 1,
             amp_lists=amp_lists, program=program)
+    if getattr(program, "_telemetry_enabled", False):
+        # device-side telemetry accumulation (observe pillar 2): pure
+        # jnp over values already live in the trace — grads, loss, and
+        # the pre/post-update params — so the step stays ONE fused XLA
+        # computation with no callbacks/host syncs
+        from ..observe import metrics as _obs_metrics
+
+        if _obs_metrics.TELEMETRY_VAR in env:
+            env[_obs_metrics.TELEMETRY_VAR] = _obs_metrics.device_update(
+                env[_obs_metrics.TELEMETRY_VAR], loss_val, grads,
+                trainable, env)
     return env
 
 
@@ -664,6 +683,13 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Any, Any] = {}
+        # feed-signature sets per cache entry: a NEW shape/dtype
+        # signature on an already-built step fn means jax will retrace
+        # and recompile it — counted as a retrace (observe pillar 2)
+        self._sig_seen: Dict[Any, set] = {}
+        from ..observe import monitoring as _obs_monitoring
+
+        _obs_monitoring.install()
 
     # -- public API ------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -704,7 +730,10 @@ class Executor:
         fn, state, feed_arrays = self._prepare(
             program, feed, fetch_names, scope, iterations,
             use_program_cache, accumulation_steps)
-        new_state, fetches = fn(state, feed_arrays)
+        from ..observe.monitoring import dispatch_timer
+
+        with dispatch_timer():
+            new_state, fetches = fn(state, feed_arrays)
         for name, val in new_state.items():
             scope.set_var(name, val)
         _debug_checks(fetch_names, fetches, new_state)
@@ -753,6 +782,18 @@ class Executor:
             v.name for v in block.vars.values()
             if v.persistable and scope.has_var(v.name)
         ))
+        from ..observe import metrics as _obs_metrics
+        from ..observe.monitoring import runtime_stats
+
+        telemetry = getattr(program, "_telemetry_enabled", False)
+        if telemetry:
+            # the accumulator rides in the state pytree (donated,
+            # carried through chain_iterations); creating it here keeps
+            # enable_telemetry() a pure program-level flag flip
+            if scope.find_var(_obs_metrics.TELEMETRY_VAR) is None:
+                scope.set_var(_obs_metrics.TELEMETRY_VAR,
+                              _obs_metrics.init_telemetry())
+            state_names = state_names + (_obs_metrics.TELEMETRY_VAR,)
         key = (program._uid, program._version, tuple(sorted(feed)),
                tuple(fetch_names), state_names, iterations,
                accumulation_steps)
@@ -761,11 +802,20 @@ class Executor:
             fn = self._build_step_fn(program, tuple(sorted(feed)),
                                      tuple(fetch_names), state_names,
                                      iterations, accumulation_steps)
+            runtime_stats.record_build()
             if use_program_cache:
                 self._cache[key] = fn
         state = {n: scope.find_var(n) for n in state_names}
         state[RNG_STATE_VAR] = scope.find_var(RNG_STATE_VAR)
         feed_arrays = {n: _to_array(v, block) for n, v in feed.items()}
+        sig = tuple(
+            (n, tuple(getattr(v, "shape", ()) or ()),
+             str(getattr(v, "dtype", type(v).__name__)))
+            for n, v in sorted(feed_arrays.items()))
+        seen = self._sig_seen.setdefault(key, set())
+        if seen and sig not in seen:
+            runtime_stats.record_retrace()
+        seen.add(sig)
         return fn, state, feed_arrays
 
     # -- compilation -----------------------------------------------------
@@ -792,6 +842,12 @@ class Executor:
             new_state = {
                 n: env[n] for n in persistable_names if n in env
             }
+            from ..observe.metrics import TELEMETRY_VAR
+
+            if TELEMETRY_VAR in env:
+                # not a block var; threads the step (and the
+                # chain_iterations carry) as executor-private state
+                new_state[TELEMETRY_VAR] = env[TELEMETRY_VAR]
             new_state[RNG_STATE_VAR] = jax.random.split(rng_key, 1)[0]
             fetches = [env[n] for n in fetch_names]
             return new_state, fetches

@@ -1,0 +1,47 @@
+"""paddle_tpu.observe — device-side telemetry for the TPU runtime.
+
+Three pillars (docs/OBSERVE.md):
+
+1. TRACE ATTRIBUTION — the executor wraps every op lowering in
+   `jax.named_scope("<op_type>:<op_index>")` so jax.profiler traces and
+   XLA HLO metadata carry fluid op names end-to-end; `trace.py` parses
+   a captured trace back into the fluid profiler's per-op time table
+   (`profiler.profiler(sorted_key=...)` prints it).
+
+2. DEVICE-SIDE METRICS — `StepTelemetry` accumulates loss/grad-norm/
+   update-norm/non-finite counters INSIDE the jitted step (extra carry
+   state, no host round-trips, no callbacks — the tunnel backend
+   forbids them) and is fetched every N steps in one sync; host-side
+   `runtime_stats` counts XLA compiles (+wall time, via
+   jax.monitoring), executor retraces, and dispatch latency.
+
+3. STRUCTURED RUN EVENTS — `RunEventLog` writes JSONL records with
+   run-id/git-sha/backend/mesh provenance, consumed by
+   contrib.Trainer(telemetry=...), bench.py, and tools/run_ab.py.
+"""
+
+from .events import RunEventLog, git_sha, new_run_id, read_events  # noqa: F401
+from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
+                      enable_telemetry, fetch_telemetry, init_telemetry,
+                      telemetry_enabled)
+from .monitoring import (RuntimeStats, device_memory_stats,  # noqa: F401
+                         peak_memory_bytes, runtime_stats)
+from .trace import fluid_op_of, format_op_table, op_time_table  # noqa: F401
+
+
+class TelemetryConfig:
+    """How contrib.Trainer publishes telemetry.
+
+    interval: fetch the device accumulator every N steps (the
+        "device-accumulate, periodic-fetch" cadence — never per-step).
+    log_path: write telemetry windows to this JSONL file (a
+        RunEventLog is created per training run).
+    event_log: alternatively, an existing RunEventLog to emit into.
+    """
+
+    def __init__(self, interval: int = 10, log_path=None, event_log=None):
+        if interval < 1:
+            raise ValueError("telemetry interval must be >= 1")
+        self.interval = int(interval)
+        self.log_path = log_path
+        self.event_log = event_log

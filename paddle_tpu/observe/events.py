@@ -1,0 +1,145 @@
+"""Structured run events: an append-only JSONL log with provenance.
+
+Every training/bench run gets a run-id + git-sha + backend/mesh stamp
+and a stream of typed event records (telemetry windows, checkpoints,
+compile storms) — the artifact a dashboards/alerting layer tails, and
+the provenance stamp tools/run_ab.py uses to keep mixed-run A/B
+artifacts auditable.  One JSON object per line; the file is valid to
+tail mid-run (each line is flushed whole).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+def new_run_id() -> str:
+    """Short unique id for one run/invocation (12 hex chars)."""
+    return uuid.uuid4().hex[:12]
+
+
+def git_sha(cwd: Optional[str] = None) -> Optional[str]:
+    """Current git HEAD (short), or None outside a repo / without git."""
+    import subprocess
+
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=5,
+                           cwd=cwd)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = r.stdout.strip()
+    return sha if r.returncode == 0 and sha else None
+
+
+def _backend_info() -> Dict[str, Any]:
+    """Backend/device provenance WITHOUT forcing backend init: only
+    reports when jax is already imported and initialized (events logs
+    must stay usable from pure-host tools like run_ab)."""
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {"backend": jax.default_backend(),
+                "n_devices": len(devs),
+                "device_kind": devs[0].device_kind if devs else None}
+    except Exception:  # noqa: BLE001 — a dead backend must not kill logging
+        return {}
+
+
+class RunEventLog:
+    """Append-only JSONL event log for one run.
+
+        with RunEventLog("events.jsonl", mesh_shape={"dp": 8}) as log:
+            log.event("checkpoint", serial=3)
+            log.telemetry_window(tel, window=10)
+
+    Records carry {ts (unix seconds), run_id, event, ...fields}.  The
+    first record is `run_begin` with run provenance (git sha, backend,
+    mesh); `close()` appends `run_end`.
+    """
+
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 mesh_shape: Optional[Dict[str, int]] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.run_id = run_id or new_run_id()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        begin: Dict[str, Any] = {"git_sha": git_sha(),
+                                 "argv": list(sys.argv)}
+        begin.update(_backend_info())
+        if mesh_shape:
+            begin["mesh_shape"] = dict(mesh_shape)
+        if meta:
+            begin.update(meta)
+        self.event("run_begin", **begin)
+
+    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Append one event record (flushed immediately)."""
+        rec = {"ts": round(time.time(), 3), "run_id": self.run_id,
+               "event": kind}
+        rec.update(fields)
+        self._f.write(json.dumps(rec, default=_jsonable) + "\n")
+        self._f.flush()
+        return rec
+
+    def telemetry_window(self, telemetry, **extra: Any) -> Dict[str, Any]:
+        """Emit one periodic-fetch window (a StepTelemetry or plain
+        dict) plus any runtime-stats fields the caller attaches."""
+        fields = (telemetry.as_dict() if hasattr(telemetry, "as_dict")
+                  else dict(telemetry))
+        fields.update(extra)
+        return self.event("telemetry", **fields)
+
+    def close(self):
+        if not self._f.closed:
+            self.event("run_end")
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _jsonable(v):
+    import numpy as np
+
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return str(v)
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL event log back into records.  Raises on corrupt
+    lines — an event log that silently drops records is worse than one
+    that fails loudly (a torn final line from a killed process is the
+    one tolerated exception)."""
+    out: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    for i, ln in enumerate(lines):
+        if not ln.strip():
+            continue
+        try:
+            out.append(json.loads(ln))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # torn tail from a killed writer
+            raise
+    return out

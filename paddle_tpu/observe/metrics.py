@@ -1,0 +1,157 @@
+"""StepTelemetry: device-side training-health accumulator.
+
+The architecture invariant (CLAUDE.md) is that a training step is ONE
+jitted XLA computation with no host round-trips, and the tunnel backend
+supports no host callbacks — so per-step scalars (loss, grad norm,
+update norm, non-finite counts) must ACCUMULATE ON DEVICE as extra
+carry state of the jitted step and be fetched every N steps in one
+host sync ("device-accumulate, periodic-fetch").  The accumulator is a
+flat dict-of-scalars pytree living in the executor state under
+`TELEMETRY_VAR`; `core/executor.py` threads it through the step (and
+through `chain_iterations`' fori_loop carry, so K chained iterations
+accumulate K updates with zero extra dispatches).
+
+reference analog: the reference's per-op NaN scan ran on HOST after
+every op (operator.cc:943 FLAGS_check_nan_inf) — affordable on a
+stream-per-op runtime, a per-step device->host sync here.  The
+host-side `_debug_checks` path still exists for debugging; this module
+is the production-telemetry replacement that costs one fetch per
+window.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+TELEMETRY_VAR = "__telemetry__"
+
+_F32_FIELDS = ("loss_sum", "loss_last", "grad_norm_sum", "grad_norm_last",
+               "update_norm_sum", "update_norm_last")
+_I32_FIELDS = ("steps", "nonfinite_grad_steps", "nonfinite_loss_steps")
+
+
+def enable_telemetry(program) -> None:
+    """Opt a Program's compiled step into device-side telemetry.  Must
+    be set before the Executor builds/caches the step fn for this
+    (program, feeds, fetches) combination — enabling later changes the
+    cache key, forcing a rebuild, so it still takes effect (at one
+    retrace's cost)."""
+    program._telemetry_enabled = True
+
+
+def telemetry_enabled(program) -> bool:
+    return bool(getattr(program, "_telemetry_enabled", False))
+
+
+def init_telemetry() -> Dict[str, Any]:
+    """Fresh zeroed accumulator (host values; become device arrays on
+    first dispatch)."""
+    out: Dict[str, Any] = {f: np.float32(0.0) for f in _F32_FIELDS}
+    out.update({f: np.int32(0) for f in _I32_FIELDS})
+    return out
+
+
+def device_update(tel: Dict[str, Any], loss, grads: Dict[str, Any],
+                  params_before: Dict[str, Any],
+                  env: Dict[str, Any]) -> Dict[str, Any]:
+    """One step's accumulation — runs INSIDE the jit trace (pure, no
+    callbacks).  grads may contain SparseGrad pytrees (their touched
+    rows carry the whole gradient mass, so the norm over rows is the
+    true table-grad norm up to duplicate-id merging)."""
+    import jax.numpy as jnp
+
+    from ..core.selected_rows import SparseGrad
+
+    gsq = jnp.float32(0.0)
+    nonfinite = jnp.int32(0)
+    for g in grads.values():
+        parts = (g.rows,) if isinstance(g, SparseGrad) else (g,)
+        for a in parts:
+            af = a.astype(jnp.float32)
+            gsq = gsq + jnp.sum(af * af)
+            nonfinite = nonfinite + (~jnp.isfinite(af)).sum().astype(
+                jnp.int32)
+    usq = jnp.float32(0.0)
+    for pname, old in params_before.items():
+        new = env.get(pname)
+        if new is None or new is old:
+            continue
+        d = new.astype(jnp.float32) - old.astype(jnp.float32)
+        usq = usq + jnp.sum(d * d)
+    gnorm = jnp.sqrt(gsq)
+    unorm = jnp.sqrt(usq)
+    lf = jnp.asarray(loss).astype(jnp.float32)
+    loss_bad = (~jnp.isfinite(lf)).astype(jnp.int32)
+    return {
+        "steps": tel["steps"] + 1,
+        "loss_sum": tel["loss_sum"] + lf,
+        "loss_last": lf,
+        "grad_norm_sum": tel["grad_norm_sum"] + gnorm,
+        "grad_norm_last": gnorm,
+        "update_norm_sum": tel["update_norm_sum"] + unorm,
+        "update_norm_last": unorm,
+        "nonfinite_grad_steps": tel["nonfinite_grad_steps"]
+        + (nonfinite > 0).astype(jnp.int32),
+        "nonfinite_loss_steps": tel["nonfinite_loss_steps"] + loss_bad,
+    }
+
+
+@dataclass
+class StepTelemetry:
+    """Host-side view of one telemetry window (the periodic fetch)."""
+
+    steps: int
+    loss_last: float
+    loss_mean: float
+    grad_norm_last: float
+    grad_norm_mean: float
+    update_norm_last: float
+    update_norm_mean: float
+    nonfinite_grad_steps: int
+    nonfinite_loss_steps: int
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "steps": self.steps,
+            "loss_last": self.loss_last,
+            "loss_mean": self.loss_mean,
+            "grad_norm_last": self.grad_norm_last,
+            "grad_norm_mean": self.grad_norm_mean,
+            "update_norm_last": self.update_norm_last,
+            "update_norm_mean": self.update_norm_mean,
+            "nonfinite_grad_steps": self.nonfinite_grad_steps,
+            "nonfinite_loss_steps": self.nonfinite_loss_steps,
+        }
+
+    @property
+    def healthy(self) -> bool:
+        return (self.nonfinite_grad_steps == 0
+                and self.nonfinite_loss_steps == 0)
+
+
+def fetch_telemetry(scope, reset: bool = True) -> Optional[StepTelemetry]:
+    """ONE host sync: pull the device accumulator out of `scope`,
+    convert to a window summary, and (by default) re-zero it so the
+    next window starts fresh.  Returns None when the scope carries no
+    telemetry (program not enabled, or no step ran yet)."""
+    raw = scope.find_var(TELEMETRY_VAR)
+    if raw is None:
+        return None
+    host = {k: np.asarray(v).item() for k, v in raw.items()}
+    if reset:
+        scope.set_var(TELEMETRY_VAR, init_telemetry())
+    n = max(int(host["steps"]), 1)
+    return StepTelemetry(
+        steps=int(host["steps"]),
+        loss_last=host["loss_last"],
+        loss_mean=host["loss_sum"] / n,
+        grad_norm_last=host["grad_norm_last"],
+        grad_norm_mean=host["grad_norm_sum"] / n,
+        update_norm_last=host["update_norm_last"],
+        update_norm_mean=host["update_norm_sum"] / n,
+        nonfinite_grad_steps=int(host["nonfinite_grad_steps"]),
+        nonfinite_loss_steps=int(host["nonfinite_loss_steps"]),
+    )

@@ -1,0 +1,133 @@
+"""Host-side runtime accounting: compile/retrace counters, compile
+wall-time, dispatch latency, device-memory snapshots.
+
+reference analog: the reference tracked per-op host timings through
+platform/profiler RecordEvent; on TPU the expensive host-side events
+are XLA COMPILES (seconds each) and jit RETRACES (a shape change
+silently recompiling the step), which are invisible without hooks.
+Compile events come from `jax.monitoring` (the jit/pjit internals emit
+`/jax/core/compile/backend_compile_duration` per backend compile);
+retraces are detected in `Executor._prepare` by input-signature change
+on an already-built step fn (jax re-traces per new shape/dtype
+signature); dispatch timing is the host cost of enqueueing one
+`Executor.run` (async — device completion is NOT included; the tunnel
+RTT story lives in bench.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# older jax emitted the `_sec`-suffixed name; accept both
+_COMPILE_EVENT_ALIASES = (_COMPILE_EVENT, _COMPILE_EVENT + "_sec",
+                          "/jax/core/compile/backend_compile_duration_sec")
+
+_FIELDS = ("compiles", "compile_time_s", "builds", "retraces",
+           "dispatches", "dispatch_time_s")
+
+
+class RuntimeStats:
+    """Monotonic counters for the process; use snapshot()/delta() to
+    attribute a region (a bench model, a telemetry window)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0           # XLA backend compiles (jax.monitoring)
+        self.compile_time_s = 0.0   # total backend-compile wall time
+        self.builds = 0             # Executor step fns traced (cache miss)
+        self.retraces = 0           # re-compiles of an existing step fn
+        #                             caused by a feed signature change
+        self.dispatches = 0         # Executor.run dispatch count
+        self.dispatch_time_s = 0.0  # host enqueue time (async; excludes
+        #                             device execution)
+        self.last_dispatch_s = 0.0
+
+    def record_compile(self, duration_s: float):
+        with self._lock:
+            self.compiles += 1
+            self.compile_time_s += float(duration_s)
+
+    def record_build(self):
+        with self._lock:
+            self.builds += 1
+
+    def record_retrace(self):
+        with self._lock:
+            self.retraces += 1
+
+    def record_dispatch(self, duration_s: float):
+        with self._lock:
+            self.dispatches += 1
+            self.dispatch_time_s += float(duration_s)
+            self.last_dispatch_s = float(duration_s)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {f: getattr(self, f) for f in _FIELDS}
+
+    def delta(self, since: Dict[str, Any]) -> Dict[str, Any]:
+        now = self.snapshot()
+        return {f: now[f] - since.get(f, 0) for f in _FIELDS}
+
+
+runtime_stats = RuntimeStats()
+
+_installed = [False]
+
+
+def install():
+    """Register the jax.monitoring compile listener (idempotent).
+    Called on first Executor use; listeners cannot be removed
+    individually in jax, so this stays for the process lifetime —
+    the callback is a counter bump, nanoseconds per compile."""
+    if _installed[0]:
+        return
+    import jax.monitoring
+
+    def _on_duration(event, duration, **_kw):
+        if event in _COMPILE_EVENT_ALIASES:
+            runtime_stats.record_compile(duration)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed[0] = True
+
+
+def device_memory_stats(device=None) -> Dict[str, Any]:
+    """One device's allocator stats (keys like bytes_in_use,
+    peak_bytes_in_use).  {} on backends that don't report (CPU)."""
+    import jax
+
+    d = device if device is not None else jax.local_devices()[0]
+    try:
+        stats = d.memory_stats()
+    except Exception:  # noqa: BLE001 — backend-dependent API
+        return {}
+    return dict(stats) if stats else {}
+
+
+def peak_memory_bytes() -> Optional[int]:
+    """Max peak_bytes_in_use across local devices, or None when no
+    device reports memory stats (the CPU test backend)."""
+    import jax
+
+    peaks = []
+    for d in jax.local_devices():
+        stats = device_memory_stats(d)
+        if "peak_bytes_in_use" in stats:
+            peaks.append(int(stats["peak_bytes_in_use"]))
+    return max(peaks) if peaks else None
+
+
+class dispatch_timer:
+    """Context manager stamping one dispatch into runtime_stats."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        runtime_stats.record_dispatch(time.perf_counter() - self._t0)
+        return False

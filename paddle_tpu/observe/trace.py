@@ -1,0 +1,341 @@
+"""Post-trace attribution: parse a captured jax.profiler trace into a
+per-fluid-op time table.
+
+The reference Fluid profiler printed a per-op summary table after the
+profiled region (python/paddle/fluid/profiler.py `sorted_key`; the data
+came from RecordEvent ranges + the CUPTI DeviceTracer).  On TPU the
+equivalent raw material is the XPlane protobuf jax.profiler writes:
+device planes carry one timed event per executed HLO instruction, and
+the trace's serialized HLO modules carry each instruction's
+`metadata.op_name` — which contains the `<op_type>:<op_index>` named
+scopes the executor emits around every op lowering
+(core/executor.py _run_one_op).  Joining the two recovers fluid-op
+attribution from a device timeline without any host-side hooks.
+
+Everything here is dependency-free: the XPlane and HLO protos are read
+with a minimal protobuf wire-format scanner (the schemas' field numbers
+are stable in XLA/tsl), so no tensorflow / tensorboard import is needed
+— those are multi-second imports that also link a second copy of XLA
+into the process.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# minimal protobuf wire-format scanner
+# --------------------------------------------------------------------------
+
+
+def _uvarint(buf: bytes, i: int) -> Tuple[int, int]:
+    x = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        x |= (b & 0x7F) << s
+        if not b & 0x80:
+            return x, i
+        s += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    Length-delimited values are returned as raw bytes (caller decides
+    whether they are strings or sub-messages)."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _uvarint(buf, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _uvarint(buf, i)
+        elif wt == 2:
+            ln, i = _uvarint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:  # groups (3/4) never appear in these schemas
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _first(buf: bytes, fno: int, default=None):
+    for f, _wt, v in _fields(buf):
+        if f == fno:
+            return v
+    return default
+
+
+def _utf8(v, default: str = "") -> str:
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return default if v is None else str(v)
+
+
+# --------------------------------------------------------------------------
+# XPlane schema (tsl/profiler/protobuf/xplane.proto — stable field numbers)
+# --------------------------------------------------------------------------
+
+# XSpace:           planes=1
+# XPlane:           name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+# XLine:            name=2 events=4
+# XEvent:           metadata_id=1 duration_ps=3 stats=4
+# XEventMetadata:   id=1 name=2 display_name=3 stats=5
+# XStatMetadata:    id=1 name=2
+# XStat:            metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7
+
+
+def _parse_stat(buf: bytes, stat_names: Dict[int, str]):
+    mid, val = 0, None
+    for f, wt, v in _fields(buf):
+        if f == 1:
+            mid = v
+        elif f in (3, 4, 7):
+            val = v
+        elif f == 5:
+            val = _utf8(v)
+        elif f == 6:
+            val = v  # bytes payloads (e.g. serialized HLO)
+        elif f == 2:
+            import struct
+
+            val = struct.unpack("<d", v)[0] if wt == 1 else v
+    return stat_names.get(mid, str(mid)), val
+
+
+def _parse_map_entry(buf: bytes) -> Tuple[int, bytes]:
+    key, val = 0, b""
+    for f, _wt, v in _fields(buf):
+        if f == 1:
+            key = v
+        elif f == 2:
+            val = v
+    return key, val
+
+
+class XPlane:
+    def __init__(self, name: str):
+        self.name = name
+        # line name -> [(event_meta_name, duration_ps, stats_dict)]
+        self.lines: Dict[str, List[Tuple[str, int, Dict[str, Any]]]] = {}
+        # event-metadata name -> stats dict (program-level metadata such
+        # as the serialized "Hlo Proto" lives here, not on timed events)
+        self.event_meta_stats: Dict[str, Dict[str, Any]] = {}
+
+
+def parse_xspace(path: str) -> List[XPlane]:
+    """Parse one .xplane.pb file into a list of XPlane views."""
+    space = open(path, "rb").read()
+    planes = []
+    for f, _wt, pbuf in _fields(space):
+        if f != 1:
+            continue
+        stat_names: Dict[int, str] = {}
+        event_meta: Dict[int, Tuple[str, bytes]] = {}
+        line_bufs: List[bytes] = []
+        name = ""
+        for pf, _pwt, pv in _fields(pbuf):
+            if pf == 2:
+                name = _utf8(pv)
+            elif pf == 3:
+                line_bufs.append(pv)
+            elif pf == 4:
+                mid, mbuf = _parse_map_entry(pv)
+                event_meta[mid] = (_utf8(_first(mbuf, 2, b"")), mbuf)
+            elif pf == 5:
+                mid, mbuf = _parse_map_entry(pv)
+                stat_names[mid] = _utf8(_first(mbuf, 2, b""))
+        plane = XPlane(name)
+        for mid, (mname, mbuf) in event_meta.items():
+            stats: Dict[str, Any] = {}
+            for mf, _mwt, mv in _fields(mbuf):
+                if mf == 5:  # XEventMetadata.stats
+                    k, v = _parse_stat(mv, stat_names)
+                    stats[k] = v
+            if stats:
+                plane.event_meta_stats[mname] = stats
+        for lbuf in line_bufs:
+            lname, events = "", []
+            for lf, _lwt, lv in _fields(lbuf):
+                if lf == 2:
+                    lname = _utf8(lv)
+                elif lf == 4:
+                    mid, dur = 0, 0
+                    estats: Dict[str, Any] = {}
+                    for ef, _ewt, ev in _fields(lv):
+                        if ef == 1:
+                            mid = ev
+                        elif ef == 3:
+                            dur = ev
+                        elif ef == 4:
+                            k, v = _parse_stat(ev, stat_names)
+                            estats[k] = v
+                    events.append((event_meta.get(mid, ("?", b""))[0],
+                                   dur, estats))
+            plane.lines.setdefault(lname, []).extend(events)
+        planes.append(plane)
+    return planes
+
+
+# --------------------------------------------------------------------------
+# HLO proto: instruction name -> metadata.op_name
+# --------------------------------------------------------------------------
+
+# HloProto:            hlo_module=1
+# HloModuleProto:      computations=3
+# HloComputationProto: instructions=2
+# HloInstructionProto: name=1 metadata=7
+# OpMetadata:          op_type=1 op_name=2
+
+
+def hlo_op_names(hlo_proto: bytes) -> Dict[str, str]:
+    """{instruction_name: metadata.op_name} for one serialized HloProto."""
+    out: Dict[str, str] = {}
+    module = _first(hlo_proto, 1, b"")
+    for f, _wt, comp in _fields(module):
+        if f != 3:
+            continue
+        for cf, _cwt, instr in _fields(comp):
+            if cf != 2:
+                continue
+            iname, opname = None, None
+            for inf, _iwt, iv in _fields(instr):
+                if inf == 1:
+                    iname = _utf8(iv)
+                elif inf == 7:
+                    opname = _utf8(_first(iv, 2, b""))
+            if iname and opname:
+                out[iname] = opname
+    return out
+
+
+_PROGRAM_ID_RE = re.compile(r"\((\d+)\)$")
+# the executor's scope convention: "<op_type>:<op_index>"
+_FLUID_SCOPE_RE = re.compile(r"(?:^|/)([A-Za-z0-9_.\-]+):(\d+)(?=/|$)")
+
+
+def fluid_op_of(op_name: str) -> Optional[str]:
+    """Innermost `<op_type>:<index>` scope segment of an HLO op_name,
+    or None when the instruction carries no fluid attribution."""
+    hits = _FLUID_SCOPE_RE.findall(op_name)
+    return hits[-1][0] if hits else None
+
+
+def _trace_files(profile_dir: str) -> List[str]:
+    """Newest run's .xplane.pb files under a jax.profiler log dir (the
+    dir itself, or profile_dir/plugins/profile/<timestamp>/)."""
+    direct = sorted(glob.glob(os.path.join(profile_dir, "*.xplane.pb")))
+    if direct:
+        return direct
+    runs = sorted(glob.glob(os.path.join(
+        profile_dir, "plugins", "profile", "*")))
+    if not runs:
+        raise FileNotFoundError(
+            f"no profiler runs under {profile_dir!r}")
+    files = sorted(glob.glob(os.path.join(runs[-1], "*.xplane.pb")))
+    if not files:
+        raise FileNotFoundError(
+            f"no .xplane.pb in newest run {runs[-1]!r}")
+    return files
+
+
+def op_time_table(profile_dir: str) -> List[Dict[str, Any]]:
+    """Aggregate a captured trace into per-fluid-op-type rows.
+
+    Returns [{op_type, calls, total_ms, avg_ms, max_ms, min_ms, ratio}]
+    sorted by total time.  Rows whose device events carry no
+    `<op>:<idx>` scope (infra, un-annotated programs) aggregate under
+    "[unattributed]"; host python events and profiler bookkeeping lines
+    are excluded.
+    """
+    # instruction -> op_name maps, keyed by program id where known
+    per_program: Dict[str, Dict[str, str]] = {}
+    merged: Dict[str, str] = {}
+    planes: List[XPlane] = []
+    for path in _trace_files(profile_dir):
+        planes.extend(parse_xspace(path))
+    for plane in planes:
+        for mname, stats in plane.event_meta_stats.items():
+            hlo = stats.get("Hlo Proto")
+            if not isinstance(hlo, bytes) or not hlo:
+                continue
+            names = hlo_op_names(hlo)
+            m = _PROGRAM_ID_RE.search(mname)
+            if m:
+                per_program.setdefault(m.group(1), {}).update(names)
+            merged.update(names)
+
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def add(op: str, dur_ms: float):
+        r = rows.setdefault(op, {"op_type": op, "calls": 0,
+                                 "total_ms": 0.0, "max_ms": 0.0,
+                                 "min_ms": float("inf")})
+        r["calls"] += 1
+        r["total_ms"] += dur_ms
+        r["max_ms"] = max(r["max_ms"], dur_ms)
+        r["min_ms"] = min(r["min_ms"], dur_ms)
+
+    for plane in planes:
+        is_device = plane.name.startswith("/device:")
+        for _lname, events in plane.lines.items():
+            for ename, dur_ps, estats in events:
+                if dur_ps <= 0:
+                    continue
+                pid = estats.get("program_id")
+                imap = per_program.get(str(pid), merged) if pid \
+                    else merged
+                op_name = imap.get(ename) or merged.get(ename)
+                if op_name is None and not is_device:
+                    # host event that is not an HLO instruction (python
+                    # frames, thread-pool bookkeeping) — not op time.
+                    # Instruction events land on host lines too: XLA:CPU
+                    # executes small thunks INLINE on the calling
+                    # thread, so the instruction-name map, not the line
+                    # name, decides what counts.
+                    continue
+                fluid_op = fluid_op_of(op_name) if op_name else None
+                add(fluid_op or "[unattributed]", dur_ps / 1e9)
+
+    out = sorted(rows.values(), key=lambda r: -r["total_ms"])
+    total = sum(r["total_ms"] for r in out) or 1.0
+    for r in out:
+        r["avg_ms"] = r["total_ms"] / r["calls"]
+        r["ratio"] = r["total_ms"] / total
+        if r["min_ms"] == float("inf"):
+            r["min_ms"] = 0.0
+    return out
+
+
+_SORT_KEYS = {"total": "total_ms", "calls": "calls", "max": "max_ms",
+              "min": "min_ms", "ave": "avg_ms", "avg": "avg_ms"}
+
+
+def format_op_table(profile_dir: str,
+                    sorted_key: Optional[str] = "total") -> str:
+    """The fluid profiler report: one row per fluid op type, sorted by
+    `sorted_key` (total/calls/max/min/ave — fluid's vocabulary)."""
+    rows = op_time_table(profile_dir)
+    key = _SORT_KEYS.get(str(sorted_key).lower(), "total_ms")
+    rows = sorted(rows, key=lambda r: -r[key])
+    lines = ["------->     Profiling Report     <-------", ""]
+    hdr = (f"{'Event':<28}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+           f"{'Max(ms)':>10}{'Min(ms)':>10}{'Ratio':>8}")
+    lines += [f"sorted by: {sorted_key}", "", hdr, "-" * len(hdr)]
+    for r in rows:
+        lines.append(
+            f"{r['op_type']:<28}{r['calls']:>8}{r['total_ms']:>12.3f}"
+            f"{r['avg_ms']:>10.4f}{r['max_ms']:>10.4f}"
+            f"{r['min_ms']:>10.4f}{r['ratio']:>8.1%}")
+    if not rows:
+        lines.append("(no attributable device events in trace)")
+    return "\n".join(lines)

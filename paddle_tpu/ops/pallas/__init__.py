@@ -40,7 +40,22 @@ def interpret() -> bool:
 
 
 def pallas_call(*args, **kw):
-    """pl.pallas_call with the shared interpret gate applied."""
+    """pl.pallas_call with the shared interpret gate applied, and the
+    invocation wrapped in a jax.named_scope carrying the kernel's name
+    — device traces then attribute custom-call time to the specific
+    Pallas kernel (custom calls are otherwise opaque blobs in profiles,
+    the same blindness that makes them report zero flops to XLA's cost
+    analysis)."""
+    import jax
     from jax.experimental import pallas as pl
 
-    return pl.pallas_call(*args, interpret=interpret(), **kw)
+    kernel = args[0] if args else kw.get("kernel")
+    name = getattr(kernel, "__name__", None) or getattr(
+        getattr(kernel, "func", None), "__name__", "kernel")
+    inner = pl.pallas_call(*args, interpret=interpret(), **kw)
+
+    def scoped(*call_args, **call_kw):
+        with jax.named_scope(f"pallas_{name}"):
+            return inner(*call_args, **call_kw)
+
+    return scoped

@@ -159,11 +159,16 @@ def gpipe(stage_fn, mesh, axis: str = "pp", batch_axis=None,
                     lambda l: lax.ppermute(l, axis, perm), t)
 
             def step(x_in, handoff, t):
-                y = stage_fn(params, x_in)
+                # stage index is data-dependent (one trace runs on every
+                # pp rank), so the scope names the schedule phase; the
+                # stage body's own op scopes nest inside it
+                with jax.named_scope("gpipe_stage"):
+                    y = stage_fn(params, x_in)
                 mb = t - rank
                 active = (mb >= 0) & (mb < n_micro)
                 y = where(active, y, zero)
-                return ppermute(y, perm_fwd), y
+                with jax.named_scope("gpipe_handoff"):
+                    return ppermute(y, perm_fwd), y
 
             if scatter:
                 def tick(carry, t):
@@ -171,7 +176,8 @@ def gpipe(stage_fn, mesh, axis: str = "pp", batch_axis=None,
                     head = jax.tree.map(lambda c: c[0], conv)
                     x_in = where(rank == 0, head, handoff)
                     new_handoff, y = step(x_in, handoff, t)
-                    sent = ppermute(head, perm_conv)
+                    with jax.named_scope("gpipe_conveyor"):
+                        sent = ppermute(head, perm_conv)
                     conv = jax.tree.map(
                         lambda c, sv: jnp.concatenate(
                             [c[1:], sv[None]], axis=0), conv, sent)

@@ -19,8 +19,13 @@ from typing import Optional
 def profiler(state: str = "All", sorted_key: Optional[str] = None,
              profile_path: str = "/tmp/profile"):
     """Drop-in for fluid.profiler.profiler: captures a device+host trace
-    for the enclosed region.  `state`/`sorted_key` are accepted for API
-    parity; the trace contains both host and device activity."""
+    for the enclosed region.  With `sorted_key` set (fluid vocabulary:
+    "total"/"calls"/"max"/"min"/"ave"), prints the fluid per-op-type
+    time table after the trace stops — rows carry fluid op names
+    because the executor scopes every op lowering
+    (observe/trace.py parses the attribution back out).  `state` is
+    accepted for API parity; the trace contains both host and device
+    activity."""
     import jax
 
     os.makedirs(profile_path, exist_ok=True)
@@ -29,6 +34,33 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
         yield
     finally:
         jax.profiler.stop_trace()
+        if sorted_key:
+            print_profile_summary(profile_path, sorted_key)
+
+
+def print_profile_summary(profile_path: str = "/tmp/profile",
+                          sorted_key: str = "total"):
+    """Parse the newest captured trace under `profile_path` into the
+    per-fluid-op time table and print it.  Degrades to a notice (never
+    raises) when the trace has no parsable device events — profiling
+    must not take down the run it observes."""
+    from .observe import trace as _trace
+
+    try:
+        table = _trace.format_op_table(profile_path,
+                                       sorted_key=sorted_key)
+    except Exception as exc:  # noqa: BLE001 — diagnostics only
+        print(f"[profiler] trace summary unavailable: {exc}")
+        return
+    print(table)
+
+
+def profile_table(profile_path: str = "/tmp/profile"):
+    """Programmatic access to the per-op rows of the newest trace
+    (list of dicts: op_type/calls/total_ms/avg_ms/max_ms/min_ms/ratio)."""
+    from .observe import trace as _trace
+
+    return _trace.op_time_table(profile_path)
 
 
 @contextlib.contextmanager
@@ -54,6 +86,8 @@ def stop_profiler(sorted_key: Optional[str] = None,
     import jax
 
     jax.profiler.stop_trace()
+    if sorted_key:
+        print_profile_summary(profile_path, sorted_key)
 
 
 def cuda_profiler(*args, **kwargs):

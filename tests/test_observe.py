@@ -1,0 +1,256 @@
+"""Observability subsystem (paddle_tpu.observe): trace attribution,
+device-side StepTelemetry, compile/retrace accounting, run events.
+
+Locks in the architecture rules of docs/OBSERVE.md:
+- op scopes reach XLA HLO metadata (the trace-attribution pillar),
+- the telemetry accumulator lives INSIDE the one jitted step (no
+  callbacks in the lowering, survives chain_iterations with zero extra
+  dispatches),
+- a feed shape change on a cached step counts exactly one retrace,
+- the JSONL event log round-trips.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+
+
+def _linreg_program(batch_feed_names=("x", "y")):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, scope, loss
+
+
+def _feed(rng, n=8):
+    return {"x": rng.rand(n, 4).astype(np.float32),
+            "y": rng.rand(n, 1).astype(np.float32)}
+
+
+def test_named_scopes_reach_compiled_hlo_and_no_callbacks():
+    main, startup, scope, loss = _linreg_program()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fn, state, feeds = exe._prepare(
+            main, _feed(rng), [loss.name], scope, 1, True)
+        lowered = fn.lower(state, feeds)
+        stablehlo = lowered.as_text()
+        # the ONE-computation invariant: telemetry/observability must
+        # not introduce host round-trips
+        assert "callback" not in stablehlo
+        compiled_hlo = lowered.compile().as_text()
+    # every op lowering is scoped "<op_type>:<op_index>" and the scope
+    # survives into XLA's op metadata (what device traces attribute by)
+    for op_type in ("mul", "mean", "sgd"):
+        assert f"{op_type}:" in compiled_hlo, \
+            f"scope for {op_type!r} missing from compiled HLO metadata"
+
+
+def test_telemetry_accumulates_across_chained_iterations():
+    main, startup, scope, loss = _linreg_program()
+    observe.enable_telemetry(main)
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _feed(rng)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        # 4 more steps in ONE dispatch: the accumulator must ride the
+        # fori_loop carry, not a per-step host fetch
+        exe.run(main, feed=feed, fetch_list=[loss], iterations=4)
+    tel = observe.fetch_telemetry(scope)
+    assert tel.steps == 5
+    assert tel.loss_mean > 0.0
+    assert tel.grad_norm_mean > 0.0
+    assert tel.update_norm_mean > 0.0
+    assert tel.healthy
+    # the lowered telemetry-enabled step is still callback-free
+    with fluid.scope_guard(scope):
+        fn, state, feeds = exe._prepare(
+            main, _feed(rng), [loss.name], scope, 4, True)
+        assert "callback" not in fn.lower(state, feeds).as_text()
+    # fetch(reset=True) starts a fresh window
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=_feed(rng), fetch_list=[loss])
+    tel2 = observe.fetch_telemetry(scope)
+    assert tel2.steps == 1
+
+
+def test_telemetry_counts_nonfinite_loss_and_grads():
+    main, startup, scope, loss = _linreg_program()
+    observe.enable_telemetry(main)
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        bad = _feed(rng)
+        bad["x"][0, 0] = np.nan
+        exe.run(main, feed=bad, fetch_list=[loss])
+    tel = observe.fetch_telemetry(scope)
+    assert tel.steps == 1
+    assert tel.nonfinite_loss_steps == 1
+    assert tel.nonfinite_grad_steps == 1
+    assert not tel.healthy
+
+
+def test_telemetry_off_is_zero_footprint():
+    main, startup, scope, loss = _linreg_program()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=_feed(rng), fetch_list=[loss])
+    assert scope.find_var(observe.TELEMETRY_VAR) is None
+    assert observe.fetch_telemetry(scope) is None
+
+
+def test_retrace_counter_increments_exactly_once_on_shape_change():
+    main, startup, scope, loss = _linreg_program()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=_feed(rng, 8), fetch_list=[loss])
+        snap = observe.runtime_stats.snapshot()
+        # same signature: cached, no retrace
+        exe.run(main, feed=_feed(rng, 8), fetch_list=[loss])
+        assert observe.runtime_stats.delta(snap)["retraces"] == 0
+        # new batch size = new jit signature = exactly one retrace
+        exe.run(main, feed=_feed(rng, 6), fetch_list=[loss])
+        d = observe.runtime_stats.delta(snap)
+        assert d["retraces"] == 1
+        # seen signature again: still one
+        exe.run(main, feed=_feed(rng, 6), fetch_list=[loss])
+        assert observe.runtime_stats.delta(snap)["retraces"] == 1
+
+
+def test_compile_accounting_sees_backend_compiles():
+    main, startup, scope, loss = _linreg_program()
+    rng = np.random.RandomState(1)
+    snap = observe.runtime_stats.snapshot()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=_feed(rng), fetch_list=[loss])
+    d = observe.runtime_stats.delta(snap)
+    assert d["compiles"] >= 1
+    assert d["compile_time_s"] > 0.0
+    assert d["builds"] >= 1
+    assert d["dispatches"] >= 1
+
+
+def test_event_log_roundtrip(tmp_path):
+    path = os.path.join(str(tmp_path), "events.jsonl")
+    with observe.RunEventLog(path, mesh_shape={"dp": 8}) as log:
+        rid = log.run_id
+        log.event("checkpoint", serial=3, epoch=1)
+        log.telemetry_window({"steps": 10, "loss_mean": 0.5},
+                             retraces=0)
+    events = observe.read_events(path)
+    kinds = [e["event"] for e in events]
+    assert kinds == ["run_begin", "checkpoint", "telemetry", "run_end"]
+    assert all(e["run_id"] == rid for e in events)
+    begin = events[0]
+    assert "git_sha" in begin and "argv" in begin
+    assert begin["mesh_shape"] == {"dp": 8}
+    assert events[2]["steps"] == 10 and events[2]["retraces"] == 0
+    # a torn final line (killed writer) is tolerated; corruption in the
+    # middle is not
+    with open(path, "a") as f:
+        f.write('{"ts": 1, "run_id"')
+    assert len(observe.read_events(path)) == 4
+    with open(path, "a") as f:
+        f.write('\n{"ok": true}\n')
+    with pytest.raises(json.JSONDecodeError):
+        observe.read_events(path)
+
+
+def test_fluid_op_of_scope_parsing():
+    assert observe.fluid_op_of("jit(step)/mul:3/dot_general") == "mul"
+    assert observe.fluid_op_of(
+        "jit(step)/while/body/conv2d:12/convolution") == "conv2d"
+    # innermost scope wins (nested macro op -> sub-block op)
+    assert observe.fluid_op_of("jit(f)/while_op:2/mul:7/mul") == "mul"
+    assert observe.fluid_op_of("jit(f)/transpose/no_scope_here") is None
+
+
+def test_trace_summary_attributes_fluid_ops(tmp_path, capsys):
+    """End-to-end pillar 1: run a step under profiler.profiler(), then
+    the parsed per-op table must attribute device time to fluid op
+    types (XLA:CPU emits per-instruction events, so this works on the
+    test backend)."""
+    from paddle_tpu import profiler
+
+    main, startup, scope, loss = _linreg_program()
+    rng = np.random.RandomState(0)
+    trace_dir = os.path.join(str(tmp_path), "trace")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _feed(rng)
+        exe.run(main, feed=feed, fetch_list=[loss])  # compile outside
+        with profiler.profiler(sorted_key="total",
+                               profile_path=trace_dir):
+            exe.run(main, feed=feed, fetch_list=[loss])
+    printed = capsys.readouterr().out
+    assert "Profiling Report" in printed
+    rows = profiler.profile_table(trace_dir)
+    assert rows, "no attributable device events parsed from trace"
+    ops = {r["op_type"] for r in rows}
+    fluid_ops = ops - {"[unattributed]"}
+    assert fluid_ops, f"no fluid-op attribution in {ops}"
+    for r in rows:
+        assert r["calls"] >= 1
+        assert r["total_ms"] >= 0.0
+        assert 0.0 <= r["ratio"] <= 1.0
+
+
+def test_trainer_telemetry_hook(tmp_path):
+    from paddle_tpu.contrib import Trainer
+
+    log_path = os.path.join(str(tmp_path), "run.jsonl")
+
+    def train_func():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    trainer = Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGDOptimizer(
+            learning_rate=0.05),
+        telemetry=observe.TelemetryConfig(interval=2, log_path=log_path))
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(5):
+            yield _feed(rng)
+
+    trainer.train(num_epochs=1, reader=reader)
+    trainer.stop()
+    assert trainer.last_telemetry is not None
+    events = observe.read_events(log_path)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_begin"
+    assert "train_begin" in kinds and "train_end" in kinds
+    windows = [e for e in events if e["event"] == "telemetry"]
+    # 5 steps at interval 2 -> two full windows + the final flush of 1
+    assert [w["steps"] for w in windows] == [2, 2, 1]
+    for w in windows:
+        assert w["loss_mean"] > 0.0
+        assert "retraces" in w and "compile_time_s" in w
+    assert windows[0]["epoch"] == 0

@@ -34,6 +34,8 @@ MODULES = [
     "paddle_tpu.data.data_feed",
     "paddle_tpu.contrib",
     "paddle_tpu.imperative",
+    "paddle_tpu.observe",
+    "paddle_tpu.profiler",
 ]
 
 

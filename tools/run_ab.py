@@ -64,6 +64,25 @@ VARIANTS = [
 ]
 
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tag():
+    """This invocation's provenance stamp (paddle_tpu.observe.events).
+    Falls back to a bare uuid when the package can't import (foreign
+    checkout) — the tag must exist either way."""
+    try:
+        if _ROOT not in sys.path:
+            sys.path.insert(0, _ROOT)
+        from paddle_tpu.observe.events import git_sha, new_run_id
+
+        return {"run_id": new_run_id(), "git_sha": git_sha(_ROOT)}
+    except Exception:  # noqa: BLE001 — provenance must not kill the run
+        import uuid
+
+        return {"run_id": uuid.uuid4().hex[:12], "git_sha": None}
+
+
 def run_variant(args, extra):
     cmd = [sys.executable, "bench.py", "--steps", str(args.steps)] + extra
     t0 = time.time()
@@ -88,12 +107,34 @@ def run_variant(args, extra):
     return out
 
 
+# variant key -> the bench model its argv requests; the throughput
+# lookup below must read THAT model's detail entry, not whatever dict
+# order yields first (ADVICE r5: a longctx line carrying an extra
+# sub-entry would have fed the wrong model's tok/s into the summary)
+_VARIANT_MODEL = {
+    key: argv[argv.index("--model") + 1]
+    for key, argv in VARIANTS if "--model" in argv
+}
+
+
+def _model_entries(detail, model):
+    """Sub-entries belonging to `model`: exact key or model-prefixed
+    (bench keys resolved shapes into names like longctx_8k,
+    resnet50_frozen)."""
+    return [sub for name, sub in detail.items()
+            if isinstance(sub, dict)
+            and (name == model or name.startswith(model + "_"))]
+
+
 def measure(results, k):
     """Comparable scalar for variant k, or None for NO DATA.
 
     A failed bench prints {"metric": "bench_failed", "value": 0.0}
     (and run_variant itself may record {"error": ...}): both are NO
     DATA, never a 0.0 that hands the other side a vacuous win.
+    The lookup is keyed by the variant's EXPECTED model (falling back
+    to a sole sub-entry for foreign/legacy artifacts): multi-entry
+    details must never contribute another model's number.
     Prefers THROUGHPUT over MFU: variants can carry different MFU
     numerators (the program's own XLA count vs the dense-equivalent
     twin for Pallas/remat configs), and the r05 chip session caught
@@ -105,12 +146,21 @@ def measure(results, k):
     if "error" in d or "failed" in d or \
             d.get("metric") == "bench_failed":
         return None
-    for sub in (d.get("detail") or {}).values():
-        if isinstance(sub, dict):
-            for key in ("tokens_per_sec", "imgs_per_sec",
-                        "examples_per_sec"):
-                if key in sub:
-                    return sub[key]
+    detail = d.get("detail") or {}
+    model = _VARIANT_MODEL.get(k)
+    if model is not None:
+        subs = _model_entries(detail, model)
+    else:
+        # unknown variant key (hand-rolled artifact): only an
+        # unambiguous single-entry detail is trustworthy
+        subs = [sub for sub in detail.values() if isinstance(sub, dict)]
+        if len(subs) != 1:
+            return None
+    for sub in subs:
+        for key in ("tokens_per_sec", "imgs_per_sec",
+                    "examples_per_sec"):
+            if key in sub:
+                return sub[key]
     return None
 
 
@@ -146,23 +196,48 @@ def main():
                    help="comma-separated variant keys to run")
     args = p.parse_args()
 
+    run_tag = _run_tag()
     results = {}
     if args.only and os.path.exists(args.out):
         # selective re-run (post-fix retest): keep the other variants'
-        # recorded entries, replace only the re-run ones
-        with open(args.out) as f:
-            results = {k: v for k, v in json.load(f).items()
-                       if k != "summary"}
+        # recorded entries, replace only the re-run ones.  A corrupt
+        # artifact (torn write from a killed run) must not crash the
+        # retest — start fresh and say so.
+        try:
+            with open(args.out) as f:
+                loaded = json.load(f)
+            if not isinstance(loaded, dict):
+                raise ValueError(f"expected a dict, got "
+                                 f"{type(loaded).__name__}")
+        except (OSError, ValueError) as e:
+            print(f"warning: existing {args.out} unreadable ({e}); "
+                  f"starting fresh", file=sys.stderr)
+            loaded = {}
+        results = {k: v for k, v in loaded.items() if k != "summary"}
+        # auditability: every kept entry must say which run produced it;
+        # pre-observability artifacts get an explicit unknown marker
+        for v in results.values():
+            if isinstance(v, dict) and "run_id" not in v:
+                v["run_id"] = None
+                v["merged_pre_provenance"] = True
     for key, extra in VARIANTS:
         if args.only and key not in args.only.split(","):
             continue
         print(f"=== {key}: bench.py {' '.join(extra)}", file=sys.stderr)
-        results[key] = run_variant(args, extra)
+        out = run_variant(args, extra)
+        # the bench line already carries its own run_id/git_sha when the
+        # bench ran far enough to print one; error entries get this
+        # invocation's tag so they are attributable too
+        out.setdefault("run_id", run_tag["run_id"])
+        out.setdefault("git_sha", run_tag["git_sha"])
+        results[key] = out
         print(json.dumps({key: results[key]}), file=sys.stderr)
         with open(args.out, "w") as f:
             json.dump(results, f, indent=1)
 
     summary = compute_summary(results)
+    summary["run_id"] = run_tag["run_id"]
+    summary["git_sha"] = run_tag["git_sha"]
     results["summary"] = summary
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)

@@ -19,4 +19,24 @@ echo "== multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
+echo "== telemetry bench smoke (cpu) =="
+# every bench JSON line must carry the observe fields
+# (compile_s/retraces/peak_mem_bytes + run provenance) — docs/OBSERVE.md
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "deepfm", "--batch", "64",
+     "--steps", "2", "--warmup", "1", "--probe-timeout", "0"],
+    capture_output=True, text=True, timeout=900)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+for field in ("compile_s", "retraces", "peak_mem_bytes", "run_id",
+              "git_sha"):
+    assert field in out, f"bench line missing {field!r}: {sorted(out)}"
+assert out["compile_s"] > 0, out["compile_s"]
+print("telemetry smoke OK:",
+      {k: out[k] for k in ("compile_s", "retraces", "peak_mem_bytes")})
+EOF
+
 echo "CI OK"
