@@ -687,6 +687,10 @@ class Executor:
         # signature on an already-built step fn means jax will retrace
         # and recompile it — counted as a retrace (observe pillar 2)
         self._sig_seen: Dict[Any, set] = {}
+        # AOT-compiled steps for cost analysis / optimized-HLO access
+        # (compiled_step): memoized so cost_analysis + observe.cost on
+        # the same program pay one extra compile, not two
+        self._aot_cache: Dict[Any, Any] = {}
         from ..observe import monitoring as _obs_monitoring
 
         _obs_monitoring.install()
@@ -743,23 +747,45 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._aot_cache.clear()
+
+    def compiled_step(self, program: Program, feed=None, fetch_list=None,
+                      scope: Optional[Scope] = None):
+        """AOT-compile the one-iteration step and return the jax
+        Compiled object (cost_analysis(), as_text(), the optimized HLO
+        module via observe.cost.compiled_hlo_proto).  One extra XLA
+        compile beyond run()'s own jit cache (the jit-internal
+        executable is not introspectable); the traced step fn itself is
+        shared via the program cache, and the Compiled is memoized per
+        (program, feed-signature) so cost_analysis + observe.cost on
+        the same step compile once."""
+        feed = dict(feed or {})
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        fn, state, feed_arrays = self._prepare(
+            program, feed, fetch_names, scope or global_scope(), 1, True)
+        key = (program._uid, program._version, tuple(sorted(feed)),
+               tuple(fetch_names),
+               tuple((n, tuple(getattr(v, "shape", ()) or ()),
+                      str(getattr(v, "dtype", type(v).__name__)))
+                     for n, v in sorted(feed_arrays.items())))
+        compiled = self._aot_cache.get(key)
+        if compiled is None:
+            compiled = fn.lower(state, feed_arrays).compile()
+            self._aot_cache[key] = compiled
+        return compiled
 
     def cost_analysis(self, program: Program, feed=None, fetch_list=None,
                       scope: Optional[Scope] = None):
         """XLA cost analysis of the compiled one-iteration step (flops,
         bytes accessed).  TPU analog of the reference profiler's per-op
         accounting — here the unit is the whole fused step.  Returns the
-        backend's dict (keys like 'flops', 'bytes accessed').  Note: the
-        analysis needs an AOT `.lower().compile()`, one extra XLA compile
-        beyond run()'s own jit cache (the jit-internal executable is not
-        introspectable); the traced step fn itself is shared via the
-        program cache."""
-        feed = dict(feed or {})
-        fetch_names = [f.name if isinstance(f, Variable) else str(f)
-                       for f in (fetch_list or [])]
-        fn, state, feed_arrays = self._prepare(
-            program, feed, fetch_names, scope or global_scope(), 1, True)
-        compiled = fn.lower(state, feed_arrays).compile()
+        backend's dict (keys like 'flops', 'bytes accessed').  Note:
+        XLA's aggregate 'bytes accessed' overcounts real HBM traffic
+        and Pallas custom calls report zero flops — observe.cost holds
+        the analytic per-op accounting built on the same compile."""
+        compiled = self.compiled_step(program, feed=feed,
+                                      fetch_list=fetch_list, scope=scope)
         analyses = compiled.cost_analysis()
         # PJRT returns one dict (or a list with one per executable)
         if isinstance(analyses, (list, tuple)):

@@ -18,8 +18,18 @@ Three pillars (docs/OBSERVE.md):
 3. STRUCTURED RUN EVENTS — `RunEventLog` writes JSONL records with
    run-id/git-sha/backend/mesh provenance, consumed by
    contrib.Trainer(telemetry=...), bench.py, and tools/run_ab.py.
+
+4. COST ATTRIBUTION — `cost.py` walks the *optimized* HLO module with
+   the same wire scanner, computing analytic per-instruction flops and
+   materialized-buffer bytes, injecting the Pallas kernel cost
+   registry at custom calls, and joining to fluid ops + measured
+   device time (`op_cost_table`); tools/roofline.py and bench.py's
+   Pallas MFU numerators are built on it.
 """
 
+from . import cost  # noqa: F401
+from .cost import (bucket_summary, device_peaks,  # noqa: F401
+                   format_cost_table, op_cost_table, program_costs)
 from .events import RunEventLog, git_sha, new_run_id, read_events  # noqa: F401
 from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
                       enable_telemetry, fetch_telemetry, init_telemetry,

@@ -1,0 +1,715 @@
+"""Per-op cost attribution: analytic flop/byte accounting over the
+*optimized* HLO module, joined to fluid ops and measured device time.
+
+Why XLA's own aggregates are not enough (the r05 roofline lesson):
+
+- `cost_analysis()["bytes accessed"]` OVERCOUNTS real HBM traffic —
+  per-instruction estimates inside fusions are summed with utilization
+  heuristics, which produced the impossible ROOFLINE_r05 result of an
+  MFU "ceiling" (0.269) below an actually measured MFU (0.309).
+- Pallas custom calls report ZERO flops, forcing bench.py's
+  dense-twin workaround for every Pallas-active config.
+- The aggregate has no attribution: r05's longctx device profile found
+  ~15.9 s of copy/transpose against ~5.0 s of flash-kernel time only
+  by manual trace reading.
+
+This module recomputes both sides analytically from the optimized
+HloModuleProto (read with trace.py's dependency-free wire scanner):
+
+- FLOPS: contraction math for dot (exact vs XLA's count) and
+  convolution (exact for VALID padding; a small overcount at padded
+  edges), 1 flop/element for elementwise arithmetic, reduction sizes
+  for reduce/reduce-window, recursive descent into fusions and called
+  computations.  Transcendentals (exp/log/tanh/...) are tallied
+  separately, matching XLA's flops-vs-transcendentals split.
+- BYTES: the *materialized-buffers* model — after optimization each
+  entry-computation instruction is one kernel that reads its operands
+  from HBM once and writes its output once; fusion internals move no
+  HBM bytes.  This is a minimum-traffic model: reuse inside a kernel
+  is free, multiple uses of one buffer by one kernel count once.  A
+  roofline built on it can only be MORE permissive than reality, so a
+  ceiling can never fall below an honest measurement again.
+- ATTRIBUTION: each instruction's `metadata.op_name` carries the
+  executor's `<op_type>:<op_index>` named scopes (observe pillar 1),
+  so every cost lands on a fluid op; each instruction is also binned
+  into a BUCKET — matmul / conv / elementwise / layout (copy +
+  transpose + bitcast-convert, the r05 longctx finding as a standard
+  diagnostic) / comm / custom_call.
+- PALLAS: custom calls whose scope names a registered kernel
+  (`ops/pallas` KERNEL_COSTS, populated next to each kernel's
+  DEFAULT_BLOCK_*) get that kernel's declared dense-equivalent
+  (flops, bytes) injected at the instruction, so Pallas-active
+  programs compute MFU numerators natively (tools/check_twin_flops.py
+  asserts registry-vs-dense-twin parity).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .trace import _fields, _first, _utf8, fluid_op_of
+
+# --------------------------------------------------------------------------
+# device peaks (shared by tools/roofline.py and op_cost_table)
+# --------------------------------------------------------------------------
+
+# bf16 MXU peak FLOP/s and HBM bandwidth by device kind prefix
+DEVICE_PEAKS = {
+    "TPU v4": (275e12, 1228e9),
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v5": (459e12, 2765e9),
+    "TPU v6 lite": (918e12, 1640e9),
+    "TPU v6e": (918e12, 1640e9),
+}
+
+
+def device_peaks(kind: Optional[str] = None):
+    """(peak_flops, hbm_bw) for a device kind, or (None, None) when the
+    kind is unknown (CPU test backend) — callers must treat None as
+    "no roofline denominator", never assume a default chip."""
+    if kind is None:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    for prefix, peaks in DEVICE_PEAKS.items():
+        if kind.startswith(prefix):
+            return peaks
+    return None, None
+
+
+# --------------------------------------------------------------------------
+# HloModuleProto parsing (field numbers are stable in xla/service/hlo.proto)
+# --------------------------------------------------------------------------
+
+# HloModuleProto:      name=1 entry_computation_name=2 computations=3
+#                      id=5 entry_computation_id=6
+# HloComputationProto: name=1 instructions=2 id=5 root_id=6
+# HloInstructionProto: name=1 opcode=2 shape=3 metadata=7 window=15
+#                      convolution_dimension_numbers=16
+#                      custom_call_target=28 dot_dimension_numbers=30
+#                      id=35 operand_ids=36 called_computation_ids=38
+#                      feature_group_count=50
+# ShapeProto:          element_type=2 dimensions=3 tuple_shapes=4
+# OpMetadata:          op_type=1 op_name=2
+# DotDimensionNumbers: lhs_contracting=1 rhs_contracting=2 lhs_batch=3
+#                      rhs_batch=4
+# Window/WindowDimension: dimensions=1 / size=1 stride=2
+
+_ELEM_BYTES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 2, 8: 4, 9: 8,
+               10: 2, 11: 4, 12: 8, 15: 8, 16: 2, 18: 16, 19: 1, 20: 1,
+               21: 1, 22: 1, 23: 1, 24: 1, 25: 1}
+
+
+def _varints(v) -> List[int]:
+    """Decode a repeated int64 field: packed (bytes of varints) or a
+    single already-decoded varint."""
+    if isinstance(v, int):
+        return [v]
+    out, i, n = [], 0, len(v)
+    while i < n:
+        x = s = 0
+        while True:
+            b = v[i]
+            i += 1
+            x |= (b & 0x7F) << s
+            if not b & 0x80:
+                break
+            s += 7
+        out.append(x)
+    return out
+
+
+def _repeated_ints(buf: bytes, fno: int) -> List[int]:
+    out: List[int] = []
+    for f, _wt, v in _fields(buf):
+        if f == fno:
+            out.extend(_varints(v))
+    return out
+
+
+class Shape:
+    __slots__ = ("element_type", "dims", "tuple_shapes")
+
+    def __init__(self, buf: Optional[bytes]):
+        self.element_type = 0
+        self.dims: List[int] = []
+        self.tuple_shapes: List["Shape"] = []
+        if not buf:
+            return
+        for f, _wt, v in _fields(buf):
+            if f == 2:
+                self.element_type = v
+            elif f == 3:
+                self.dims.extend(_varints(v))
+            elif f == 4:
+                self.tuple_shapes.append(Shape(v))
+
+    @property
+    def elements(self) -> int:
+        if self.tuple_shapes:
+            return sum(s.elements for s in self.tuple_shapes)
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def bytes(self) -> int:
+        if self.tuple_shapes:
+            return sum(s.bytes for s in self.tuple_shapes)
+        return self.elements * _ELEM_BYTES.get(self.element_type, 0)
+
+    @property
+    def elem_bytes(self) -> int:
+        return _ELEM_BYTES.get(self.element_type, 0)
+
+
+class Instr:
+    __slots__ = ("name", "opcode", "shape", "op_name", "id",
+                 "operand_ids", "called_ids", "dot_dnums_buf",
+                 "window_buf", "conv_dnums_buf", "feature_group_count",
+                 "custom_call_target")
+
+    def __init__(self, buf: bytes):
+        self.name = ""
+        self.opcode = ""
+        self.shape = Shape(None)
+        self.op_name = ""
+        self.id = 0
+        self.operand_ids: List[int] = []
+        self.called_ids: List[int] = []
+        self.dot_dnums_buf = b""
+        self.window_buf = b""
+        self.conv_dnums_buf = b""
+        self.feature_group_count = 1
+        self.custom_call_target = ""
+        for f, _wt, v in _fields(buf):
+            if f == 1:
+                self.name = _utf8(v)
+            elif f == 2:
+                self.opcode = _utf8(v)
+            elif f == 3:
+                self.shape = Shape(v)
+            elif f == 7:
+                self.op_name = _utf8(_first(v, 2, b""))
+            elif f == 15:
+                self.window_buf = v
+            elif f == 16:
+                self.conv_dnums_buf = v
+            elif f == 28:
+                self.custom_call_target = _utf8(v)
+            elif f == 30:
+                self.dot_dnums_buf = v
+            elif f == 35:
+                self.id = v
+            elif f == 36:
+                self.operand_ids.extend(_varints(v))
+            elif f == 38:
+                self.called_ids.extend(_varints(v))
+            elif f == 50:
+                self.feature_group_count = max(int(v), 1)
+
+
+class Computation:
+    __slots__ = ("name", "id", "root_id", "instructions", "by_id")
+
+    def __init__(self, buf: bytes):
+        self.name = ""
+        self.id = 0
+        self.root_id = 0
+        self.instructions: List[Instr] = []
+        for f, _wt, v in _fields(buf):
+            if f == 1:
+                self.name = _utf8(v)
+            elif f == 2:
+                self.instructions.append(Instr(v))
+            elif f == 5:
+                self.id = v
+            elif f == 6:
+                self.root_id = v
+        self.by_id = {i.id: i for i in self.instructions}
+
+    @property
+    def root(self) -> Optional[Instr]:
+        return self.by_id.get(self.root_id) or (
+            self.instructions[-1] if self.instructions else None)
+
+
+class HloModule:
+    def __init__(self, proto: bytes):
+        # accept either a bare HloModuleProto or an HloProto wrapper
+        # (hlo_module=1) — traces embed the wrapper, runtime
+        # executables hand out the bare module
+        if _first(proto, 2) is None and _first(proto, 1) is not None:
+            inner = _first(proto, 1)
+            if isinstance(inner, bytes) and _first(inner, 3) is not None:
+                proto = inner
+        self.entry_id = _first(proto, 6, 0)
+        self.computations: Dict[int, Computation] = {}
+        for f, _wt, v in _fields(proto):
+            if f == 3:
+                comp = Computation(v)
+                self.computations[comp.id] = comp
+
+    @property
+    def entry(self) -> Computation:
+        if self.entry_id in self.computations:
+            return self.computations[self.entry_id]
+        # fall back: the computation with the largest id is the entry
+        # in XLA's numbering
+        return self.computations[max(self.computations)]
+
+
+# --------------------------------------------------------------------------
+# analytic flop model (mirrors xla HloCostAnalysis conventions)
+# --------------------------------------------------------------------------
+
+_TRANSCENDENTAL = {
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "logistic", "tanh", "sine", "cosine", "tan", "sqrt", "rsqrt",
+    "cbrt", "atan2", "power", "erf",
+}
+
+# elementwise arithmetic XLA counts at 1 flop/element
+_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "compare", "select", "clamp", "and", "or", "xor", "not", "negate",
+    "abs", "sign", "floor", "ceil", "round-nearest-afz",
+    "round-nearest-even", "remainder", "shift-left",
+    "shift-right-arithmetic", "shift-right-logical", "is-finite",
+    "count-leading-zeros", "popcnt", "convert", "real", "imag",
+    "complex", "stochastic-convert",
+}
+
+# pure data movement / bookkeeping: zero flops AND (except where they
+# appear at the entry level) no modeled HBM traffic of their own
+_NO_BYTES = {"parameter", "constant", "tuple", "get-tuple-element",
+             "bitcast", "after-all", "partition-id", "replica-id",
+             "domain", "opt-barrier", "optimization-barrier"}
+
+_COMM = {"all-reduce", "all-gather", "all-to-all", "collective-permute",
+         "collective-broadcast", "reduce-scatter", "send", "recv",
+         "send-done", "recv-done", "all-reduce-start", "all-reduce-done",
+         "all-gather-start", "all-gather-done",
+         "collective-permute-start", "collective-permute-done"}
+
+_LAYOUT = {"copy", "transpose", "bitcast-convert", "copy-start",
+           "copy-done", "reshape"}
+
+
+def _dot_flops(instr: Instr, operands: List[Instr]) -> float:
+    # fma(2) * output elements * contracted width — identical to
+    # HloCostAnalysis::HandleDot
+    k = 1
+    if instr.dot_dnums_buf and operands:
+        lhs_contract = _repeated_ints(instr.dot_dnums_buf, 1)
+        lhs_dims = operands[0].shape.dims
+        for dim in lhs_contract:
+            if dim < len(lhs_dims):
+                k *= lhs_dims[dim]
+    return 2.0 * instr.shape.elements * k
+
+
+def _conv_flops(instr: Instr, operands: List[Instr]) -> float:
+    # fma(2) * output elements * kernel spatial size * input features
+    # per group.  Exact for VALID padding; overcounts clipped window
+    # positions at padded edges (small for large feature maps).
+    if len(operands) < 2:
+        return 0.0
+    kernel = operands[1].shape.dims
+    spatial = _repeated_ints(instr.conv_dnums_buf, 6)
+    kin = _repeated_ints(instr.conv_dnums_buf, 3)
+    window = 1
+    for dim in spatial:
+        if dim < len(kernel):
+            window *= kernel[dim]
+    cin = kernel[kin[0]] if kin and kin[0] < len(kernel) else 1
+    return 2.0 * instr.shape.elements * window * cin
+
+
+def _reduce_ops(module: HloModule, instr: Instr) -> int:
+    """Flop-bearing instruction count of a reduce/scatter computation
+    (1 for add/max — the common case)."""
+    n = 0
+    for cid in instr.called_ids:
+        comp = module.computations.get(cid)
+        if not comp:
+            continue
+        n += sum(1 for i in comp.instructions
+                 if i.opcode in _ELEMENTWISE or i.opcode in _TRANSCENDENTAL)
+    return max(n, 1)
+
+
+def _computation_flops(module: HloModule, comp: Computation,
+                       seen: Optional[set] = None) -> Tuple[float, float]:
+    """(flops, transcendentals) of every instruction in `comp`,
+    descending into fusions/calls (cycle-safe)."""
+    seen = set() if seen is None else seen
+    if comp.id in seen:
+        return 0.0, 0.0
+    seen.add(comp.id)
+    flops = transc = 0.0
+    for instr in comp.instructions:
+        f, t = _instr_flops(module, comp, instr, seen)
+        flops += f
+        transc += t
+    return flops, transc
+
+
+def _instr_flops(module: HloModule, comp: Computation, instr: Instr,
+                 seen: Optional[set] = None) -> Tuple[float, float]:
+    op = instr.opcode
+    elems = instr.shape.elements
+    operands = [comp.by_id[i] for i in instr.operand_ids
+                if i in comp.by_id]
+    if op == "dot":
+        return _dot_flops(instr, operands), 0.0
+    if op == "convolution":
+        return _conv_flops(instr, operands), 0.0
+    if op in _TRANSCENDENTAL:
+        return 0.0, float(elems)
+    if op in _ELEMENTWISE:
+        return float(elems), 0.0
+    if op == "reduce":
+        in_elems = operands[0].shape.elements if operands else 0
+        return (max(in_elems - elems, 0) * _reduce_ops(module, instr),
+                0.0)
+    if op in ("reduce-window", "select-and-scatter"):
+        window = 1
+        for wd, _wt, v in _fields(instr.window_buf):
+            if wd == 1:
+                window *= _first(v, 1, 1)
+        return float(elems) * window * _reduce_ops(module, instr), 0.0
+    if op == "scatter":
+        upd = operands[-1].shape.elements if operands else 0
+        return float(upd) * _reduce_ops(module, instr), 0.0
+    if op in ("fusion", "call", "while", "conditional", "async-start"):
+        flops = transc = 0.0
+        for cid in instr.called_ids:
+            sub = module.computations.get(cid)
+            if sub is not None:
+                f, t = _computation_flops(module, sub, seen)
+                flops += f
+                transc += t
+        return flops, transc
+    # custom-call: zero here; the Pallas registry injects at a higher
+    # level so callers can see xla-vs-registry flops separately
+    return 0.0, 0.0
+
+
+# --------------------------------------------------------------------------
+# Pallas kernel cost registry injection
+# --------------------------------------------------------------------------
+
+_PALLAS_SCOPE_RE = re.compile(r"pallas_([A-Za-z0-9_]+)")
+
+
+def _pallas_kernel_of(op_name: str) -> Optional[str]:
+    """Registered kernel name from an instruction's op_name scope, or
+    None when the custom call is not a scoped Pallas kernel."""
+    m = _PALLAS_SCOPE_RE.search(op_name or "")
+    return m.group(1) if m else None
+
+
+def _registry_cost(kernel: str, instr: Instr, operands: List[Instr]):
+    """(flops, bytes|None) declared by the kernel module, or None when
+    the kernel has no registered cost."""
+    from ..ops import pallas as pallas_pkg
+
+    fn = pallas_pkg.KERNEL_COSTS.get(kernel)
+    if fn is None:
+        return None
+    op_shapes = [(tuple(o.shape.dims), o.shape.elem_bytes)
+                 for o in operands]
+    res = instr.shape
+    res_shapes = ([(tuple(s.dims), s.elem_bytes)
+                   for s in res.tuple_shapes]
+                  if res.tuple_shapes else [(tuple(res.dims),
+                                             res.elem_bytes)])
+    return fn(op_shapes, res_shapes)
+
+
+# --------------------------------------------------------------------------
+# per-instruction cost rows + bucketing
+# --------------------------------------------------------------------------
+
+def _bucket(module: HloModule, instr: Instr) -> str:
+    op = instr.opcode
+    if op == "custom-call":
+        return "custom_call"
+    if op == "dot":
+        return "matmul"
+    if op == "convolution":
+        return "conv"
+    if op in _COMM:
+        return "comm"
+    if op == "fusion":
+        ops_inside = set()
+        root_op = None
+        for cid in instr.called_ids:
+            sub = module.computations.get(cid)
+            if sub is None:
+                continue
+            ops_inside.update(i.opcode for i in sub.instructions)
+            if root_op is None and sub.root is not None:
+                root_op = sub.root.opcode
+        if "dot" in ops_inside:
+            return "matmul"
+        if "convolution" in ops_inside:
+            return "conv"
+        if root_op in _LAYOUT:
+            return "layout"
+        return "elementwise"
+    if op in _LAYOUT:
+        return "layout"
+    if op in _NO_BYTES:
+        return "noop"
+    return "elementwise"
+
+
+def instruction_costs(proto: bytes) -> List[Dict[str, Any]]:
+    """Analytic per-instruction cost rows for the module's entry
+    computation (one row per post-fusion kernel).
+
+    Row keys: name, opcode, op_type (fluid attribution or None),
+    bucket, flops, transcendentals, bytes, pallas_kernel (set when a
+    registered Pallas kernel's cost was injected at a custom call).
+    `flops` already includes the injected registry flops; `xla_flops`
+    carries the pre-injection analytic count.
+    """
+    # force kernel-cost registration before walking custom calls
+    from ..ops.pallas import flash_attention as _fa  # noqa: F401
+    from ..ops.pallas import vocab_ce as _vc  # noqa: F401
+
+    module = HloModule(proto)
+    entry = module.entry
+    rows: List[Dict[str, Any]] = []
+    for instr in entry.instructions:
+        operands = [entry.by_id[i] for i in instr.operand_ids
+                    if i in entry.by_id]
+        flops, transc = _instr_flops(module, entry, instr)
+        bucket = _bucket(module, instr)
+        if instr.opcode in _NO_BYTES:
+            nbytes = 0
+        else:
+            # materialized-buffers model: unique operands read once,
+            # output written once; operands that are themselves
+            # bookkeeping (tuple/gte wrapping a buffer) still stand in
+            # for one read of their underlying buffer size
+            seen_ids = set()
+            nbytes = instr.shape.bytes
+            for o in operands:
+                if o.id in seen_ids:
+                    continue
+                seen_ids.add(o.id)
+                nbytes += o.shape.bytes
+        row = {
+            "name": instr.name,
+            "opcode": instr.opcode,
+            "op_type": fluid_op_of(instr.op_name),
+            "bucket": bucket,
+            "flops": flops,
+            "xla_flops": flops,
+            "transcendentals": transc,
+            "bytes": float(nbytes),
+            "pallas_kernel": None,
+        }
+        if instr.opcode == "custom-call":
+            kernel = _pallas_kernel_of(instr.op_name)
+            if kernel is not None:
+                cost = _registry_cost(kernel, instr, operands)
+                if cost is not None:
+                    kflops, kbytes = cost
+                    row["pallas_kernel"] = kernel
+                    row["flops"] = float(kflops)
+                    if kbytes is not None:
+                        row["bytes"] = float(kbytes)
+        rows.append(row)
+    return rows
+
+
+def total_costs(proto: bytes) -> Dict[str, Any]:
+    """Whole-program totals over `instruction_costs`.
+
+    flops = analytic flops INCLUDING injected Pallas registry costs;
+    `pallas_flops` is the injected share, `custom_calls` /
+    `pallas_matched` make an unmatched (uncounted) custom call visible
+    instead of silently reading as zero flops."""
+    rows = instruction_costs(proto)
+    custom = [r for r in rows if r["opcode"] == "custom-call"]
+    matched = [r for r in custom if r["pallas_kernel"]]
+    return {
+        "flops": sum(r["flops"] for r in rows),
+        "transcendentals": sum(r["transcendentals"] for r in rows),
+        "bytes": sum(r["bytes"] for r in rows),
+        "pallas_flops": sum(r["flops"] for r in matched),
+        "custom_calls": len(custom),
+        "pallas_matched": len(matched),
+        "bucket_bytes": _sum_by(rows, "bytes"),
+        "bucket_flops": _sum_by(rows, "flops"),
+    }
+
+
+def _sum_by(rows: Iterable[Dict[str, Any]], key: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for r in rows:
+        out[r["bucket"]] = out.get(r["bucket"], 0.0) + r[key]
+    return out
+
+
+# --------------------------------------------------------------------------
+# compiled-program access + the per-op table
+# --------------------------------------------------------------------------
+
+def compiled_hlo_proto(compiled) -> bytes:
+    """Serialized optimized HloModuleProto of a jax Compiled object."""
+    try:
+        modules = compiled.runtime_executable().hlo_modules()
+    except AttributeError:  # jax version drift: go through _executable
+        modules = compiled._executable.xla_executable.hlo_modules()
+    return modules[0].as_serialized_hlo_module_proto()
+
+
+def compiled_xla_flops(compiled) -> float:
+    analyses = compiled.cost_analysis()
+    if isinstance(analyses, (list, tuple)):
+        analyses = analyses[0]
+    return float(analyses.get("flops", 0.0))
+
+
+def program_costs(program, feed=None, fetch_list=None, scope=None,
+                  exe=None) -> Dict[str, Any]:
+    """Compile a fluid program's one-iteration step (AOT, shared with
+    Executor.cost_analysis) and return `total_costs` of the optimized
+    module plus XLA's own aggregate flops for cross-checking."""
+    from ..core.executor import Executor
+
+    exe = exe or Executor()
+    compiled = exe.compiled_step(program, feed=feed,
+                                 fetch_list=fetch_list, scope=scope)
+    proto = compiled_hlo_proto(compiled)
+    out = total_costs(proto)
+    out["xla_aggregate_flops"] = compiled_xla_flops(compiled)
+    return out
+
+
+def op_cost_table(program=None, feed=None, fetch_list=None, scope=None,
+                  exe=None, profile_dir: Optional[str] = None,
+                  peak_flops: Optional[float] = None,
+                  hbm_bw: Optional[float] = None,
+                  proto: Optional[bytes] = None) -> List[Dict[str, Any]]:
+    """Per-framework-op cost rows for a program's optimized step.
+
+    Each row aggregates the entry instructions attributed to one
+    (fluid op type, bucket) pair:
+
+        {op_type, bucket, instructions, flops, transcendentals, bytes,
+         time_ms, arith_intensity, achieved_flops_frac,
+         roofline_time_ms}
+
+    - `time_ms` joins measured per-instruction device time from a
+      jax.profiler trace under `profile_dir` (None when no trace is
+      given or no event matched — cost attribution works chip-free).
+    - `achieved_flops_frac` = (flops / time) / peak_flops when both a
+      time and a peak are known, else None.
+    - `roofline_time_ms` = max(flops/peak, bytes/bw): the row's own
+      roofline lower bound (None off-chip).
+
+    Pass `proto` to analyze an already-serialized optimized module
+    instead of compiling `program`.
+    """
+    if proto is None:
+        if program is None:
+            raise ValueError("op_cost_table needs a program or a proto")
+        from ..core.executor import Executor
+
+        exe = exe or Executor()
+        compiled = exe.compiled_step(program, feed=feed,
+                                     fetch_list=fetch_list, scope=scope)
+        proto = compiled_hlo_proto(compiled)
+    rows = instruction_costs(proto)
+
+    times: Dict[str, float] = {}
+    if profile_dir is not None:
+        from .trace import instr_time_table
+
+        times = {name: t["total_ms"]
+                 for name, t in instr_time_table(profile_dir).items()}
+
+    if peak_flops is None and hbm_bw is None:
+        peak_flops, hbm_bw = device_peaks()
+
+    grouped: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in rows:
+        if r["bucket"] == "noop":
+            continue
+        key = (r["op_type"] or "[unattributed]", r["bucket"])
+        g = grouped.setdefault(key, {
+            "op_type": key[0], "bucket": key[1], "instructions": 0,
+            "flops": 0.0, "transcendentals": 0.0, "bytes": 0.0,
+            "time_ms": None,
+        })
+        g["instructions"] += 1
+        g["flops"] += r["flops"]
+        g["transcendentals"] += r["transcendentals"]
+        g["bytes"] += r["bytes"]
+        t = times.get(r["name"])
+        if t is not None:
+            g["time_ms"] = (g["time_ms"] or 0.0) + t
+
+    out = []
+    for g in grouped.values():
+        g["arith_intensity"] = (round(g["flops"] / g["bytes"], 3)
+                                if g["bytes"] else None)
+        g["achieved_flops_frac"] = None
+        g["roofline_time_ms"] = None
+        if peak_flops:
+            if g["time_ms"]:
+                g["achieved_flops_frac"] = round(
+                    (g["flops"] / (g["time_ms"] / 1e3)) / peak_flops, 4)
+            if hbm_bw:
+                g["roofline_time_ms"] = round(
+                    max(g["flops"] / peak_flops,
+                        g["bytes"] / hbm_bw) * 1e3, 4)
+        out.append(g)
+    out.sort(key=lambda g: (-(g["time_ms"] or 0.0), -g["flops"],
+                            -g["bytes"]))
+    return out
+
+
+def bucket_summary(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Collapse op_cost_table rows to per-bucket totals — the
+    layout/copy/transpose share IS the r05 longctx diagnostic."""
+    out: Dict[str, Dict[str, float]] = {}
+    for r in rows:
+        b = out.setdefault(r["bucket"], {"flops": 0.0, "bytes": 0.0,
+                                         "time_ms": 0.0,
+                                         "instructions": 0})
+        b["flops"] += r["flops"]
+        b["bytes"] += r["bytes"]
+        b["time_ms"] += r["time_ms"] or 0.0
+        b["instructions"] += r["instructions"]
+    return out
+
+
+def format_cost_table(rows: List[Dict[str, Any]],
+                      top: int = 30) -> str:
+    """Human-readable per-op cost report (the r05 manual device-profile
+    reading, automated)."""
+    hdr = (f"{'Op':<24}{'Bucket':<12}{'Instrs':>7}{'GFLOP':>10}"
+           f"{'MB':>10}{'Time(ms)':>10}{'AI':>8}{'Ach.MFU':>9}")
+    lines = ["-------> Per-op cost attribution <-------", hdr,
+             "-" * len(hdr)]
+    for r in rows[:top]:
+        lines.append(
+            f"{r['op_type']:<24}{r['bucket']:<12}{r['instructions']:>7}"
+            f"{r['flops'] / 1e9:>10.3f}{r['bytes'] / 1e6:>10.2f}"
+            f"{(r['time_ms'] if r['time_ms'] is not None else -1):>10.3f}"
+            f"{(r['arith_intensity'] or 0):>8.1f}"
+            f"{(r['achieved_flops_frac'] if r['achieved_flops_frac'] is not None else -1):>9.4f}")
+    if len(rows) > top:
+        lines.append(f"... ({len(rows) - top} more rows)")
+    return "\n".join(lines)

@@ -219,13 +219,19 @@ def hlo_op_names(hlo_proto: bytes) -> Dict[str, str]:
 
 
 _PROGRAM_ID_RE = re.compile(r"\((\d+)\)$")
-# the executor's scope convention: "<op_type>:<op_index>"
-_FLUID_SCOPE_RE = re.compile(r"(?:^|/)([A-Za-z0-9_.\-]+):(\d+)(?=/|$)")
+# the executor's scope convention: "<op_type>:<op_index>".  jax
+# transforms WRAP scope segments — under value_and_grad the forward
+# lowers as "jvp(mul:3)" and the backward as "transpose(jvp(mul:3))" —
+# so a scope may be delimited by parens, not just "/".
+_FLUID_SCOPE_RE = re.compile(
+    r"(?:^|[/(])([A-Za-z0-9_.\-]+):(\d+)(?=[/)]|$)")
 
 
 def fluid_op_of(op_name: str) -> Optional[str]:
-    """Innermost `<op_type>:<index>` scope segment of an HLO op_name,
-    or None when the instruction carries no fluid attribution."""
+    """Innermost `<op_type>:<index>` scope segment of an HLO op_name
+    (including transform-wrapped `jvp(...)` / `transpose(jvp(...))`
+    forms), or None when the instruction carries no fluid
+    attribution."""
     hits = _FLUID_SCOPE_RE.findall(op_name)
     return hits[-1][0] if hits else None
 
@@ -248,16 +254,10 @@ def _trace_files(profile_dir: str) -> List[str]:
     return files
 
 
-def op_time_table(profile_dir: str) -> List[Dict[str, Any]]:
-    """Aggregate a captured trace into per-fluid-op-type rows.
-
-    Returns [{op_type, calls, total_ms, avg_ms, max_ms, min_ms, ratio}]
-    sorted by total time.  Rows whose device events carry no
-    `<op>:<idx>` scope (infra, un-annotated programs) aggregate under
-    "[unattributed]"; host python events and profiler bookkeeping lines
-    are excluded.
-    """
-    # instruction -> op_name maps, keyed by program id where known
+def _load_planes(profile_dir: str):
+    """(planes, per_program_instruction_maps, merged_instruction_map)
+    for the newest run under a profiler log dir — the shared setup of
+    op_time_table / instr_time_table."""
     per_program: Dict[str, Dict[str, str]] = {}
     merged: Dict[str, str] = {}
     planes: List[XPlane] = []
@@ -273,18 +273,13 @@ def op_time_table(profile_dir: str) -> List[Dict[str, Any]]:
             if m:
                 per_program.setdefault(m.group(1), {}).update(names)
             merged.update(names)
+    return planes, per_program, merged
 
-    rows: Dict[str, Dict[str, Any]] = {}
 
-    def add(op: str, dur_ms: float):
-        r = rows.setdefault(op, {"op_type": op, "calls": 0,
-                                 "total_ms": 0.0, "max_ms": 0.0,
-                                 "min_ms": float("inf")})
-        r["calls"] += 1
-        r["total_ms"] += dur_ms
-        r["max_ms"] = max(r["max_ms"], dur_ms)
-        r["min_ms"] = min(r["min_ms"], dur_ms)
-
+def _instruction_events(planes, per_program, merged) -> Iterator[
+        Tuple[str, Optional[str], float]]:
+    """Yield (instruction_name, hlo_op_name or None, duration_ms) for
+    every timed event that is attributable instruction work."""
     for plane in planes:
         is_device = plane.name.startswith("/device:")
         for _lname, events in plane.lines.items():
@@ -303,8 +298,50 @@ def op_time_table(profile_dir: str) -> List[Dict[str, Any]]:
                     # thread, so the instruction-name map, not the line
                     # name, decides what counts.
                     continue
-                fluid_op = fluid_op_of(op_name) if op_name else None
-                add(fluid_op or "[unattributed]", dur_ps / 1e9)
+                yield ename, op_name, dur_ps / 1e9
+
+
+def instr_time_table(profile_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Per-HLO-instruction measured time from a captured trace:
+    {instruction_name: {total_ms, calls, op_name}} — the join key for
+    observe.cost's analytic per-instruction flop/byte rows."""
+    planes, per_program, merged = _load_planes(profile_dir)
+    out: Dict[str, Dict[str, Any]] = {}
+    for ename, op_name, dur_ms in _instruction_events(
+            planes, per_program, merged):
+        r = out.setdefault(ename, {"total_ms": 0.0, "calls": 0,
+                                   "op_name": op_name})
+        r["total_ms"] += dur_ms
+        r["calls"] += 1
+    return out
+
+
+def op_time_table(profile_dir: str) -> List[Dict[str, Any]]:
+    """Aggregate a captured trace into per-fluid-op-type rows.
+
+    Returns [{op_type, calls, total_ms, avg_ms, max_ms, min_ms, ratio}]
+    sorted by total time.  Rows whose device events carry no
+    `<op>:<idx>` scope (infra, un-annotated programs) aggregate under
+    "[unattributed]"; host python events and profiler bookkeeping lines
+    are excluded.
+    """
+    planes, per_program, merged = _load_planes(profile_dir)
+
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def add(op: str, dur_ms: float):
+        r = rows.setdefault(op, {"op_type": op, "calls": 0,
+                                 "total_ms": 0.0, "max_ms": 0.0,
+                                 "min_ms": float("inf")})
+        r["calls"] += 1
+        r["total_ms"] += dur_ms
+        r["max_ms"] = max(r["max_ms"], dur_ms)
+        r["min_ms"] = min(r["min_ms"], dur_ms)
+
+    for _ename, op_name, dur_ms in _instruction_events(
+            planes, per_program, merged):
+        fluid_op = fluid_op_of(op_name) if op_name else None
+        add(fluid_op or "[unattributed]", dur_ms)
 
     out = sorted(rows.values(), key=lambda r: -r["total_ms"])
     total = sum(r["total_ms"] for r in out) or 1.0

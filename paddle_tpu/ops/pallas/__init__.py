@@ -39,19 +39,45 @@ def interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def pallas_call(*args, **kw):
+# kernel-name -> cost function registry (observe/cost.py injection
+# point).  A cost fn maps the custom call's actual operand/result
+# shapes to the kernel's DENSE-EQUIVALENT work:
+#     fn(operand_shapes, result_shapes) -> (flops, bytes_or_None)
+# where each shapes list holds (dims_tuple, element_bytes) pairs.
+# "Dense-equivalent" is bench.py's standing MFU convention: the flop
+# count of the logical math (what the non-Pallas composition would
+# compute ONCE) — skipped masked blocks are not credited and backward
+# recompute is not double-counted.  bytes None = use the default
+# materialized-buffers model (operands + outputs once), which already
+# matches how these kernels stream HBM.  Each kernel module registers
+# its entries next to its DEFAULT_BLOCK_* tuning constants.
+KERNEL_COSTS = {}
+
+
+def register_kernel_cost(name: str, fn):
+    """Declare a Pallas kernel's analytic cost; `name` must match the
+    `name=` the kernel passes to `pallas_call` (the jax.named_scope
+    that reaches the custom call's HLO metadata)."""
+    KERNEL_COSTS[name] = fn
+    return fn
+
+
+def pallas_call(*args, name=None, **kw):
     """pl.pallas_call with the shared interpret gate applied, and the
     invocation wrapped in a jax.named_scope carrying the kernel's name
     — device traces then attribute custom-call time to the specific
     Pallas kernel (custom calls are otherwise opaque blobs in profiles,
     the same blindness that makes them report zero flops to XLA's cost
-    analysis)."""
+    analysis).  `name` also keys the KERNEL_COSTS registry: observe.cost
+    finds `pallas_<name>` in the custom call's op_name and injects the
+    registered (flops, bytes) there."""
     import jax
     from jax.experimental import pallas as pl
 
     kernel = args[0] if args else kw.get("kernel")
-    name = getattr(kernel, "__name__", None) or getattr(
-        getattr(kernel, "func", None), "__name__", "kernel")
+    if name is None:
+        name = getattr(kernel, "__name__", None) or getattr(
+            getattr(kernel, "func", None), "__name__", "kernel")
     inner = pl.pallas_call(*args, interpret=interpret(), **kw)
 
     def scoped(*call_args, **call_kw):

@@ -37,6 +37,83 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
+# -- kernel cost registry (observe/cost.py injects these at the custom
+# -- call instructions; tools/check_twin_flops.py asserts parity with
+# -- the dense twin) ---------------------------------------------------
+#
+# Dense-equivalent convention: full Tq*Tk scores regardless of causal
+# (the twin computes the masked positions too), backward recompute of
+# s/p NOT credited.  Per flattened head (NH = N*H):
+#   fwd:  s = q k^T and o = p v            -> 2 dots = 4*Tq*Tk*D
+#   bwd:  dq, dk, dv, dp = do v^T          -> 4 dots = 8*Tq*Tk*D
+# The per-score constants cover the softmax's non-transcendental
+# elementwise work as XLA counts it in the dense composition
+# (measured: ~8.2 flops/score fwd, ~8.1 bwd; exp is tallied under
+# "transcendentals", not flops, in both accountings).
+_SOFTMAX_FWD_PER_SCORE = 8.0
+_SOFTMAX_BWD_PER_SCORE = 8.0
+
+
+def _attn_dims(operand_shapes):
+    (nh, t_q, d) = operand_shapes[0][0]
+    t_k = operand_shapes[1][0][1]
+    return nh, t_q, t_k, d
+
+
+def _io_bytes(operand_shapes, result_shapes):
+    total = 0
+    for dims, elem in list(operand_shapes) + list(result_shapes):
+        n = 1
+        for d in dims:
+            n *= d
+        total += n * elem
+    return float(total)
+
+
+def flash_fwd_cost(operand_shapes, result_shapes):
+    nh, t_q, t_k, d = _attn_dims(operand_shapes)
+    flops = nh * t_q * t_k * (4.0 * d + _SOFTMAX_FWD_PER_SCORE)
+    return flops, _io_bytes(operand_shapes, result_shapes)
+
+
+def flash_dkv_cost(operand_shapes, result_shapes):
+    # carries dk + dv + the shared dp dot (dense-equivalent split with
+    # flash_dq_cost: together they sum to the dense backward's 4 dots)
+    nh, t_q, t_k, d = _attn_dims(operand_shapes)
+    flops = nh * t_q * t_k * (6.0 * d + 0.625 * _SOFTMAX_BWD_PER_SCORE)
+    return flops, _io_bytes(operand_shapes, result_shapes)
+
+
+def flash_dq_cost(operand_shapes, result_shapes):
+    nh, t_q, t_k, d = _attn_dims(operand_shapes)
+    flops = nh * t_q * t_k * (2.0 * d + 0.375 * _SOFTMAX_BWD_PER_SCORE)
+    return flops, _io_bytes(operand_shapes, result_shapes)
+
+
+def attention_cost(nh, t_q, t_k, d, dtype_bytes=4):
+    """Dense-equivalent (flops, bytes) of one fwd+bwd flash attention —
+    the sum of the three kernels' registry entries (test/parity
+    helper; q/k/v/do/o assumed dtype_bytes wide, lse/delta f32)."""
+    q = ((nh, t_q, d), dtype_bytes)
+    k = ((nh, t_k, d), dtype_bytes)
+    stat = ((nh, 8, t_q), 4)
+    lse = ((nh, t_q), 4)
+    fwd = flash_fwd_cost([q, k, k], [q, lse])
+    dkv = flash_dkv_cost([q, k, k, q, stat, stat], [k, k])
+    dq = flash_dq_cost([q, k, k, q, stat, stat], [q])
+    return (fwd[0] + dkv[0] + dq[0], fwd[1] + dkv[1] + dq[1])
+
+
+def _register_costs():
+    from . import register_kernel_cost
+
+    register_kernel_cost("flash_fwd", flash_fwd_cost)
+    register_kernel_cost("flash_dkv", flash_dkv_cost)
+    register_kernel_cost("flash_dq", flash_dq_cost)
+
+
+_register_costs()
+
 
 def _pallas_call(*args, **kw):
     from . import pallas_call  # shared interpret gate (package init)
@@ -162,6 +239,7 @@ def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k):
 
     o, lse = _pallas_call(
         kern,
+        name="flash_fwd",
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -427,6 +505,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
 
     dkv_out = _pallas_call(
         dkv_kern,
+        name="flash_dkv",
         grid=(nh, nk, nq),
         in_specs=specs("kq"),
         out_specs=kq_out_specs,
@@ -450,6 +529,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
 
     dq = _pallas_call(
         dq_kern,
+        name="flash_dq",
         grid=(nh, nq, nk),
         in_specs=specs("qk"),
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, 0)),

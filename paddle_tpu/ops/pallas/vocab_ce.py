@@ -46,6 +46,80 @@ NEG = -1e30
 DEFAULT_BLOCK_T = 512
 DEFAULT_BLOCK_V = 1024
 
+# -- kernel cost registry (observe/cost.py injects these at the custom
+# -- call instructions) ------------------------------------------------
+#
+# Dense-equivalent convention (see flash_attention.py): flops the
+# composed projection+CE would compute once, backward recompute of z
+# NOT credited.  For N tokens, D hidden, V vocab:
+#   fwd: z = h @ W                 -> 2*N*D*V
+#   bwd: dh = dz W^T, dW = h^T dz  -> 4*N*D*V
+# Per-logit constants cover the softmax/CE elementwise work as XLA
+# counts it in the dense composition (measured: ~4.0 flops/logit fwd,
+# ~3.0 bwd; exp lands under transcendentals in both accountings).
+_CE_FWD_PER_LOGIT = 4.0
+_CE_BWD_PER_LOGIT = 3.0
+
+
+def _ce_dims(operand_shapes):
+    (n, d) = operand_shapes[0][0]
+    v = operand_shapes[1][0][1]
+    return n, d, v
+
+
+def _io_bytes(operand_shapes, result_shapes):
+    total = 0
+    for dims, elem in list(operand_shapes) + list(result_shapes):
+        count = 1
+        for d in dims:
+            count *= d
+        total += count * elem
+    return float(total)
+
+
+def vocab_ce_fwd_cost(operand_shapes, result_shapes):
+    n, d, v = _ce_dims(operand_shapes)
+    flops = n * v * (2.0 * d + _CE_FWD_PER_LOGIT)
+    return flops, _io_bytes(operand_shapes, result_shapes)
+
+
+def vocab_ce_dh_cost(operand_shapes, result_shapes):
+    n, d, v = _ce_dims(operand_shapes)
+    flops = n * v * (2.0 * d + 2.0 / 3.0 * _CE_BWD_PER_LOGIT)
+    return flops, _io_bytes(operand_shapes, result_shapes)
+
+
+def vocab_ce_dw_cost(operand_shapes, result_shapes):
+    n, d, v = _ce_dims(operand_shapes)
+    flops = n * v * (2.0 * d + 1.0 / 3.0 * _CE_BWD_PER_LOGIT)
+    return flops, _io_bytes(operand_shapes, result_shapes)
+
+
+def vocab_ce_cost(n_tokens, d, v, dtype_bytes=4):
+    """Dense-equivalent (flops, bytes) of one fwd+bwd fused vocab-CE —
+    the sum of the three kernels' registry entries (test/parity
+    helper)."""
+    h = ((n_tokens, d), dtype_bytes)
+    w = ((d, v), dtype_bytes)
+    lbl = ((1, n_tokens), 4)
+    row = ((1, n_tokens), 4)
+    stat = ((8, n_tokens), 4)
+    fwd = vocab_ce_fwd_cost([h, w, lbl], [stat, stat, stat])
+    dh = vocab_ce_dh_cost([h, w, lbl, row, row], [h])
+    dw = vocab_ce_dw_cost([h, w, lbl, row, row], [w])
+    return (fwd[0] + dh[0] + dw[0], fwd[1] + dh[1] + dw[1])
+
+
+def _register_costs():
+    from . import register_kernel_cost
+
+    register_kernel_cost("vocab_ce_fwd", vocab_ce_fwd_cost)
+    register_kernel_cost("vocab_ce_dh", vocab_ce_dh_cost)
+    register_kernel_cost("vocab_ce_dw", vocab_ce_dw_cost)
+
+
+_register_costs()
+
 
 def _pallas_call(*args, **kw):
     from . import pallas_call  # shared interpret gate (package init)
@@ -199,6 +273,7 @@ def _fwd(h, w, labels, block_t, block_v):
     grid = (pl.cdiv(n, block_t), pl.cdiv(v, block_v))
     lse, zt, zsum = _pallas_call(
         functools.partial(_fwd_kernel, block_v=block_v, n_valid_v=v),
+        name="vocab_ce_fwd",
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_t, d), lambda t, vb: (t, 0)),
@@ -235,6 +310,7 @@ def _bwd(h, w, labels, lse, g, eps, block_t, block_v):
                   n_valid_v=v, eps=eps)
     dh = _pallas_call(
         functools.partial(_bwd_dh_kernel, **common),
+        name="vocab_ce_dh",
         grid=(pl.cdiv(n, block_t), pl.cdiv(v, block_v)),
         in_specs=[
             pl.BlockSpec((block_t, d), lambda t, vb: (t, 0)),
@@ -249,6 +325,7 @@ def _bwd(h, w, labels, lse, g, eps, block_t, block_v):
     )(h, w, lbl, lse2, g2)
     dw = _pallas_call(
         functools.partial(_bwd_dw_kernel, **common),
+        name="vocab_ce_dw",
         grid=(pl.cdiv(v, block_v), pl.cdiv(n, block_t)),
         in_specs=[
             pl.BlockSpec((block_t, d), lambda vb, t: (t, 0)),

@@ -18,14 +18,26 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def compat_shard_map(fn, mesh, in_specs, out_specs, check=False):
+    """shard_map with two jax API drifts smoothed over: the import
+    location (jax.shard_map vs jax.experimental.shard_map) and the
+    replication-check kwarg rename (check_rep -> check_vma).  `check`
+    feeds whichever kwarg this jax has."""
+    import inspect
+
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    kw = ("check_vma" if "check_vma" in
+          inspect.signature(shard_map).parameters else "check_rep")
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                     out_specs=out_specs, check_vma=False)
+                     out_specs=out_specs, **{kw: check})
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return compat_shard_map(fn, mesh, in_specs, out_specs)
 
 
 def psum(x, axis_name):

@@ -51,19 +51,13 @@ from jax import lax
 
 
 def _shard_map():
-    """shard_map with the check_rep/check_vma rename smoothed over."""
-    import inspect
+    """shard_map with the check_rep/check_vma rename smoothed over
+    (the shared shim lives in collectives.compat_shard_map)."""
+    from .collectives import compat_shard_map
 
-    try:
-        from jax import shard_map as _sm
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map as _sm
-    kw = ("check_vma" if "check_vma" in
-          inspect.signature(_sm).parameters else "check_rep")
-
-    def sm(f, **kwargs):
-        kwargs[kw] = kwargs.pop("check_rep")
-        return _sm(f, **kwargs)
+    def sm(f, mesh, in_specs, out_specs, check_rep):
+        return compat_shard_map(f, mesh, in_specs, out_specs,
+                                check=check_rep)
 
     return sm
 
@@ -133,21 +127,48 @@ def gpipe(stage_fn, mesh, axis: str = "pp", batch_axis=None,
         in_x_spec = jax.tree.map(lambda l: leaf_spec(l, scatter), micro_x)
         out_spec = jax.tree.map(lambda l: leaf_spec(l, False), micro_x)
 
+        # On a MULTI-AXIS mesh (dp×pp), params enter the shard_map
+        # fully replicated (P()) and each rank slices out its own stage
+        # inside the body.  The obvious P(axis) stage-sliced entry is
+        # WRONG on this jax/XLA version when the stacked array is a
+        # jit-internal value (the engine stacks env params mid-program):
+        # the SPMD partitioner delivers each rank's slice dp-SUMMED
+        # instead of replicated — every layer's weights arrive
+        # multiplied by the dp degree.  Caught by
+        # tests/test_pipeline_engine.py::test_pipelined_transformer_dp_x_pp;
+        # minimal repro in tests/test_gpipe.py::
+        # test_gpipe_dp_x_pp_with_jit_internal_stacked_params.  Neither
+        # with_sharding_constraint, optimization_barrier, nor
+        # mentioning dp via a broadcast dim avoids it — only the
+        # fully-replicated entry does.  Cost: inside the manual region
+        # each device transiently holds all S stages' params instead of
+        # 1/S, so pure-pp meshes (where the sliced entry is correct)
+        # keep the memory-lean path.
+        multi_axis = any(name != axis and size > 1
+                         for name, size in mesh.shape.items())
+        if multi_axis:
+            param_spec = jax.tree.map(lambda _: P(), stacked_params)
+        else:
+            param_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+
         @partial(
             shard_map, mesh=mesh,
-            in_specs=(jax.tree.map(lambda _: P(axis), stacked_params),
-                      in_x_spec),
+            in_specs=(param_spec, in_x_spec),
             out_specs=out_spec,
             check_rep=False)
         def run(params, xs):
-            # inside: params leaves are (1, ...) — this device's stage.
-            # NOTE params enter replicated over the dp axis (spec
-            # mentions only pp); shard_map's transpose psums their
-            # cotangents over the unmentioned axis, so per-dp-shard
-            # batch contributions sum correctly — pinned by
-            # tests/test_gpipe.py::test_gpipe_dp_gradients_match.
-            params = jax.tree.map(lambda l: l[0], params)
             rank = lax.axis_index(axis)
+            if multi_axis:
+                # full (S, ...) leaves on every device: take this
+                # rank's stage (transpose: scatter + psum over the
+                # replicated-in axes = the correct dp grad sum, pinned
+                # by tests/test_gpipe.py::test_gpipe_dp_gradients_match)
+                params = jax.tree.map(
+                    lambda l: lax.dynamic_index_in_dim(
+                        l, rank, 0, keepdims=False), params)
+            else:
+                # stage-sliced entry: leaves are (1, ...) local shards
+                params = jax.tree.map(lambda l: l[0], params)
             zero = jax.tree.map(lambda l: jnp.zeros(l.shape[1:], l.dtype),
                                 xs)
 

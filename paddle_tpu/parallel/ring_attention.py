@@ -70,10 +70,7 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
     batch_axis: mesh axis the batch dim is sharded over (e.g. "dp" on a
     dp x sp mesh) — without it the shard_map boundary would all-gather
     dp-sharded activations and every dp group would redo the compute."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from .collectives import compat_shard_map
 
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -118,8 +115,7 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
     b_ax = (batch_axis if batch_axis
             and mesh.shape.get(batch_axis, 1) > 1 else None)
     spec = P(b_ax, None, axis, None)
-    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    fn = compat_shard_map(local_fn, mesh, (spec, spec, spec), spec)
     return fn(q, k, v)
 
 
@@ -131,10 +127,7 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
     on `axis`; H must be divisible by the axis size.  use_pallas None =
     auto (Pallas kernel on TPU), same convention as ring_attention;
     batch_axis keeps dp-sharded batches sharded inside the shard_map."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from .collectives import compat_shard_map
 
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -170,6 +163,5 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
     b_ax = (batch_axis if batch_axis
             and mesh.shape.get(batch_axis, 1) > 1 else None)
     spec = P(b_ax, None, axis, None)
-    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    fn = compat_shard_map(local_fn, mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

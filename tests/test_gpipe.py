@@ -212,3 +212,48 @@ def test_gpipe_trains():
         losses.append(float(lv))
         params = jax.tree.map(lambda p, gr: p - 0.3 * gr, params, g)
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_gpipe_dp_x_pp_with_jit_internal_stacked_params():
+    """Minimal repro of the dp×pp forward corruption this jax/XLA
+    version produces when gpipe's stacked params are a JIT-INTERNAL
+    value (the pipeline engine stacks env params mid-program): with the
+    stage-sliced P('pp') entry, the SPMD partitioner delivered each
+    rank's param slice dp-SUMMED (weights × dp per layer).  gpipe now
+    enters params fully replicated on multi-axis meshes and slices per
+    rank inside the body — this pins both the forward values and the
+    fact that the fix composes with GSPMD in_shardings."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh({"dp": 2, "pp": 2})
+    rng = np.random.RandomState(0)
+    ws = [jnp.asarray(rng.randn(D, D) * 0.4, jnp.float32)
+          for _ in range(4)]
+    x_flat = jnp.asarray(rng.randn(8, D), jnp.float32)
+
+    def relu_chain(x):
+        out = np.asarray(x)
+        for w in ws:
+            out = np.maximum(out @ np.asarray(w), 0.0)
+        return out
+
+    def stage(p, x):
+        def body(c, w):
+            return jnp.maximum(c @ w, 0.0), None
+        out, _ = jax.lax.scan(body, x, p["w"])
+        return out
+
+    pfn = gpipe(stage, mesh, batch_axis="dp")
+
+    def step(state, x):
+        # the stack happens INSIDE jit — the trigger
+        stacked = {"w": jnp.stack([state[f"w{i}"] for i in range(4)])
+                   .reshape(2, 2, D, D)}
+        return pfn(stacked, x.reshape(4, 2, D)).reshape(8, D)
+
+    fn = jax.jit(step, in_shardings=(
+        {f"w{i}": NamedSharding(mesh, P()) for i in range(4)},
+        NamedSharding(mesh, P("dp"))))
+    got = np.asarray(fn({f"w{i}": ws[i] for i in range(4)}, x_flat))
+    np.testing.assert_allclose(got, relu_chain(x_flat), rtol=1e-5,
+                               atol=1e-6)

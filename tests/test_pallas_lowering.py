@@ -20,13 +20,34 @@ import jax.numpy as jnp
 from paddle_tpu.ops.pallas import force_mosaic_lowering
 
 
+def _export_fn():
+    """Version-tolerant jax.export accessor: newer jax ships it as the
+    `jax.export` SUBMODULE (not auto-imported — plain attribute access
+    raises AttributeError), older jax as jax.experimental.export, and
+    the keyword drifted lowering_platforms -> platforms along the way."""
+    import inspect
+
+    try:
+        import jax.export as jexp  # jax >= 0.4.30
+    except ImportError:
+        from jax.experimental import export as jexp  # older jax
+    sig = inspect.signature(jexp.export)
+    kw = ("platforms" if "platforms" in sig.parameters
+          else "lowering_platforms")
+
+    def export(fn, *args):
+        return jexp.export(jax.jit(fn), **{kw: ["tpu"]})(*args)
+
+    return export
+
+
 def _export_tpu(fn, *args):
     """Export for the TPU target with the interpret gate overridden —
     otherwise the CPU host would serialize the INTERPRETER path and
     the check would be vacuous."""
 
     with force_mosaic_lowering():
-        exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+        exp = _export_fn()(fn, *args)
     # prove the Mosaic custom call is actually in the artifact
     mlir = exp.mlir_module()
     assert "tpu_custom_call" in mlir, \
