@@ -1,6 +1,7 @@
 """High-level contrib APIs (reference: python/paddle/fluid/contrib/)."""
 
 from . import slim  # noqa: F401
+from .serving import serve  # noqa: F401
 from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401
                       CheckpointConfig, EndEpochEvent, EndStepEvent,
                       Inferencer, Trainer)

@@ -163,6 +163,48 @@ class Predictor:
             return False
         return self._signature(feeds) == self._export_sig
 
+    def compile_signature(self, feed_spec: Dict[str, object],
+                          donate_feeds: bool = False):
+        """AOT-compile the inference executable for one input signature
+        WITHOUT example data (the serving warmup path: feed_spec maps
+        input name → jax.ShapeDtypeStruct).  The executable lands in
+        the same per-signature cache run() consults, so a later run()
+        with feeds of exactly this signature dispatches the precompiled
+        executable — serving.ServingEngine precompiles its whole shape-
+        bucket ladder through here and then never compiles again.
+
+        donate_feeds=True donates the feed buffers to XLA (outputs may
+        reuse input memory — the right call for a serving engine that
+        pads a FRESH host batch per dispatch).  Do not enable it on a
+        Predictor that is also run() with device-resident feeds reused
+        across calls (e.g. benchmark(zero_copy=True)): a donated buffer
+        is dead after the call.  Params are never donated.
+
+        Idempotent per signature; returns the compiled executable."""
+        import jax
+
+        sig = tuple(sorted(
+            (n, tuple(s.shape), str(np.dtype(s.dtype)))
+            for n, s in feed_spec.items()))
+        entry = self._compiled.get(sig)
+        if entry is not None:
+            return entry
+        program = self._program
+        fetch_names = self._fetch_names
+
+        def infer(params, feeds):
+            env = dict(params)
+            env.update(feeds)
+            env = interpret_program(program, env, None,
+                                    fetch_names=tuple(fetch_names))
+            return [env[n] for n in fetch_names]
+
+        jitted = (jax.jit(infer, donate_argnums=(1,)) if donate_feeds
+                  else jax.jit(infer))
+        entry = jitted.lower(self._params, dict(feed_spec)).compile()
+        self._compiled[sig] = entry
+        return entry
+
     def run(self, feed: Dict[str, np.ndarray] | Sequence[np.ndarray]):
         """Returns fetch arrays (list, fetch order from export)."""
         import jax
@@ -176,14 +218,19 @@ class Predictor:
             feed = dict(zip(self._feed_names, feed))
         feeds = {n: jnp.asarray(v) for n, v in feed.items()}
 
-        if self._exported_matches(feeds):
+        sig = self._signature(feeds)
+        entry = self._compiled.get(sig)
+        # an already-compiled executable beats the serialized artifact
+        # (the artifact exists to skip TRACING on cold start; its own
+        # first .call still pays an XLA compile — a warmed signature,
+        # e.g. a serving bucket precompiled via compile_signature, must
+        # never fall back to that and recompile post-warmup)
+        if entry is None and self._exported_matches(feeds):
             outs = self._exported.call(
                 {n: self._params[n] for n in sorted(self._params)},
                 {n: feeds[n] for n in sorted(feeds)})
             return [np.asarray(o) for o in outs]
 
-        sig = self._signature(feeds)
-        entry = self._compiled.get(sig)
         if entry is None:
             program = self._program
             fetch_names = self._fetch_names

@@ -30,12 +30,14 @@ Three pillars (docs/OBSERVE.md):
 from . import cost  # noqa: F401
 from .cost import (bucket_summary, device_peaks,  # noqa: F401
                    format_cost_table, op_cost_table, program_costs)
-from .events import RunEventLog, git_sha, new_run_id, read_events  # noqa: F401
+from .events import (SERVING_EVENTS, RunEventLog, git_sha,  # noqa: F401
+                     new_run_id, read_events)
 from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
                       enable_telemetry, fetch_telemetry, init_telemetry,
                       telemetry_enabled)
-from .monitoring import (RuntimeStats, device_memory_stats,  # noqa: F401
-                         peak_memory_bytes, runtime_stats)
+from .monitoring import (LatencyHistogram, RuntimeStats,  # noqa: F401
+                         device_memory_stats, peak_memory_bytes,
+                         runtime_stats)
 from .trace import fluid_op_of, format_op_table, op_time_table  # noqa: F401
 
 

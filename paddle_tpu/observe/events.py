@@ -18,6 +18,17 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 
+# well-known serving event kinds (paddle_tpu.serving emits these; a
+# dashboard tailing an event log can filter on them)
+SERVING_EVENTS = (
+    "serving_start",                # engine config at start()
+    "serving_warmup",               # bucket-ladder precompile summary
+    "serving_window",               # periodic stats snapshot
+    "serving_compile_post_warmup",  # LOUD: a shape leaked past buckets
+    "serving_drain",                # final snapshot at drain
+)
+
+
 def new_run_id() -> str:
     """Short unique id for one run/invocation (12 hex chars)."""
     return uuid.uuid4().hex[:12]
@@ -99,6 +110,14 @@ class RunEventLog:
                   else dict(telemetry))
         fields.update(extra)
         return self.event("telemetry", **fields)
+
+    def serving_window(self, stats, **extra: Any) -> Dict[str, Any]:
+        """Emit one serving stats snapshot (a serving.ServingStats or a
+        plain dict) — the serving analog of telemetry_window."""
+        fields = (stats.snapshot() if hasattr(stats, "snapshot")
+                  else dict(stats))
+        fields.update(extra)
+        return self.event("serving_window", **fields)
 
     def close(self):
         if not self._f.closed:

@@ -121,6 +121,86 @@ def peak_memory_bytes() -> Optional[int]:
     return max(peaks) if peaks else None
 
 
+class LatencyHistogram:
+    """Fixed log-spaced latency histogram with percentile estimates.
+
+    Serving telemetry needs p50/p95/p99 over unbounded request streams
+    without storing samples: log-spaced bins (default 20/decade from
+    10 µs to 60 s ≈ 7% relative resolution) hold counts only, so
+    record() is O(1), memory is constant, and merged windows stay
+    exact.  percentile() returns the upper edge of the bin holding the
+    rank — a ≤7% overestimate, never an underestimate (latency SLOs
+    should round pessimistically).  Thread-safe.
+    """
+
+    def __init__(self, lo_ms: float = 0.01, hi_ms: float = 60000.0,
+                 bins_per_decade: int = 20):
+        import math
+
+        if not (0 < lo_ms < hi_ms):
+            raise ValueError("need 0 < lo_ms < hi_ms")
+        self._lo = lo_ms
+        self._k = bins_per_decade
+        self._nbins = (int(math.ceil(
+            math.log10(hi_ms / lo_ms) * bins_per_decade)) + 2)
+        # bin 0 catches < lo_ms; the last bin catches >= hi_ms
+        self._counts = [0] * self._nbins
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+
+    def _bin(self, ms: float) -> int:
+        import math
+
+        if ms < self._lo:
+            return 0
+        idx = int(math.log10(ms / self._lo) * self._k) + 1
+        return min(idx, self._nbins - 1)
+
+    def _edge(self, idx: int) -> float:
+        # upper edge of bin idx (bin 0's edge is lo_ms itself)
+        return self._lo * 10.0 ** (idx / self._k)
+
+    def record(self, ms: float):
+        ms = float(ms)
+        with self._lock:
+            self._counts[self._bin(ms)] += 1
+            self.count += 1
+            self.sum_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100] → latency ms (bin upper edge), None if empty."""
+        with self._lock:
+            if self.count == 0:
+                return None
+            rank = p / 100.0 * self.count
+            acc = 0
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc >= rank:
+                    # never report past the observed max (the top bins
+                    # are coarse)
+                    return min(self._edge(i), self.max_ms)
+            return self.max_ms
+
+    def summary(self) -> Dict[str, Any]:
+        """{count, mean_ms, sum_ms, max_ms, p50_ms, p95_ms, p99_ms} —
+        the serving_window wire form."""
+        with self._lock:
+            count, total, mx = self.count, self.sum_ms, self.max_ms
+        out: Dict[str, Any] = {"count": count}
+        out["sum_ms"] = round(total, 3)
+        out["mean_ms"] = round(total / count, 3) if count else None
+        out["max_ms"] = round(mx, 3) if count else None
+        for p in (50, 95, 99):
+            v = self.percentile(p)
+            out[f"p{p}_ms"] = round(v, 3) if v is not None else None
+        return out
+
+
 class dispatch_timer:
     """Context manager stamping one dispatch into runtime_stats."""
 

@@ -1,0 +1,31 @@
+"""paddle_tpu.serving — production serving over the AOT Predictor.
+
+The inference-side subsystem (docs/SERVING.md): what `parallel/` +
+`contrib.Trainer` are for training, this is for serving —
+
+- `engine.ServingEngine`: shape-bucketed AOT executables (precompiled
+  warmup ladder, zero steady-state compiles) + request normalization,
+- `batcher.DynamicBatcher`: dynamic micro-batching with futures
+  (max_batch_size / max_wait_ms, whichever first),
+- `admission.AdmissionController`: bounded queue with fast-reject load
+  shedding, per-request deadlines, health/drain state machine,
+- `stats.ServingStats`: latency percentiles, occupancy, padding waste,
+  shed/deadline counters — emitted as observe.RunEventLog events.
+
+Quick start (or `paddle_tpu.contrib.serve(...)`):
+
+    from paddle_tpu.serving import BucketConfig, ServingEngine
+    engine = ServingEngine(model_dir, example_feed={"x": example},
+                           buckets=BucketConfig((1, 2, 4, 8)))
+    engine.start()
+    y = engine.infer({"x": x})
+    engine.close()
+"""
+
+from .admission import (AdmissionController,  # noqa: F401
+                        DeadlineExceededError, QueueFullError,
+                        ServingClosedError, ServingError)
+from .batcher import DynamicBatcher, Request  # noqa: F401
+from .engine import (BucketConfig, BucketMissError,  # noqa: F401
+                     ServingEngine)
+from .stats import ServingStats  # noqa: F401

@@ -1,0 +1,152 @@
+"""Admission control: the robustness half of the serving engine.
+
+A TPU serving frontend dies in one of three boring ways: an unbounded
+queue grows until the process OOMs, expired requests burn device time
+computing answers nobody is waiting for, or shutdown races in-flight
+work and strands callers on futures that never resolve.  This module
+owns all three:
+
+- **bounded queue + fast-reject load shedding** — `check()` raises
+  `QueueFullError` *at submit time* when the engine is at capacity;
+  the caller gets a structured rejection in microseconds instead of a
+  timeout after seconds (the TF-Serving batching-queue contract),
+- **per-request deadlines** — `deadline_for()` stamps an absolute
+  monotonic deadline on each request; the batcher drops expired
+  requests *before* dispatch (`DeadlineExceededError`), never after,
+- **health/drain state machine** — CREATED → RUNNING → DRAINING →
+  STOPPED.  Draining stops admission immediately but lets queued work
+  finish, so a rolling restart never drops accepted requests.
+
+All serving errors derive from `ServingError` and carry a structured
+`details` dict (`as_dict()`), so a frontend can serialize rejections
+without parsing message strings.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# -- state machine values (strings, so health() dicts are json-ready) ---
+CREATED = "created"
+RUNNING = "running"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+
+class ServingError(RuntimeError):
+    """Base for structured serving rejections.
+
+    `details` is machine-readable; `as_dict()` is the wire form a
+    frontend returns to the client (and what tests assert on).
+    """
+
+    kind = "serving_error"
+
+    def __init__(self, message: str, **details: Any):
+        super().__init__(message)
+        self.details = details
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = {"error": self.kind, "message": str(self)}
+        out.update(self.details)
+        return out
+
+
+class QueueFullError(ServingError):
+    """Load shed: the bounded queue is at capacity (fast-reject)."""
+
+    kind = "queue_full"
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired while queued; it was dropped
+    before dispatch (no device time was spent on it)."""
+
+    kind = "deadline_exceeded"
+
+
+class ServingClosedError(ServingError):
+    """Submitted to an engine that is not RUNNING (not started yet,
+    draining, or stopped)."""
+
+    kind = "serving_closed"
+
+
+class AdmissionController:
+    """Admission decisions + the health/drain state machine.
+
+    The controller is deliberately free of queue mechanics: the batcher
+    reports its in-flight count and the controller answers admit/reject,
+    so the policy is testable without threads.
+    """
+
+    def __init__(self, queue_capacity: int,
+                 default_deadline_ms: Optional[float] = None):
+        if queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+        if default_deadline_ms is not None and default_deadline_ms <= 0:
+            raise ValueError("default_deadline_ms must be > 0")
+        self.queue_capacity = int(queue_capacity)
+        self.default_deadline_ms = default_deadline_ms
+        self._state = CREATED
+        self._lock = threading.Lock()
+
+    # -- state machine --------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def start(self):
+        with self._lock:
+            if self._state != CREATED:
+                raise ServingClosedError(
+                    f"cannot start from state {self._state!r}",
+                    state=self._state)
+            self._state = RUNNING
+
+    def begin_drain(self):
+        with self._lock:
+            if self._state in (DRAINING, STOPPED):
+                return  # drain is idempotent
+            if self._state != RUNNING:
+                raise ServingClosedError(
+                    f"cannot drain from state {self._state!r}",
+                    state=self._state)
+            self._state = DRAINING
+
+    def finish_drain(self):
+        with self._lock:
+            self._state = STOPPED
+
+    # -- admission ------------------------------------------------------
+    def check(self, inflight: int):
+        """Admit one request given the current in-flight count, or
+        raise the structured rejection.  Called under the batcher's
+        lock, so the count cannot race past capacity."""
+        if self._state != RUNNING:
+            raise ServingClosedError(
+                f"engine is {self._state}; not accepting requests",
+                state=self._state)
+        if inflight >= self.queue_capacity:
+            raise QueueFullError(
+                f"queue at capacity ({self.queue_capacity}); request "
+                "shed", capacity=self.queue_capacity, inflight=inflight)
+
+    def deadline_for(self, deadline_ms: Optional[float],
+                     now: Optional[float] = None) -> Optional[float]:
+        """Absolute monotonic deadline for a request, or None when
+        neither the request nor the engine sets one."""
+        ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        if ms is None:
+            return None
+        if ms <= 0:
+            raise ValueError("deadline_ms must be > 0")
+        return (now if now is not None else time.monotonic()) + ms / 1e3
+
+    def health(self, **extra: Any) -> Dict[str, Any]:
+        out = {"state": self._state, "capacity": self.queue_capacity}
+        out.update(extra)
+        return out

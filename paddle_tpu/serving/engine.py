@@ -1,0 +1,423 @@
+"""ServingEngine: shape-bucketed AOT serving over the Predictor.
+
+XLA serves fixed shapes: every novel input signature is a multi-second
+compile, and a production frontend that lets request shapes leak into
+the executable cache compiles forever (shape churn).  The engine closes
+the shape space up front:
+
+- a **bounded bucket ladder** — batch sizes × (optionally) sequence
+  lengths, `BucketConfig`.  Every dispatch is padded UP to the smallest
+  bucket that fits, so the set of signatures the device ever sees is
+  exactly the ladder, precompiled at `start()` (warmup) through
+  `Predictor.compile_signature` (AOT, no example data),
+- **ragged requests ride the repo's padded-dense convention** — an
+  input with a `<name>.seq_len` companion in the saved model's feed
+  list is ragged on its leading (time) axis; the engine pads each
+  request to the seq bucket and synthesizes the int32 companion with
+  true lengths, so kernels mask padding exactly as in training
+  (lod_level=1; nested lod_level=2 serving is rejected loudly),
+- a request that fits NO bucket (wrong dense shape, over-long
+  sequence) fails fast at submit() with a structured
+  `BucketMissError` — it never occupies queue capacity and never
+  reaches the device.
+
+Steady state is therefore ZERO compiles (asserted by tests and the CI
+smoke via `observe.runtime_stats`); a post-warmup compile is emitted as
+a loud `serving_compile_post_warmup` event rather than silently eating
+seconds of serving capacity.
+
+Threading: `submit()`/`infer()` are safe from any number of frontend
+threads; one batcher worker owns dispatch (XLA executions are
+internally thread-safe, but one dispatcher keeps the device queue
+ordered and the occupancy story simple).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..inference import AnalysisConfig, Predictor
+from ..observe.events import RunEventLog
+from ..observe.monitoring import runtime_stats
+from .admission import AdmissionController, ServingError
+from .batcher import DynamicBatcher, Request
+from .stats import ServingStats
+
+
+class BucketMissError(ServingError):
+    """The request fits no configured shape bucket (structured: carries
+    the offending input, its shape, and the allowed buckets)."""
+
+    kind = "bucket_miss"
+
+
+class BucketConfig:
+    """The bounded shape ladder the engine is allowed to compile.
+
+    batch_sizes: ascending batch buckets; the largest is also the
+        batcher's max_batch_size.
+    seq_lens: ascending sequence-length buckets for ragged inputs
+        (None for dense-only models).
+    max_buckets: hard cap on |batch_sizes| × |seq_lens| — warmup
+        compiles every combination, and an unbounded ladder is exactly
+        the shape churn this subsystem exists to prevent.
+    """
+
+    def __init__(self, batch_sizes: Sequence[int] = (1, 2, 4, 8),
+                 seq_lens: Optional[Sequence[int]] = None,
+                 max_buckets: int = 32):
+        self.batch_sizes = self._ladder("batch_sizes", batch_sizes)
+        self.seq_lens = (self._ladder("seq_lens", seq_lens)
+                         if seq_lens is not None else None)
+        n = len(self.batch_sizes) * max(1, len(self.seq_lens or ()))
+        if n > max_buckets:
+            raise ValueError(
+                f"{n} shape buckets exceed max_buckets={max_buckets}: "
+                f"every bucket is an XLA compile at warmup and a "
+                f"resident executable — thin the ladder or raise the "
+                f"cap deliberately")
+        self.n_buckets = n
+
+    @staticmethod
+    def _ladder(name: str, vals) -> Tuple[int, ...]:
+        vals = tuple(int(v) for v in vals)
+        if not vals or any(v < 1 for v in vals) \
+                or list(vals) != sorted(set(vals)):
+            raise ValueError(
+                f"{name} must be ascending unique positive ints, "
+                f"got {vals}")
+        return vals
+
+    @staticmethod
+    def pick(ladder: Tuple[int, ...], need: int) -> Optional[int]:
+        """Smallest bucket >= need (minimum padding waste), or None."""
+        for v in ladder:
+            if v >= need:
+                return v
+        return None
+
+
+class ServingEngine:
+    """Dynamic-batching serving endpoint over a saved inference model.
+
+        engine = ServingEngine(model_dir,
+                               example_feed={"x": np.zeros(16, "f4")},
+                               buckets=BucketConfig((1, 2, 4, 8)))
+        engine.start()                      # warmup: compile the ladder
+        y = engine.infer({"x": x})          # or submit() -> Future
+        engine.close()                      # drain, then stop
+
+    model: a saved-model dir, AnalysisConfig, or an existing Predictor.
+    example_feed: one PER-EXAMPLE array per model input (no batch dim;
+        ragged inputs use their natural (L, ...) shape) — the dtype and
+        trailing-shape template requests are validated against.
+    max_wait_ms: batch window — a request waits at most this long for
+        co-batching before dispatching underfull.
+    queue_capacity: bound on accepted-but-unresolved requests; beyond
+        it submit() fast-rejects with QueueFullError (load shedding).
+    default_deadline_ms: per-request deadline when the caller sets
+        none; expired requests are dropped before dispatch.
+    event_log / log_path: observe.RunEventLog (or a path to create
+        one) for serving_* telemetry events.
+    donate_feeds: donate request buffers to XLA (output reuses input
+        memory).  Default: on for TPU backends, off for CPU.  Leave off
+        if you run() the shared Predictor yourself with device-resident
+        feeds you reuse.
+    """
+
+    def __init__(self, model: Union[str, AnalysisConfig, Predictor],
+                 example_feed: Dict[str, np.ndarray],
+                 buckets: Optional[BucketConfig] = None,
+                 max_wait_ms: float = 5.0, queue_capacity: int = 128,
+                 default_deadline_ms: Optional[float] = None,
+                 event_log: Optional[RunEventLog] = None,
+                 log_path: Optional[str] = None,
+                 stats_window: int = 256,
+                 donate_feeds: Optional[bool] = None):
+        self.predictor = (model if isinstance(model, Predictor)
+                          else Predictor(model))
+        self.buckets = buckets or BucketConfig()
+        feed_names = self.predictor.get_input_names()
+        nested = [n for n in feed_names if n.endswith(".seq_len2")]
+        if nested:
+            raise NotImplementedError(
+                f"nested (lod_level=2) serving inputs not supported: "
+                f"{nested}")
+        companions = {n for n in feed_names if n.endswith(".seq_len")}
+        self._data_names = [n for n in feed_names
+                            if n not in companions]
+        self._ragged = {n for n in self._data_names
+                        if f"{n}.seq_len" in companions}
+        orphan = companions - {f"{n}.seq_len" for n in self._ragged}
+        if orphan:
+            raise ValueError(f"seq_len companions without a data input: "
+                             f"{sorted(orphan)}")
+        missing = set(self._data_names) - set(example_feed)
+        if missing:
+            raise ValueError(
+                f"example_feed missing inputs: {sorted(missing)} "
+                f"(model feeds: {self._data_names})")
+        self._templates = {n: np.asarray(example_feed[n])
+                           for n in self._data_names}
+        if self._ragged and self.buckets.seq_lens is None:
+            raise ValueError(
+                f"model has ragged inputs {sorted(self._ragged)} but "
+                f"BucketConfig has no seq_lens ladder")
+        if not self._ragged and self.buckets.seq_lens is not None:
+            raise ValueError(
+                "BucketConfig.seq_lens given but the model has no "
+                "ragged (.seq_len companion) inputs")
+        for n in self._ragged:
+            if self._templates[n].ndim < 1:
+                raise ValueError(f"ragged input {n!r} example must have "
+                                 f"a leading sequence axis")
+
+        if donate_feeds is None:
+            import jax
+
+            donate_feeds = jax.default_backend() == "tpu"
+        self._donate = bool(donate_feeds)
+
+        self._own_log = None
+        if event_log is None and log_path is not None:
+            event_log = self._own_log = RunEventLog(
+                log_path, meta={"component": "serving_engine"})
+        self.stats = ServingStats(event_log=event_log,
+                                  window=stats_window)
+        self._event_log = event_log
+        self.admission = AdmissionController(
+            queue_capacity, default_deadline_ms=default_deadline_ms)
+        self.batcher = DynamicBatcher(
+            self._dispatch, self.admission,
+            max_batch_size=self.buckets.batch_sizes[-1],
+            max_wait_ms=max_wait_ms,
+            on_deadline_miss=lambda _req:
+                self.stats.record_deadline_miss())
+        self._started = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        """Warmup: AOT-compile every bucket, then open for traffic.
+        After this returns, steady-state serving performs zero XLA
+        compiles (any later compile is a shape leak and is reported)."""
+        with self._lock:
+            if self._started:
+                raise RuntimeError("engine already started")
+            self._started = True
+        if self._event_log is not None:
+            self._event_log.event(
+                "serving_start",
+                buckets={"batch_sizes": list(self.buckets.batch_sizes),
+                         "seq_lens": list(self.buckets.seq_lens)
+                         if self.buckets.seq_lens else None},
+                queue_capacity=self.admission.queue_capacity,
+                max_wait_ms=self.batcher.max_wait_ms,
+                inputs=self._data_names,
+                ragged=sorted(self._ragged),
+                donate_feeds=self._donate)
+        snap = runtime_stats.snapshot()
+        t0 = time.perf_counter()
+        for spec in self._bucket_specs():
+            self.predictor.compile_signature(
+                spec, donate_feeds=self._donate)
+        seconds = time.perf_counter() - t0
+        delta = runtime_stats.delta(snap)
+        self.stats.record_warmup(self.buckets.n_buckets,
+                                 delta["compiles"],
+                                 delta["compile_time_s"], seconds)
+        self.admission.start()
+        self.batcher.start()
+        return self
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Graceful shutdown, phase 1: stop admission (new submits get
+        ServingClosedError), flush open batch windows, wait for every
+        accepted request to resolve.  Idempotent."""
+        self.admission.begin_drain()
+        ok = self.batcher.drain(timeout_s)
+        if self._event_log is not None:
+            self.stats.emit("serving_drain", drained=ok)
+        return ok
+
+    def close(self, timeout_s: float = 60.0):
+        """drain() + stop the worker.  Every future an accepted request
+        ever got is resolved by the time this returns — with a result,
+        or with a structured ServingError."""
+        if self.admission.state == "running":
+            self.drain(timeout_s)
+        self.batcher.shutdown(timeout_s)
+        self.admission.finish_drain()
+        if self._own_log is not None:
+            self._own_log.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start() if not self._started else self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def health(self) -> Dict[str, Any]:
+        return self.admission.health(
+            queue_depth=self.batcher.inflight,
+            buckets=self.buckets.n_buckets,
+            completed=self.stats.completed,
+            post_warmup_compiles=self.stats.post_warmup_compiles())
+
+    # -- request path ---------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               deadline_ms: Optional[float] = None) -> Future:
+        """Accept one request (PER-EXAMPLE feeds, no batch dim) and
+        return a Future of its fetch list.  Raises BucketMissError /
+        QueueFullError / ServingClosedError synchronously — a rejected
+        request never occupies queue capacity."""
+        feeds, max_len = self._normalize(feed)
+        deadline = self.admission.deadline_for(deadline_ms)
+        req = Request(feeds, deadline=deadline, max_len=max_len)
+        try:
+            self.batcher.submit(req)
+        except ServingError as e:
+            if e.kind == "queue_full":
+                self.stats.record_shed()
+            raise
+        self.stats.record_submit(self.batcher.queue_depth)
+        return req.future
+
+    def infer(self, feed: Dict[str, np.ndarray],
+              deadline_ms: Optional[float] = None,
+              timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        """Synchronous submit()+result() convenience."""
+        return self.submit(feed, deadline_ms=deadline_ms).result(
+            timeout_s)
+
+    # -- internals ------------------------------------------------------
+    def _normalize(self, feed: Dict[str, np.ndarray]
+                   ) -> Tuple[Dict[str, np.ndarray], Optional[int]]:
+        unknown = set(feed) - set(self._data_names)
+        if unknown:
+            raise ValueError(
+                f"unknown inputs {sorted(unknown)}; model feeds are "
+                f"{self._data_names} (seq_len companions are "
+                f"synthesized by the engine)")
+        missing = set(self._data_names) - set(feed)
+        if missing:
+            raise ValueError(f"missing inputs: {sorted(missing)}")
+        out: Dict[str, np.ndarray] = {}
+        max_len: Optional[int] = None
+        for n in self._data_names:
+            tpl = self._templates[n]
+            v = np.asarray(feed[n])
+            if v.dtype != tpl.dtype:
+                v = v.astype(tpl.dtype)  # serving frontends send f64
+            if n in self._ragged:
+                if v.ndim != tpl.ndim or v.shape[1:] != tpl.shape[1:]:
+                    raise BucketMissError(
+                        f"ragged input {n!r}: got shape {v.shape}, "
+                        f"want (L,) + {tpl.shape[1:]}",
+                        input=n, got_shape=list(v.shape),
+                        want_tail=list(tpl.shape[1:]))
+                length = v.shape[0]
+                if length < 1:
+                    raise BucketMissError(
+                        f"ragged input {n!r} is empty", input=n,
+                        got_shape=list(v.shape))
+                if BucketConfig.pick(self.buckets.seq_lens,
+                                     length) is None:
+                    self.stats.record_bucket_miss()
+                    raise BucketMissError(
+                        f"ragged input {n!r} length {length} exceeds "
+                        f"the largest seq bucket "
+                        f"{self.buckets.seq_lens[-1]}",
+                        input=n, length=length,
+                        seq_lens=list(self.buckets.seq_lens))
+                max_len = length if max_len is None \
+                    else max(max_len, length)
+            elif v.shape != tpl.shape:
+                self.stats.record_bucket_miss()
+                raise BucketMissError(
+                    f"input {n!r}: got shape {v.shape}, bucketed "
+                    f"shapes require the per-example template "
+                    f"{tpl.shape}", input=n, got_shape=list(v.shape),
+                    want_shape=list(tpl.shape))
+            out[n] = v
+        return out, max_len
+
+    def _bucket_specs(self):
+        """ShapeDtypeStruct feed specs for every ladder combination."""
+        import jax
+
+        for bs in self.buckets.batch_sizes:
+            for sl in (self.buckets.seq_lens or (None,)):
+                spec: Dict[str, jax.ShapeDtypeStruct] = {}
+                for n, tpl in self._templates.items():
+                    if n in self._ragged:
+                        shape = (bs, sl) + tpl.shape[1:]
+                        spec[f"{n}.seq_len"] = jax.ShapeDtypeStruct(
+                            (bs,), np.int32)
+                    else:
+                        shape = (bs,) + tpl.shape
+                    spec[n] = jax.ShapeDtypeStruct(shape, tpl.dtype)
+                yield spec
+
+    def _dispatch(self, requests: Sequence[Request]):
+        """Batcher callback: pad to the smallest fitting bucket,
+        dispatch ONE executable call, demux outputs to futures."""
+        n = len(requests)
+        bucket_b = BucketConfig.pick(self.buckets.batch_sizes, n)
+        assert bucket_b is not None, (n, self.buckets.batch_sizes)
+        bucket_s = None
+        if self._ragged:
+            need = max(r.max_len for r in requests)
+            bucket_s = BucketConfig.pick(self.buckets.seq_lens, need)
+            assert bucket_s is not None, (need, self.buckets.seq_lens)
+
+        feed: Dict[str, np.ndarray] = {}
+        elems_real = elems_padded = 0.0
+        for name, tpl in self._templates.items():
+            if name in self._ragged:
+                arr = np.zeros((bucket_b, bucket_s) + tpl.shape[1:],
+                               dtype=tpl.dtype)
+                # pad rows get length 1, not 0: a zero-length row can
+                # divide-by-zero inside masked kernels (avg pools), and
+                # its output is discarded at demux anyway
+                lens = np.ones((bucket_b,), np.int32)
+                for i, r in enumerate(requests):
+                    v = r.feeds[name]
+                    arr[i, :v.shape[0]] = v
+                    lens[i] = v.shape[0]
+                feed[name] = arr
+                feed[f"{name}.seq_len"] = lens
+                row = float(np.prod(tpl.shape[1:], dtype=np.float64)
+                            or 1.0)
+                elems_real += sum(
+                    r.feeds[name].shape[0] for r in requests) * row
+                elems_padded += bucket_b * bucket_s * row
+            else:
+                arr = np.zeros((bucket_b,) + tpl.shape, dtype=tpl.dtype)
+                for i, r in enumerate(requests):
+                    arr[i] = r.feeds[name]
+                feed[name] = arr
+                row = float(tpl.size or 1.0)
+                elems_real += n * row
+                elems_padded += bucket_b * row
+        t0 = time.perf_counter()
+        outs = self.predictor.run(feed)
+        exec_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.record_batch(n, bucket_b, elems_real, elems_padded,
+                                exec_ms)
+        now = time.monotonic()
+        for i, r in enumerate(requests):
+            # fetches are batch-major; anything without a leading batch
+            # axis (a scalar metric) is handed back whole
+            res = [o[i] if (getattr(o, "ndim", 0) >= 1
+                            and o.shape[0] == bucket_b) else o
+                   for o in outs]
+            r.future.set_result(res)
+            self.stats.record_done((now - r.t_submit) * 1e3)
+        self.stats.maybe_emit()

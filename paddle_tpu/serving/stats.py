@@ -1,0 +1,176 @@
+"""Serving telemetry, wired into the paddle_tpu.observe pillars.
+
+What a serving operator needs to see, and where it comes from:
+
+- **latency percentiles** — p50/p95/p99 of per-request end-to-end time
+  (submit → future resolved) and of per-batch executable time.  Both
+  use `observe.LatencyHistogram` (log-spaced bins, no sample storage).
+  Convention note: on the test/TPU tunnel every dispatch pays ~114 ms
+  RTT, so `exec_ms` is dominated by the tunnel at low occupancy — the
+  batch AMORTIZES that cost over its members, which is exactly the
+  quantity `exec_per_req_ms` reports (the dispatch-amortized compute
+  latency of docs/SERVING.md).
+- **occupancy + padding waste** — real requests per bucket slot, and
+  the fraction of padded elements that carried no data (batch padding
+  + ragged seq padding).  Low occupancy means max_wait_ms is too
+  short or traffic too thin; high waste means the bucket ladder is too
+  coarse.
+- **robustness counters** — shed (queue-full fast rejects), deadline
+  misses (dropped before dispatch), bucket misses.
+- **compile hygiene** — XLA compiles after warmup, from
+  `observe.runtime_stats` (pillar 2).  Steady-state serving must hold
+  this at ZERO; any nonzero value is a shape leak and is emitted as a
+  loud `serving_compile_post_warmup` event.
+
+Snapshots are emitted as structured `serving_window` events through
+`observe.RunEventLog` (pillar 3) every `window` completed requests and
+at drain, carrying run-id/git-sha provenance like every other artifact
+in the repo.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..observe.events import RunEventLog
+from ..observe.monitoring import LatencyHistogram, runtime_stats
+
+
+class ServingStats:
+    """Thread-safe serving counters + histograms + event emission."""
+
+    def __init__(self, event_log: Optional[RunEventLog] = None,
+                 window: int = 256):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        self._lock = threading.Lock()
+        self._event_log = event_log
+        self.window = int(window)
+        self.e2e_ms = LatencyHistogram()
+        self.exec_ms = LatencyHistogram()
+        self.submitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.deadline_misses = 0
+        self.bucket_misses = 0
+        self.batches = 0
+        self._slots = 0           # sum of bucket batch sizes dispatched
+        self._real = 0            # sum of real requests dispatched
+        self._elems_real = 0.0    # element-level fill (ragged-aware)
+        self._elems_padded = 0.0
+        self.max_queue_depth = 0
+        self.warmup: Dict[str, Any] = {}
+        self._rt_base: Optional[Dict[str, Any]] = None
+        self._emitted_at = 0      # completed count at last window emit
+        self._compiles_reported = 0
+
+    # -- recording ------------------------------------------------------
+    def record_warmup(self, n_buckets: int, compiles: int,
+                      compile_s: float, seconds: float):
+        with self._lock:
+            self.warmup = {"buckets": n_buckets, "compiles": compiles,
+                           "compile_s": round(compile_s, 3),
+                           "seconds": round(seconds, 3)}
+            # post-warmup compile accounting starts here
+            self._rt_base = runtime_stats.snapshot()
+        self._emit("serving_warmup", **self.warmup)
+
+    def record_submit(self, queue_depth: int):
+        with self._lock:
+            self.submitted += 1
+            if queue_depth > self.max_queue_depth:
+                self.max_queue_depth = queue_depth
+
+    def record_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def record_deadline_miss(self):
+        with self._lock:
+            self.deadline_misses += 1
+
+    def record_bucket_miss(self):
+        with self._lock:
+            self.bucket_misses += 1
+
+    def record_batch(self, n_real: int, bucket_batch: int,
+                     elems_real: float, elems_padded: float,
+                     exec_ms: float):
+        with self._lock:
+            self.batches += 1
+            self._real += n_real
+            self._slots += bucket_batch
+            self._elems_real += elems_real
+            self._elems_padded += elems_padded
+        self.exec_ms.record(exec_ms)
+
+    def record_done(self, e2e_ms: float):
+        self.e2e_ms.record(e2e_ms)
+        with self._lock:
+            self.completed += 1
+
+    # -- reading --------------------------------------------------------
+    def post_warmup_compiles(self) -> int:
+        """XLA backend compiles since warmup finished (must stay 0 in
+        steady state — the zero-recompile serving contract)."""
+        if self._rt_base is None:
+            return 0
+        return runtime_stats.delta(self._rt_base)["compiles"]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "deadline_misses": self.deadline_misses,
+                "bucket_misses": self.bucket_misses,
+                "batches": self.batches,
+                "max_queue_depth": self.max_queue_depth,
+                "batch_occupancy": round(self._real / self._slots, 4)
+                if self._slots else None,
+                "padding_waste": round(
+                    1.0 - self._elems_real / self._elems_padded, 4)
+                if self._elems_padded else None,
+            }
+            if self.warmup:
+                out["warmup"] = dict(self.warmup)
+        e2e = self.e2e_ms.summary()
+        ex = self.exec_ms.summary()
+        out["e2e_ms"] = e2e
+        out["exec_ms"] = ex
+        # dispatch-amortized compute latency: total executable time
+        # spread over the requests it served
+        out["exec_per_req_ms"] = (round(ex["sum_ms"] / out["completed"], 3)
+                                  if out["completed"] else None)
+        out["post_warmup_compiles"] = self.post_warmup_compiles()
+        return out
+
+    # -- emission (observe pillar 3) ------------------------------------
+    def maybe_emit(self):
+        """Emit a serving_window event every `window` completed
+        requests, plus a loud event the first time a post-warmup
+        compile is observed (a shape leaked past the buckets)."""
+        emit_window = False
+        with self._lock:
+            if self.completed - self._emitted_at >= self.window:
+                self._emitted_at = self.completed
+                emit_window = True
+        compiles = self.post_warmup_compiles()
+        if compiles > self._compiles_reported:
+            self._compiles_reported = compiles
+            self._emit("serving_compile_post_warmup",
+                       post_warmup_compiles=compiles)
+        if emit_window:
+            self.emit()
+
+    def emit(self, kind: str = "serving_window", **extra: Any):
+        snap = self.snapshot()
+        snap.update(extra)
+        self._emit(kind, **snap)
+        return snap
+
+    def _emit(self, kind: str, **fields: Any):
+        if self._event_log is not None:
+            self._event_log.event(kind, **fields)

@@ -1,0 +1,358 @@
+"""Serving subsystem tests (CPU backend).
+
+The production contracts from docs/SERVING.md, pinned:
+- batcher correctness: concurrent submitters get exactly the answers a
+  per-request reference run produces (demux never crosses wires),
+- zero XLA compiles after warmup (observe.runtime_stats counters),
+- structured bucket-miss / shed / deadline / closed rejections,
+- drain leaves no orphaned futures,
+- ragged inputs bucket on the seq axis with the `<name>.seq_len`
+  companion synthesized by the engine,
+- offered-load throughput beats per-request dispatch (the acceptance
+  bar, at a deliberately modest margin on CPU).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.observe import read_events, runtime_stats
+from paddle_tpu.serving import (BucketConfig, BucketMissError,
+                                DeadlineExceededError, QueueFullError,
+                                ServingClosedError, ServingEngine)
+
+
+@pytest.fixture(scope="module")
+def mlp_dir(tmp_path_factory):
+    """A small saved inference model: fc-relu-fc over 16 features."""
+    d = str(tmp_path_factory.mktemp("serving_mlp"))
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[16], append_batch_size=True)
+        h = layers.fc(x, size=32, act="relu")
+        pred = layers.fc(h, size=4)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+    return d
+
+
+def _engine(mlp_dir, **kw):
+    kw.setdefault("buckets", BucketConfig((1, 2, 4, 8)))
+    kw.setdefault("max_wait_ms", 10)
+    kw.setdefault("queue_capacity", 64)
+    return ServingEngine(mlp_dir, {"x": np.zeros(16, np.float32)}, **kw)
+
+
+def test_concurrent_submitters_match_reference(mlp_dir):
+    rng = np.random.RandomState(7)
+    xs = rng.rand(24, 16).astype(np.float32)
+    # reference BEFORE the engine snapshot: one request at a time
+    ref_pred = fluid.Predictor(mlp_dir)
+    refs = [ref_pred.run({"x": xs[i:i + 1]})[0][0] for i in range(24)]
+
+    engine = _engine(mlp_dir).start()
+    outs = [None] * 24
+
+    def client(i):
+        outs[i] = engine.infer({"x": xs[i]}, timeout_s=60)[0]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.close()
+    for i in range(24):
+        assert outs[i] is not None, f"request {i} unresolved"
+        assert outs[i].shape == (4,)
+        # batched row must be THIS request's answer (demux wiring)
+        np.testing.assert_allclose(outs[i], refs[i], rtol=1e-5,
+                                   atol=1e-6)
+    snap = engine.stats.snapshot()
+    assert snap["completed"] == 24
+    assert snap["batches"] >= 3  # max bucket is 8
+    assert snap["batch_occupancy"] is not None
+
+
+def test_zero_compiles_after_warmup(mlp_dir):
+    engine = _engine(mlp_dir).start()
+    assert engine.stats.warmup["buckets"] == 4
+    snap = runtime_stats.snapshot()
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        # odd batch sizes (3, then singles) still land on bucket shapes
+        futs = [engine.submit({"x": rng.rand(16).astype(np.float32)})
+                for _ in range(3)]
+        for f in futs:
+            f.result(60)
+    assert runtime_stats.delta(snap)["compiles"] == 0
+    assert engine.stats.post_warmup_compiles() == 0
+    assert engine.health()["post_warmup_compiles"] == 0
+    engine.close()
+
+
+def test_bucket_miss_is_structured_and_fast(mlp_dir):
+    engine = _engine(mlp_dir).start()
+    with pytest.raises(BucketMissError) as ei:
+        engine.submit({"x": np.zeros(17, np.float32)})
+    d = ei.value.as_dict()
+    assert d["error"] == "bucket_miss"
+    assert d["input"] == "x"
+    assert d["got_shape"] == [17]
+    assert d["want_shape"] == [16]
+    # a rejected request never occupied queue capacity
+    assert engine.batcher.inflight == 0
+    assert engine.stats.snapshot()["bucket_misses"] == 1
+    with pytest.raises(ValueError):
+        engine.submit({"x": np.zeros(16, np.float32), "bogus": 1})
+    engine.close()
+
+
+def test_deadline_expired_dropped_before_dispatch(mlp_dir):
+    # window (80 ms) longer than the deadline (5 ms): the request
+    # expires while queued and must be dropped, not computed
+    engine = _engine(mlp_dir, max_wait_ms=80).start()
+    fut = engine.submit({"x": np.zeros(16, np.float32)}, deadline_ms=5)
+    with pytest.raises(DeadlineExceededError) as ei:
+        fut.result(60)
+    assert ei.value.as_dict()["queued_ms"] >= 5
+    assert engine.stats.snapshot()["deadline_misses"] == 1
+    # the engine is still healthy for fresh requests
+    out = engine.infer({"x": np.zeros(16, np.float32)}, timeout_s=60)
+    assert out[0].shape == (4,)
+    engine.close()
+
+
+def test_overload_sheds_structured_not_unbounded(mlp_dir):
+    # max_batch_size (16) > capacity (12): the forming batch can never
+    # fill and dispatch early, so all accepted requests stay parked in
+    # the 400 ms window while the overload arrives — the shed count is
+    # deterministic, not a race against dispatch latency
+    engine = _engine(mlp_dir, buckets=BucketConfig((1, 2, 4, 16)),
+                     queue_capacity=12, max_wait_ms=400).start()
+    x = np.zeros(16, np.float32)
+    accepted, shed = [], []
+    for i in range(24):  # 2x queue capacity
+        try:
+            accepted.append(engine.submit({"x": x}))
+        except QueueFullError as e:
+            shed.append(e)
+    assert len(accepted) == 12
+    assert len(shed) == 12
+    d = shed[0].as_dict()
+    assert d["error"] == "queue_full" and d["capacity"] == 12
+    assert engine.batcher.inflight <= 12  # hard bound held
+    # accepted work still completes (no deadlock under overload)
+    for f in accepted:
+        assert f.result(60)[0].shape == (4,)
+    snap = engine.stats.snapshot()
+    assert snap["shed"] == 12 and snap["completed"] == 12
+    engine.close()
+
+
+def test_drain_leaves_no_orphan_futures(mlp_dir):
+    # long window: requests are parked mid-window when drain begins
+    engine = _engine(mlp_dir, max_wait_ms=2000).start()
+    x = np.zeros(16, np.float32)
+    futs = [engine.submit({"x": x}) for _ in range(5)]
+    t0 = time.monotonic()
+    assert engine.drain(timeout_s=30)  # flushes the open window NOW
+    assert time.monotonic() - t0 < 10  # did not sit out the window
+    for f in futs:
+        assert f.done()
+        assert f.result()[0].shape == (4,)
+    # draining engine refuses new work with the structured error
+    with pytest.raises(ServingClosedError):
+        engine.submit({"x": x})
+    engine.close()
+    assert engine.admission.state == "stopped"
+
+
+def test_shutdown_without_drain_fails_pending_futures(mlp_dir):
+    engine = _engine(mlp_dir, max_wait_ms=5000).start()
+    x = np.zeros(16, np.float32)
+    futs = [engine.submit({"x": x}) for _ in range(3)]
+    engine.admission.begin_drain()
+    engine.batcher.shutdown(timeout_s=30)  # no drain: abandon queue
+    engine.admission.finish_drain()
+    for f in futs:
+        assert f.done()  # resolved either way — never orphaned
+        if f.exception() is not None:
+            assert isinstance(f.exception(), ServingClosedError)
+
+
+def test_serving_events_emitted_with_provenance(mlp_dir, tmp_path):
+    log_path = str(tmp_path / "serving_events.jsonl")
+    engine = _engine(mlp_dir, log_path=log_path, stats_window=4).start()
+    rng = np.random.RandomState(1)
+    for _ in range(9):
+        engine.infer({"x": rng.rand(16).astype(np.float32)},
+                     timeout_s=60)
+    engine.close()
+    events = read_events(log_path)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_begin"
+    assert "serving_start" in kinds and "serving_warmup" in kinds
+    assert "serving_window" in kinds and "serving_drain" in kinds
+    assert "serving_compile_post_warmup" not in kinds
+    run_ids = {e["run_id"] for e in events}
+    assert len(run_ids) == 1  # one run-id stamps every record
+    drain = [e for e in events if e["event"] == "serving_drain"][-1]
+    # the drain snapshot carries the full serving telemetry schema
+    for key in ("completed", "batches", "batch_occupancy",
+                "padding_waste", "e2e_ms", "exec_ms",
+                "exec_per_req_ms", "post_warmup_compiles", "shed",
+                "deadline_misses"):
+        assert key in drain, key
+    assert drain["completed"] == 9
+    assert drain["post_warmup_compiles"] == 0
+    assert drain["e2e_ms"]["p50_ms"] > 0
+    assert drain["e2e_ms"]["p99_ms"] >= drain["e2e_ms"]["p50_ms"]
+    json.dumps(drain)  # snapshot stays json-serializable
+
+
+def test_bucket_config_caps_and_validates():
+    with pytest.raises(ValueError, match="max_buckets"):
+        BucketConfig(tuple(2 ** i for i in range(8)),
+                     seq_lens=(64, 128, 256, 512, 1024),
+                     max_buckets=32)
+    with pytest.raises(ValueError, match="ascending"):
+        BucketConfig((4, 2, 1))
+    assert BucketConfig.pick((1, 2, 4, 8), 3) == 4
+    assert BucketConfig.pick((1, 2, 4, 8), 9) is None
+
+
+def test_dense_model_rejects_seq_lens(mlp_dir):
+    with pytest.raises(ValueError, match="no.*ragged"):
+        ServingEngine(mlp_dir, {"x": np.zeros(16, np.float32)},
+                      buckets=BucketConfig((1, 2), seq_lens=(8, 16)))
+
+
+@pytest.fixture(scope="module")
+def ragged_dir(tmp_path_factory):
+    """Saved model with a ragged (lod_level=1) input: masked sum-pool
+    over a padded (B, T, 4) sequence, then fc."""
+    d = str(tmp_path_factory.mktemp("serving_ragged"))
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[-1, 4], dtype="float32",
+                        append_batch_size=True, lod_level=1)
+        pooled = layers.sequence_pool(x, pool_type="sum")
+        pred = layers.fc(pooled, size=3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x", "x.seq_len"], [pred],
+                                      exe, main_program=main)
+    return d
+
+
+def test_ragged_seq_bucketing_matches_reference(ragged_dir):
+    rng = np.random.RandomState(3)
+    lens = [3, 7, 8, 1, 12, 16, 5, 9]
+    seqs = [rng.rand(n, 4).astype(np.float32) for n in lens]
+
+    # reference: each request alone, padded to ITS seq bucket
+    ref_pred = fluid.Predictor(ragged_dir)
+    refs = []
+    for s in seqs:
+        bucket = 8 if len(s) <= 8 else 16
+        padded = np.zeros((1, bucket, 4), np.float32)
+        padded[0, :len(s)] = s
+        refs.append(ref_pred.run(
+            {"x": padded,
+             "x.seq_len": np.asarray([len(s)], np.int32)})[0][0])
+
+    engine = ServingEngine(
+        ragged_dir, {"x": np.zeros((1, 4), np.float32)},
+        buckets=BucketConfig((1, 2, 4, 8), seq_lens=(8, 16)),
+        max_wait_ms=20, queue_capacity=32).start()
+    snap = runtime_stats.snapshot()
+    futs = [engine.submit({"x": s}) for s in seqs]
+    outs = [f.result(60)[0] for f in futs]
+    # mixed-length requests co-batched: the synthesized seq_len
+    # companion must mask each row's padding exactly
+    for got, ref in zip(outs, refs):
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert runtime_stats.delta(snap)["compiles"] == 0
+    s = engine.stats.snapshot()
+    assert s["padding_waste"] is not None and s["padding_waste"] > 0
+
+    # over-long sequence: structured miss naming the ladder
+    with pytest.raises(BucketMissError) as ei:
+        engine.submit({"x": rng.rand(17, 4).astype(np.float32)})
+    d = ei.value.as_dict()
+    assert d["length"] == 17 and d["seq_lens"] == [8, 16]
+    engine.close()
+
+
+def test_ragged_model_requires_seq_lens(ragged_dir):
+    with pytest.raises(ValueError, match="seq_lens"):
+        ServingEngine(ragged_dir, {"x": np.zeros((1, 4), np.float32)},
+                      buckets=BucketConfig((1, 2)))
+
+
+def test_offered_load_beats_per_request(mlp_dir):
+    """Acceptance bar: at a fixed offered load the engine sustains
+    higher throughput than per-request dispatch (CPU margin is modest;
+    the tunnel RTT amortization on TPU is the real win).  Wall-clock
+    comparisons on a shared CI box are noisy, so the structural win is
+    taken as the best of 3 attempts — a structurally slower engine
+    still fails all three."""
+    rng = np.random.RandomState(11)
+    n = 48
+    xs = rng.rand(n, 16).astype(np.float32)
+
+    pred = fluid.Predictor(mlp_dir)
+    pred.run({"x": xs[0:1]})  # compile outside the timed window
+    engine = _engine(mlp_dir, max_wait_ms=2,
+                     queue_capacity=64).start()
+    engine.infer({"x": xs[0]}, timeout_s=60)  # warm dispatch path
+
+    def per_request_pass():
+        t0 = time.perf_counter()
+        for i in range(n):
+            pred.run({"x": xs[i:i + 1]})
+        return time.perf_counter() - t0
+
+    def engine_pass():
+        results = [None] * n
+
+        def client(k):
+            for i in range(k, n, 12):
+                results[i] = engine.infer({"x": xs[i]}, timeout_s=60)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(12)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        assert all(r is not None for r in results)
+        return elapsed
+
+    attempts = []
+    for _ in range(3):
+        per_req_s = per_request_pass()
+        engine_s = engine_pass()
+        attempts.append((engine_s, per_req_s))
+        if engine_s < per_req_s:
+            break
+    snap = engine.stats.snapshot()
+    engine.close()
+    assert snap["post_warmup_compiles"] == 0
+    # batching actually amortized dispatches (structural, not timing)
+    assert snap["batches"] < snap["completed"]
+    # "measurably higher": same work in less wall time
+    assert any(e < p for e, p in attempts), attempts

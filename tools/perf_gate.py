@@ -38,8 +38,9 @@ import os
 import sys
 
 # headline metrics: higher is better, keyed by per-model detail entries
+# (requests_per_sec = the serving_engine offered-load line)
 _THROUGHPUT_KEYS = ("tokens_per_sec", "imgs_per_sec",
-                    "examples_per_sec")
+                    "examples_per_sec", "requests_per_sec")
 # serving latency: lower is better
 _LATENCY_KEYS = ("compute_ms",)
 

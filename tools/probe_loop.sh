@@ -9,10 +9,15 @@
 #   2026-07-31T02:30:12Z DOWN timeout>90s
 #
 # On the first UP it also touches docs/PROBE_UP.flag so a glance at the
-# repo root answers "has the tunnel been alive at any point this round".
+# repo root answers "is the probe loop up and has it seen the tunnel
+# alive".  The flag is removed when the loop exits (trap below): a
+# stale flag must not outlive the loop as evidence (VERDICT r5) — and
+# bench.py treats a FRESH flag as a live attach hazard, so cleanup also
+# stops killed-loop residue from tainting later bench lines.
 # Runs until killed; intended to be started detached at round start.
 set -u
 cd "$(dirname "$0")/.."
+trap 'rm -f docs/PROBE_UP.flag' EXIT HUP INT TERM
 LOG=docs/PROBE_r05.log
 INTERVAL="${PROBE_INTERVAL:-1200}"
 TIMEOUT="${PROBE_TIMEOUT:-90}"

@@ -38,6 +38,59 @@ print("telemetry smoke OK:",
       {k: out.get(k) for k in ("compile_s", "retraces", "peak_mem_bytes")})
 EOF
 
+echo "== serving engine smoke (cpu) =="
+# the production-serving contract end-to-end: engine start (bucket
+# warmup) -> concurrent requests -> drain, with ZERO XLA compiles
+# after warmup and every answer matching a per-request reference
+# (docs/SERVING.md)
+python - <<'EOF'
+import tempfile, threading
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.observe import runtime_stats
+from paddle_tpu.serving import BucketConfig, ServingEngine
+
+rng = np.random.RandomState(0)
+d = tempfile.mkdtemp()
+main, startup = fluid.Program(), fluid.Program()
+scope = fluid.Scope()
+with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+    x = layers.data("x", shape=[16], append_batch_size=True)
+    pred = layers.fc(layers.fc(x, size=32, act="relu"), size=4)
+    exe = fluid.Executor()
+    exe.run(startup)
+    fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                  main_program=main)
+xs = rng.rand(32, 16).astype(np.float32)
+ref = fluid.Predictor(d)
+refs = [ref.run({"x": xs[i:i + 1]})[0][0] for i in range(32)]
+
+engine = ServingEngine(d, {"x": np.zeros(16, np.float32)},
+                       buckets=BucketConfig((1, 2, 4, 8)),
+                       max_wait_ms=5, queue_capacity=64).start()
+snap = runtime_stats.snapshot()
+outs = [None] * 32
+def client(i):
+    outs[i] = engine.infer({"x": xs[i]}, timeout_s=120)[0]
+threads = [threading.Thread(target=client, args=(i,)) for i in range(32)]
+[t.start() for t in threads]; [t.join() for t in threads]
+assert engine.drain(timeout_s=60), "drain timed out"
+engine.close()
+for i in range(32):
+    np.testing.assert_allclose(outs[i], refs[i], rtol=1e-5, atol=1e-6)
+compiles = runtime_stats.delta(snap)["compiles"]
+assert compiles == 0, f"{compiles} XLA compiles AFTER warmup (shape leak)"
+s = engine.stats.snapshot()
+assert s["completed"] == 32 and s["post_warmup_compiles"] == 0
+print("serving smoke OK:",
+      {k: s[k] for k in ("completed", "batches", "batch_occupancy",
+                         "post_warmup_compiles")})
+EOF
+
 echo "== perf gate (schema + synthetic-regression smoke, cpu) =="
 # 1. the fresh bench line must satisfy the observability schema
 python tools/perf_gate.py --schema --candidate /tmp/bench_ci_line.json
