@@ -45,6 +45,7 @@ from . import imperative  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import metrics  # noqa: F401
 from . import observe  # noqa: F401
+from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import profiler  # noqa: F401
 from .data.data_feeder import DataFeeder  # noqa: F401

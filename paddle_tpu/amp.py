@@ -56,12 +56,19 @@ class OptimizerWithMixedPrecision:
     """Optimizer wrapper: marks the program as amp at minimize() time.
 
     The wrapped optimizer is unchanged — master weights are the normal
-    f32 params, so every optimizer composes with amp.
+    f32 params, so every optimizer composes with amp.  With
+    `loss_scaling` set (a resilience.LossScaleConfig), minimize() also
+    enables the in-step non-finite update guard with dynamic loss
+    scaling (resilience/guard.py) — the fp16 transpiler's scale
+    machinery, TPU-native.
     """
 
-    def __init__(self, optimizer, amp_lists: Optional[AutoMixedPrecisionLists]):
+    def __init__(self, optimizer,
+                 amp_lists: Optional[AutoMixedPrecisionLists],
+                 loss_scaling=None):
         self._optimizer = optimizer
         self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._loss_scaling = loss_scaling
 
     def __getattr__(self, name):
         return getattr(self._optimizer, name)
@@ -71,14 +78,44 @@ class OptimizerWithMixedPrecision:
         program = loss.block.program
         program._amp_lists = self._amp_lists
         program._bump()
-        return self._optimizer.minimize(
+        result = self._optimizer.minimize(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)
+        if self._loss_scaling is not None:
+            # after minimize: the guard must see the full op list
+            # (backward marker + update ops are appended by now)
+            from .resilience.guard import enable_update_guard
+
+            enable_update_guard(program, loss_scaling=self._loss_scaling)
+        return result
 
 
-def decorate(optimizer, amp_lists: Optional[AutoMixedPrecisionLists] = None):
-    """Wrap `optimizer` for bf16 mixed-precision training."""
-    return OptimizerWithMixedPrecision(optimizer, amp_lists)
+def decorate(optimizer, amp_lists: Optional[AutoMixedPrecisionLists] = None,
+             use_dynamic_loss_scaling: bool = False,
+             init_loss_scaling: float = 2.0 ** 15,
+             incr_every_n_steps: int = 1000,
+             decr_every_n_nan_or_inf: int = 1,
+             incr_ratio: float = 2.0, decr_ratio: float = 0.5):
+    """Wrap `optimizer` for bf16 mixed-precision training.
+
+    use_dynamic_loss_scaling: enable the device-side loss-scale
+        schedule + non-finite update guard (reference: fluid's
+        decorate(init_loss_scaling=..., use_dynamic_loss_scaling=True)
+        fp16 API).  bf16 usually needs no scaling (f32 dynamic range) —
+        this is the fp16/overflow-hardening opt-in; the update guard it
+        brings protects bf16 runs from NaN steps too.
+    """
+    loss_scaling = None
+    if use_dynamic_loss_scaling:
+        from .resilience.guard import LossScaleConfig
+
+        loss_scaling = LossScaleConfig(
+            init_loss_scaling=init_loss_scaling,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio)
+    return OptimizerWithMixedPrecision(optimizer, amp_lists,
+                                       loss_scaling=loss_scaling)
 
 
 def cast_ins_for_op(op_type: str, ins, amp_lists: AutoMixedPrecisionLists):

@@ -81,16 +81,22 @@ class Trainer:
 
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  place=None, checkpoint_config: Optional[CheckpointConfig]
-                 = None, scope: Optional[Scope] = None, telemetry=None):
+                 = None, scope: Optional[Scope] = None, telemetry=None,
+                 step_deadline_s: Optional[float] = None):
         """telemetry: an observe.TelemetryConfig — enables the
         device-side StepTelemetry accumulator on the train program and
         publishes a window (telemetry means + compile/retrace/dispatch
         runtime stats) every `interval` steps, to the configured JSONL
         event log when one is given.  The accumulator lives inside the
         jitted step; the only added host traffic is ONE fetch per
-        window (never per-step — CLAUDE.md tunnel-backend rule)."""
+        window (never per-step — CLAUDE.md tunnel-backend rule).
+
+        step_deadline_s: wall-clock watchdog around each training step
+        (resilience.Deadline) — a hung compile/dispatch raises a
+        structured WatchdogTimeout instead of stalling forever."""
         self.checkpoint_cfg = checkpoint_config
         self.telemetry_cfg = telemetry
+        self.step_deadline_s = step_deadline_s
         self.scope = scope or Scope()
         self.startup_program = Program()
         self.train_program = Program()
@@ -148,9 +154,26 @@ class Trainer:
                     continue
         return sorted(ids)
 
+    def _emit(self, kind: str, **fields):
+        """Checkpoint/resume lifecycle events go to the event log when
+        one is configured AND to stderr — a resume that silently
+        skipped a corrupt checkpoint is an incident nobody can debug."""
+        import sys
+
+        if self._event_log:
+            self._event_log.event(kind, **fields)
+        print(f"Trainer {kind}: "
+              + " ".join(f"{k}={v}" for k, v in fields.items()),
+              file=sys.stderr)
+
     def _save_checkpoint(self, serial: int, epoch: int, step: int):
         root = self._ckpt_root()
         path = os.path.join(root, f"ckpt_{serial}")
+        if os.path.isdir(path) and not os.path.exists(
+                os.path.join(path, "__trainer_state__.json")):
+            # leftover of a save that died mid-write (torn): clear it so
+            # stale shard files cannot mix with the fresh save
+            shutil.rmtree(path, ignore_errors=True)
         os.makedirs(path, exist_ok=True)
         with scope_guard(self.scope):
             # sharded writer: each process persists only its own array
@@ -166,11 +189,12 @@ class Trainer:
             victim = os.path.join(root, f"ckpt_{ids.pop(0)}")
             shutil.rmtree(victim, ignore_errors=True)
 
-    def _try_resume(self):
-        ids = self._list_checkpoints()
-        if not ids:
-            return
-        path = os.path.join(self._ckpt_root(), f"ckpt_{ids[-1]}")
+    def _load_checkpoint(self, path: str) -> dict:
+        """Load one checkpoint dir (arrays + trainer cursor) or raise a
+        structured CheckpointError (resilience/errors.py)."""
+        from ..resilience.errors import (CheckpointCorruptError,
+                                         CheckpointNotFoundError)
+
         with scope_guard(self.scope):
             if os.path.exists(os.path.join(path,
                                            fluid_io.SHARD_MANIFEST)):
@@ -186,10 +210,45 @@ class Trainer:
                 # checkpoint from the pre-sharded combined format
                 fluid_io.load_persistables(self.exe, path,
                                            main_program=self.train_program)
-        with open(os.path.join(path, "__trainer_state__.json")) as f:
-            st = json.load(f)
-        self._resume_epoch = int(st.get("epoch", 0))
-        self._resume_step_in_epoch = int(st.get("step", 0))
+        state_path = os.path.join(path, "__trainer_state__.json")
+        try:
+            with open(state_path) as f:
+                return json.load(f)
+        except FileNotFoundError as e:
+            raise CheckpointNotFoundError(
+                f"checkpoint {path!r} has no trainer state (torn save)",
+                dirname=path) from e
+        except (json.JSONDecodeError, OSError) as e:
+            raise CheckpointCorruptError(
+                f"unreadable trainer state {state_path!r}: {e}",
+                dirname=path, cause=f"{type(e).__name__}: {e}") from e
+
+    def _try_resume(self):
+        """Resume from the NEWEST VALID checkpoint: serials are tried
+        newest-first, and a torn/corrupt/incomplete one is skipped with
+        a loud `ckpt_fallback` record — never a raw numpy/JSON error,
+        never a silent fresh start when an older valid serial exists."""
+        from ..resilience.errors import CheckpointError
+
+        ids = self._list_checkpoints()
+        for serial in reversed(ids):
+            path = os.path.join(self._ckpt_root(), f"ckpt_{serial}")
+            try:
+                st = self._load_checkpoint(path)
+            except CheckpointError as e:
+                self._emit("ckpt_fallback", serial=serial,
+                           error=e.as_dict())
+                continue
+            self._resume_epoch = int(st.get("epoch", 0))
+            self._resume_step_in_epoch = int(st.get("step", 0))
+            if serial != ids[-1] or self._event_log:
+                self._emit("ckpt_resume", serial=serial,
+                           epoch=self._resume_epoch,
+                           step=self._resume_step_in_epoch,
+                           fallback=serial != ids[-1])
+            return
+        if ids:
+            self._emit("ckpt_resume_failed", tried=list(reversed(ids)))
 
     # -- the loop --------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Optional[Callable]
@@ -233,7 +292,11 @@ class Trainer:
                     batch = dict(zip(feed_order, batch))
                 begin = BeginStepEvent(epoch, step)
                 handler(begin)
-                with scope_guard(self.scope):
+                from ..resilience.watchdog import Deadline
+
+                with scope_guard(self.scope), \
+                        Deadline(self.step_deadline_s or 0,
+                                 what=f"train step {epoch}/{step}"):
                     metrics = self.exe.run(
                         self.train_program, feed=batch,
                         fetch_list=fetch if begin.fetch_metrics else [])

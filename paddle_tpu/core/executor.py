@@ -407,38 +407,93 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
             loss = jnp.squeeze(loss)
         return loss, e
 
+    # resilience update guard (resilience/guard.py): dynamic loss
+    # scaling wraps the loss BEFORE autodiff; the all-finite check +
+    # update select happen below.  All of it is pure jnp inside this
+    # trace — the step remains ONE XLA computation.
+    from ..observe import metrics as _obs_metrics
+
+    guard_cfg = getattr(program, "_update_guard", None)
+    scale = None
+    if (guard_cfg is not None and guard_cfg.loss_scaling is not None
+            and _obs_metrics.TELEMETRY_VAR in env):
+        import jax.numpy as jnp
+
+        scale = jnp.asarray(
+            env[_obs_metrics.TELEMETRY_VAR]["loss_scale"], jnp.float32)
+
+    grad_fwd = fwd
+    if scale is not None:
+        def grad_fwd(params, base_env, key, sparse_rows=None):
+            loss, e = fwd(params, base_env, key,
+                          sparse_rows=sparse_rows)
+            return loss * scale, e
+
     sparse_lookups = _find_sparse_lookups(fwd_ops, trainable, env)
     if accum_steps <= 1:
         if sparse_lookups:
             loss_val, grads, env = _sparse_value_and_grad(
-                fwd, fwd_ops, sparse_lookups, trainable, env, rng_key)
+                grad_fwd, fwd_ops, sparse_lookups, trainable, env,
+                rng_key)
         else:
             (loss_val, env_after), grads = jax.value_and_grad(
-                fwd, has_aux=True)(trainable, env, rng_key)
+                grad_fwd, has_aux=True)(trainable, env, rng_key)
             env = env_after
     else:
         # accumulation + sparse grads: dense fallback (SparseGrads don't
         # zeros_like/add in the scan carry); correctness is identical
         loss_val, grads, env = _accumulate_gradients(
-            program, fwd, fwd_ops, trainable, env, rng_key,
+            program, grad_fwd, fwd_ops, trainable, env, rng_key,
             accum_steps, feed_names, fetch_names, loss_name)
+    if scale is not None:
+        # unscale before the finite check and the update ops: the
+        # optimizer must see master-scale gradients
+        from ..resilience import guard as _guard
+
+        inv = 1.0 / scale
+        loss_val = loss_val * inv
+        grads = _guard.scale_grads(grads, inv)
+        if accum_steps > 1 and loss_name in env:
+            # the accumulation scan surfaced the scaled loss
+            env[loss_name] = env[loss_name] * inv
+    finite = None
+    pre_update: Dict[str, Any] = {}
+    if guard_cfg is not None:
+        from ..resilience import guard as _guard
+
+        finite = _guard.all_finite(loss_val, grads)
+        written = set()
+        for op in rest_ops[1:]:
+            written.update(op.desc.output_names())
+        pre_update = _guard.snapshot_env(env, written)
     env[grad_var_name(loss_name)] = loss_val * 0 + 1.0
     for pname, g in grads.items():
         env[grad_var_name(pname)] = g
     # rest_ops[0] is the `backward_marker` op itself; skip it.
     run_ops(rest_ops[1:], env, rng_key, start_index=k + 1,
             amp_lists=amp_lists, program=program)
+    if finite is not None:
+        # a non-finite step becomes a full state no-op: every value the
+        # update ops wrote selects back to its pre-update snapshot
+        from ..resilience import guard as _guard
+
+        _guard.select_updates(finite, env, pre_update)
     if getattr(program, "_telemetry_enabled", False):
         # device-side telemetry accumulation (observe pillar 2): pure
         # jnp over values already live in the trace — grads, loss, and
         # the pre/post-update params — so the step stays ONE fused XLA
         # computation with no callbacks/host syncs
-        from ..observe import metrics as _obs_metrics
-
         if _obs_metrics.TELEMETRY_VAR in env:
             env[_obs_metrics.TELEMETRY_VAR] = _obs_metrics.device_update(
                 env[_obs_metrics.TELEMETRY_VAR], loss_val, grads,
                 trainable, env)
+            if finite is not None:
+                from ..resilience import guard as _guard
+
+                env[_obs_metrics.TELEMETRY_VAR] = \
+                    _guard.guard_telemetry_update(
+                        env[_obs_metrics.TELEMETRY_VAR], finite,
+                        guard_cfg)
     return env
 
 
@@ -817,8 +872,12 @@ class Executor:
             # carried through chain_iterations); creating it here keeps
             # enable_telemetry() a pure program-level flag flip
             if scope.find_var(_obs_metrics.TELEMETRY_VAR) is None:
-                scope.set_var(_obs_metrics.TELEMETRY_VAR,
-                              _obs_metrics.init_telemetry())
+                guard_cfg = getattr(program, "_update_guard", None)
+                scope.set_var(
+                    _obs_metrics.TELEMETRY_VAR,
+                    _obs_metrics.init_telemetry(
+                        loss_scale=guard_cfg.init_loss_scale
+                        if guard_cfg is not None else 1.0))
             state_names = state_names + (_obs_metrics.TELEMETRY_VAR,)
         key = (program._uid, program._version, tuple(sorted(feed)),
                tuple(fetch_names), state_names, iterations,

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -27,11 +28,97 @@ from .core.desc import (PROGRAM_FORMAT_VERSION, dump_program_dict,
                         load_program_dict)
 from .core.executor import Executor, Scope, global_scope
 from .core.program import Parameter, Program, Variable
+from .resilience.errors import (CheckpointCorruptError,
+                                CheckpointFormatError,
+                                CheckpointIncompleteError,
+                                CheckpointNotFoundError)
 
 MODEL_FILENAME = "__model__"
 MANIFEST = "__manifest__.json"
 # serialized AOT inference artifact (written by inference.py)
 EXPORT_FILENAME = "__model__.export"
+
+
+def _read_manifest(dirname: str, name: str) -> dict:
+    """Manifest read with the structured CheckpointError contract:
+    missing file → CheckpointNotFoundError (a save that died before its
+    manifest is *by design* not a checkpoint), unparseable JSON →
+    CheckpointCorruptError, newer format → CheckpointFormatError."""
+    path = os.path.join(dirname, name)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except FileNotFoundError as e:
+        raise CheckpointNotFoundError(
+            f"no checkpoint manifest {name!r} in {dirname!r} (missing "
+            f"or torn/incomplete save)", dirname=dirname,
+            manifest=name) from e
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest {path!r}: {e}",
+            dirname=dirname, manifest=name,
+            cause=f"{type(e).__name__}: {e}") from e
+    version = manifest.get("version", 0)
+    if version > PROGRAM_FORMAT_VERSION:
+        raise CheckpointFormatError(
+            f"checkpoint in {dirname!r} written by format version "
+            f"{version}; this build reads <= {PROGRAM_FORMAT_VERSION}",
+            dirname=dirname, manifest=name, version=version,
+            supported=PROGRAM_FORMAT_VERSION)
+    return manifest
+
+
+def _short(e: BaseException) -> str:
+    """Error summary safe to embed in messages/events (BadZipFile can
+    quote kilobytes of raw archive bytes)."""
+    s = str(e)
+    return f"{type(e).__name__}: {s[:160]}{'…' if len(s) > 160 else ''}"
+
+
+def _open_container(dirname: str, fname: str, files: dict):
+    """np.load a shard/param container with structured errors (cached
+    in `files`)."""
+    if fname in files:
+        return files[fname]
+    path = os.path.join(dirname, fname)
+    try:
+        files[fname] = np.load(path)
+    except FileNotFoundError as e:
+        raise CheckpointIncompleteError(
+            f"checkpoint {dirname!r} manifest references missing file "
+            f"{fname!r}", dirname=dirname, file=fname) from e
+    except Exception as e:  # noqa: BLE001 — BadZipFile/zlib/ValueError
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint container {path!r}: {_short(e)}",
+            dirname=dirname, file=fname, cause=_short(e)) from e
+    return files[fname]
+
+
+def _read_member(container, dirname: str, fname: str, key: str,
+                 want_crc: Optional[int]) -> np.ndarray:
+    """One stored array out of a container, CRC32-verified against the
+    manifest record when present (older checkpoints without CRCs still
+    load)."""
+    try:
+        piece = container[key]
+    except KeyError as e:
+        raise CheckpointIncompleteError(
+            f"checkpoint container {fname!r} in {dirname!r} is missing "
+            f"key {key!r}", dirname=dirname, file=fname, key=key) from e
+    except Exception as e:  # noqa: BLE001 — zlib error mid-member
+        raise CheckpointCorruptError(
+            f"corrupt member {key!r} in checkpoint container {fname!r}:"
+            f" {_short(e)}", dirname=dirname, file=fname, key=key,
+            cause=_short(e)) from e
+    if want_crc is not None:
+        got = zlib.crc32(piece.tobytes()) & 0xFFFFFFFF
+        if got != want_crc:
+            raise CheckpointCorruptError(
+                f"CRC mismatch for {key!r} in {fname!r} ({dirname!r}): "
+                f"stored {want_crc:#010x}, computed {got:#010x} — the "
+                f"shard was corrupted after save", dirname=dirname,
+                file=fname, key=key, crc_stored=want_crc, crc_got=got)
+    return piece
 
 
 def _is_parameter(var: Variable) -> bool:
@@ -83,6 +170,8 @@ def save_vars(executor: Executor, dirname: str,
         "file": fname,
         "vars": names,
         "dtypes": {n: str(arrays[n].dtype) for n in names},
+        "crc32": {n: zlib.crc32(arrays[n].tobytes()) & 0xFFFFFFFF
+                  for n in names},
     }
     with open(os.path.join(dirname, MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)
@@ -107,18 +196,19 @@ def load_vars(executor: Executor, dirname: str,
     program = main_program or default_main_program()
     if vars is None:
         vars = _collect(program, predicate or (lambda v: v.persistable))
-    with open(os.path.join(dirname, MANIFEST)) as f:
-        manifest = json.load(f)
-    if manifest.get("version", 0) > PROGRAM_FORMAT_VERSION:
-        raise RuntimeError("checkpoint written by a newer format version")
-    data = np.load(os.path.join(dirname, filename or manifest["file"]))
+    manifest = _read_manifest(dirname, MANIFEST)
+    fname = filename or manifest["file"]
+    data = _open_container(dirname, fname, {})
     scope = global_scope()
     import jax.numpy as jnp
 
     for v in vars:
         if v.name not in data:
-            raise RuntimeError(f"checkpoint missing variable {v.name!r}")
-        arr = data[v.name]
+            raise CheckpointIncompleteError(
+                f"checkpoint in {dirname!r} is missing variable "
+                f"{v.name!r}", dirname=dirname, var=v.name)
+        arr = _read_member(data, dirname, fname, v.name,
+                           manifest.get("crc32", {}).get(v.name))
         want = manifest.get("dtypes", {}).get(v.name)
         if want is not None:
             arr = _reinterpret(arr, want)
@@ -216,12 +306,40 @@ def save_sharded(executor: Executor, dirname: str,
             "shards": shards_meta,
         }
     np.savez(os.path.join(dirname, f"shards_p{proc}.npz"), **local_arrays)
+    # per-shard CRC32 sidecar: each process records checksums for the
+    # shards it wrote; proc 0 folds every sidecar into the manifest
+    # after the barrier (it cannot checksum bytes it never held)
+    crcs = {k: zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+            for k, a in local_arrays.items()}
+    with open(os.path.join(dirname, f"shards_p{proc}.crc.json"),
+              "w") as f:
+        json.dump(crcs, f)
     _barrier("save_sharded:shards")
+    # fault-injection point (resilience/chaos.py): the torn-checkpoint
+    # tests simulate preemption exactly here — shards on disk, no
+    # manifest yet
+    from .resilience.chaos import failpoint
+
+    failpoint("ckpt:before_manifest")
     # the manifest is written LAST and only once all processes' shard
     # files exist — its presence marks the checkpoint complete, so a
     # process preempted mid-save can never leave a torn-but-loadable
     # checkpoint behind
     if proc == 0:
+        all_crcs: dict = {}
+        for sfile in {sh["file"] for m in meta.values()
+                      for sh in m["shards"]}:
+            cpath = os.path.join(
+                dirname, sfile.replace(".npz", ".crc.json"))
+            try:
+                with open(cpath) as f:
+                    all_crcs.update(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                pass  # CRC is best-effort at save; load tolerates gaps
+        for m in meta.values():
+            for sh in m["shards"]:
+                if sh["key"] in all_crcs:
+                    sh["crc32"] = all_crcs[sh["key"]]
         tmp = os.path.join(dirname, SHARD_MANIFEST + ".tmp")
         with open(tmp, "w") as f:
             json.dump({"version": PROGRAM_FORMAT_VERSION, "vars": meta},
@@ -257,9 +375,10 @@ def _assemble_index(meta, files, dirname, index):
         inter_b = [min(b, sb) for b, (_, sb) in zip(stops, s_idx)]
         if any(a >= b for a, b in zip(inter_a, inter_b)):
             continue
-        if sh["file"] not in files:
-            files[sh["file"]] = np.load(os.path.join(dirname, sh["file"]))
-        piece = _reinterpret(files[sh["file"]][sh["key"]], meta["dtype"])
+        container = _open_container(dirname, sh["file"], files)
+        raw = _read_member(container, dirname, sh["file"], sh["key"],
+                           sh.get("crc32"))
+        piece = _reinterpret(raw, meta["dtype"])
         src = tuple(slice(a - sa, b - sa) for a, b, (sa, _) in
                     zip(inter_a, inter_b, s_idx))
         dst = tuple(slice(a - oa, b - oa) for a, b, oa in
@@ -267,10 +386,11 @@ def _assemble_index(meta, files, dirname, index):
         buf[dst] = piece[src]
         filled += int(np.prod([b - a for a, b in zip(inter_a, inter_b)]))
     if filled < int(np.prod(buf.shape)):
-        raise RuntimeError(
+        raise CheckpointIncompleteError(
             "sharded checkpoint does not cover the requested slice "
-            f"(covered {filled} of {int(np.prod(buf.shape))} elements) — "
-            "missing shard files?")
+            f"(covered {filled} of {int(np.prod(buf.shape))} elements) "
+            "— missing shard files?", dirname=dirname,
+            covered=filled, needed=int(np.prod(buf.shape)))
     return buf
 
 
@@ -291,10 +411,7 @@ def load_sharded(executor: Executor, dirname: str,
     program = main_program or default_main_program()
     if vars is None:
         vars = _collect(program, lambda v: v.persistable)
-    with open(os.path.join(dirname, SHARD_MANIFEST)) as f:
-        manifest = json.load(f)
-    if manifest.get("version", 0) > PROGRAM_FORMAT_VERSION:
-        raise RuntimeError("checkpoint written by a newer format version")
+    manifest = _read_manifest(dirname, SHARD_MANIFEST)
     metas = manifest["vars"]
 
     if mesh is not None and sharding_rules is None:
@@ -306,7 +423,9 @@ def load_sharded(executor: Executor, dirname: str,
     files: dict = {}
     for v in vars:
         if v.name not in metas:
-            raise RuntimeError(f"checkpoint missing variable {v.name!r}")
+            raise CheckpointIncompleteError(
+                f"sharded checkpoint in {dirname!r} is missing variable "
+                f"{v.name!r}", dirname=dirname, var=v.name)
         meta = metas[v.name]
         if tuple(meta["shape"]) != tuple(v.shape) and -1 not in v.shape:
             raise RuntimeError(

@@ -30,8 +30,8 @@ Three pillars (docs/OBSERVE.md):
 from . import cost  # noqa: F401
 from .cost import (bucket_summary, device_peaks,  # noqa: F401
                    format_cost_table, op_cost_table, program_costs)
-from .events import (SERVING_EVENTS, RunEventLog, git_sha,  # noqa: F401
-                     new_run_id, read_events)
+from .events import (RESILIENCE_EVENTS, SERVING_EVENTS,  # noqa: F401
+                     RunEventLog, git_sha, new_run_id, read_events)
 from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
                       enable_telemetry, fetch_telemetry, init_telemetry,
                       telemetry_enabled)

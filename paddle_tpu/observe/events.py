@@ -26,6 +26,18 @@ SERVING_EVENTS = (
     "serving_window",               # periodic stats snapshot
     "serving_compile_post_warmup",  # LOUD: a shape leaked past buckets
     "serving_drain",                # final snapshot at drain
+    "serving_breaker_open",         # LOUD: executor failure burst —
+    #                                 admission flipped to DEGRADED
+    "serving_breaker_close",        # half-open probe succeeded; RUNNING
+)
+
+# resilience event kinds (docs/RESILIENCE.md): checkpoint fallback and
+# guard lifecycle, emitted by contrib.Trainer / the chaos CI smoke
+RESILIENCE_EVENTS = (
+    "ckpt_fallback",        # a serial was skipped (torn/corrupt), with
+    #                         the structured CheckpointError as_dict()
+    "ckpt_resume",          # resumed; fallback=True when not newest
+    "ckpt_resume_failed",   # NO valid serial existed — fresh start
 )
 
 

@@ -30,7 +30,12 @@ TELEMETRY_VAR = "__telemetry__"
 
 _F32_FIELDS = ("loss_sum", "loss_last", "grad_norm_sum", "grad_norm_last",
                "update_norm_sum", "update_norm_last")
-_I32_FIELDS = ("steps", "nonfinite_grad_steps", "nonfinite_loss_steps")
+_I32_FIELDS = ("steps", "nonfinite_grad_steps", "nonfinite_loss_steps",
+               "skipped_update_steps")
+# update-guard state (resilience/guard.py) rides the same accumulator
+# but is NOT a window counter: a telemetry reset must preserve it, or
+# the loss-scale schedule would restart every fetch
+_PERSISTENT_FIELDS = ("loss_scale", "ls_good_steps", "ls_bad_steps")
 
 
 def enable_telemetry(program) -> None:
@@ -46,11 +51,15 @@ def telemetry_enabled(program) -> bool:
     return bool(getattr(program, "_telemetry_enabled", False))
 
 
-def init_telemetry() -> Dict[str, Any]:
+def init_telemetry(loss_scale: float = 1.0) -> Dict[str, Any]:
     """Fresh zeroed accumulator (host values; become device arrays on
-    first dispatch)."""
+    first dispatch).  `loss_scale` seeds the dynamic loss-scale scalar
+    (resilience update guard); 1.0 = inert."""
     out: Dict[str, Any] = {f: np.float32(0.0) for f in _F32_FIELDS}
     out.update({f: np.int32(0) for f in _I32_FIELDS})
+    out["loss_scale"] = np.float32(loss_scale)
+    out["ls_good_steps"] = np.int32(0)
+    out["ls_bad_steps"] = np.int32(0)
     return out
 
 
@@ -85,7 +94,8 @@ def device_update(tel: Dict[str, Any], loss, grads: Dict[str, Any],
     unorm = jnp.sqrt(usq)
     lf = jnp.asarray(loss).astype(jnp.float32)
     loss_bad = (~jnp.isfinite(lf)).astype(jnp.int32)
-    return {
+    out = dict(tel)  # guard/loss-scale fields pass through untouched
+    out.update({
         "steps": tel["steps"] + 1,
         "loss_sum": tel["loss_sum"] + lf,
         "loss_last": lf,
@@ -96,7 +106,8 @@ def device_update(tel: Dict[str, Any], loss, grads: Dict[str, Any],
         "nonfinite_grad_steps": tel["nonfinite_grad_steps"]
         + (nonfinite > 0).astype(jnp.int32),
         "nonfinite_loss_steps": tel["nonfinite_loss_steps"] + loss_bad,
-    }
+    })
+    return out
 
 
 @dataclass
@@ -112,6 +123,9 @@ class StepTelemetry:
     update_norm_mean: float
     nonfinite_grad_steps: int
     nonfinite_loss_steps: int
+    # resilience update guard (0 / 1.0 when the guard is not enabled)
+    skipped_update_steps: int = 0
+    loss_scale: float = 1.0
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -124,6 +138,8 @@ class StepTelemetry:
             "update_norm_mean": self.update_norm_mean,
             "nonfinite_grad_steps": self.nonfinite_grad_steps,
             "nonfinite_loss_steps": self.nonfinite_loss_steps,
+            "skipped_update_steps": self.skipped_update_steps,
+            "loss_scale": self.loss_scale,
         }
 
     @property
@@ -142,7 +158,11 @@ def fetch_telemetry(scope, reset: bool = True) -> Optional[StepTelemetry]:
         return None
     host = {k: np.asarray(v).item() for k, v in raw.items()}
     if reset:
-        scope.set_var(TELEMETRY_VAR, init_telemetry())
+        fresh = init_telemetry()
+        for f in _PERSISTENT_FIELDS:  # loss-scale schedule survives
+            if f in raw:
+                fresh[f] = raw[f]
+        scope.set_var(TELEMETRY_VAR, fresh)
     n = max(int(host["steps"]), 1)
     return StepTelemetry(
         steps=int(host["steps"]),
@@ -154,4 +174,6 @@ def fetch_telemetry(scope, reset: bool = True) -> Optional[StepTelemetry]:
         update_norm_mean=host["update_norm_sum"] / n,
         nonfinite_grad_steps=int(host["nonfinite_grad_steps"]),
         nonfinite_loss_steps=int(host["nonfinite_loss_steps"]),
+        skipped_update_steps=int(host.get("skipped_update_steps", 0)),
+        loss_scale=float(host.get("loss_scale", 1.0)),
     )

@@ -1,0 +1,34 @@
+"""paddle_tpu.resilience — fault tolerance for training and serving.
+
+The production-scale counterpart to observe/ (which only *sees*
+failures): this subsystem survives them (docs/RESILIENCE.md):
+
+- `guard`: in-step non-finite update guard + dynamic loss scaling —
+  a NaN step is skipped ON DEVICE inside the one jitted step
+  (`enable_update_guard`, or `amp.decorate(...,
+  use_dynamic_loss_scaling=True)`),
+- checkpoint integrity (io.py): per-shard CRC32 verified on load, a
+  structured `CheckpointError` hierarchy (`errors`), and
+  contrib.Trainer falling back to the newest *valid* serial,
+- `watchdog`: `Deadline` (SIGALRM guard for hung compiles/dispatches),
+  `probe_backend` (subprocess init probe), `retry_call` (bounded
+  exponential backoff) — shared by bench.py, Trainer, ServingEngine,
+- the serving circuit breaker lives with its state machine in
+  `paddle_tpu.serving.admission` (DEGRADED state, `CircuitBreaker`),
+- `chaos`: deterministic fault injectors (failpoints, NaN batches,
+  shard corruption, torn checkpoints, executor failure bursts) that
+  the tests and the CI chaos smoke use to prove all of the above.
+"""
+
+from . import chaos  # noqa: F401
+from .chaos import (ChaosKilled, FlakyPredictor,  # noqa: F401
+                    corrupt_file, corrupt_shard, nan_reader,
+                    poison_feed, tear_checkpoint)
+from .errors import (CheckpointCorruptError,  # noqa: F401
+                     CheckpointError, CheckpointFormatError,
+                     CheckpointIncompleteError, CheckpointNotFoundError,
+                     ResilienceError, RetriesExhaustedError,
+                     WatchdogTimeout)
+from .guard import (LossScaleConfig, UpdateGuardConfig,  # noqa: F401
+                    enable_update_guard, guard_config)
+from .watchdog import Deadline, probe_backend, retry_call  # noqa: F401

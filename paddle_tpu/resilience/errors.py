@@ -1,0 +1,89 @@
+"""Structured error hierarchy for the resilience subsystem.
+
+Every failure the subsystem handles — a corrupt checkpoint shard, a
+torn save, a hung compile, exhausted retries — surfaces as a typed
+exception carrying a machine-readable `details` dict (`as_dict()`),
+mirroring the serving-side `ServingError` contract: a recovery layer
+(Trainer fallback, CI chaos smoke, an alerting dashboard) dispatches
+on `kind`, never by parsing message strings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class ResilienceError(RuntimeError):
+    """Base for structured resilience failures."""
+
+    kind = "resilience_error"
+
+    def __init__(self, message: str, **details: Any):
+        super().__init__(message)
+        self.details = details
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = {"error": self.kind, "message": str(self)}
+        out.update(self.details)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity (io.py save_sharded/load_sharded, contrib.Trainer)
+# ---------------------------------------------------------------------------
+
+class CheckpointError(ResilienceError):
+    """Base for checkpoint load/save failures.  `details` always carries
+    the checkpoint `dirname`; Trainer attaches the `serial` it was
+    attempting so a `ckpt_fallback` event names what it skipped."""
+
+    kind = "checkpoint_error"
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """No manifest at the expected path: the directory is not a
+    (complete) checkpoint.  A save that died between shard write and
+    manifest write lands here — the manifest is written LAST, so a torn
+    checkpoint is indistinguishable from no checkpoint (by design)."""
+
+    kind = "checkpoint_not_found"
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The checkpoint exists but its content fails verification: a
+    shard CRC32 mismatch, an unreadable/truncated shard container, a
+    manifest or trainer-state file that is not valid JSON."""
+
+    kind = "checkpoint_corrupt"
+
+
+class CheckpointIncompleteError(CheckpointError):
+    """The manifest references shard files/keys that are missing, or
+    the present shards do not cover a requested slice."""
+
+    kind = "checkpoint_incomplete"
+
+
+class CheckpointFormatError(CheckpointError):
+    """The checkpoint was written by an incompatible (newer) program
+    format version."""
+
+    kind = "checkpoint_format"
+
+
+# ---------------------------------------------------------------------------
+# Watchdog / retry (resilience/watchdog.py)
+# ---------------------------------------------------------------------------
+
+class WatchdogTimeout(ResilienceError):
+    """A deadline-guarded region (compile, dispatch, warmup) exceeded
+    its wall-clock budget."""
+
+    kind = "watchdog_timeout"
+
+
+class RetriesExhaustedError(ResilienceError):
+    """A retried operation failed on every attempt; `details` carries
+    the attempt count and the final error."""
+
+    kind = "retries_exhausted"

@@ -1,0 +1,183 @@
+"""In-step update guard: skip non-finite optimizer updates on device.
+
+A single NaN/Inf step corrupts training silently — telemetry *counts*
+nonfinite grads (observe/metrics.py) but the optimizer applies them
+anyway, and every parameter is NaN one step later.  The guard closes
+that hole INSIDE the one jitted step (CLAUDE.md invariant: no host
+round-trips, no extra dispatches, no callbacks):
+
+1. after gradients are computed, an all-finite reduction runs over the
+   loss and every gradient leaf (SparseGrad rows included),
+2. the optimizer/update ops execute unconditionally (tracing is
+   unconditional under jit anyway), then every value they wrote is
+   `jnp.where(all_finite, new, old)`-selected against its pre-update
+   snapshot — a poisoned step is a full state no-op,
+3. the telemetry accumulator (`__telemetry__`, which the guard rides)
+   gains `skipped_update_steps` plus the dynamic loss-scale state.
+
+Dynamic loss scaling (`amp.decorate(..., use_dynamic_loss_scaling=
+True)`, the fp16/bf16 underflow story): the loss is multiplied by a
+device-resident scale before autodiff, gradients are unscaled before
+the finite check and the update ops, and the scale adapts — halved
+(decr_ratio) after `decr_every_n_nan_or_inf` consecutive overflow
+steps, multiplied by incr_ratio after `incr_every_n_steps` consecutive
+good steps (reference: fluid's update_loss_scaling op semantics).
+
+The executor hooks (`core/executor.py interpret_program`) call the
+helpers below; everything here is pure jnp over values already live in
+the trace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class LossScaleConfig:
+    """Dynamic loss-scale schedule (reference: fluid
+    update_loss_scaling_op attrs)."""
+
+    init_loss_scaling: float = 2.0 ** 15
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 1
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    min_loss_scaling: float = 1.0
+    max_loss_scaling: float = 2.0 ** 24
+
+    def __post_init__(self):
+        if self.init_loss_scaling <= 0:
+            raise ValueError("init_loss_scaling must be > 0")
+        if self.incr_every_n_steps < 1 or self.decr_every_n_nan_or_inf < 1:
+            raise ValueError("loss-scale step intervals must be >= 1")
+        if not (self.incr_ratio > 1.0 and 0.0 < self.decr_ratio < 1.0):
+            raise ValueError("need incr_ratio > 1 and 0 < decr_ratio < 1")
+
+
+class UpdateGuardConfig:
+    """Program-level guard switch; `loss_scaling=None` guards updates
+    at scale 1.0 (finite-check only)."""
+
+    def __init__(self, loss_scaling: Optional[LossScaleConfig] = None):
+        self.loss_scaling = loss_scaling
+
+    @property
+    def init_loss_scale(self) -> float:
+        return (self.loss_scaling.init_loss_scaling
+                if self.loss_scaling else 1.0)
+
+
+def enable_update_guard(program,
+                        loss_scaling: Optional[LossScaleConfig] = None
+                        ) -> UpdateGuardConfig:
+    """Opt a Program's compiled step into the non-finite update guard.
+
+    Implies device-side telemetry (the skip counter and loss-scale
+    scalar live in the `__telemetry__` executor state).  Bumps the
+    program version so an already-cached unguarded step fn is not
+    reused."""
+    from ..observe import metrics as _metrics
+
+    cfg = UpdateGuardConfig(loss_scaling)
+    program._update_guard = cfg
+    _metrics.enable_telemetry(program)
+    program._bump()
+    return cfg
+
+
+def guard_config(program) -> Optional[UpdateGuardConfig]:
+    return getattr(program, "_update_guard", None)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time helpers (called from core/executor.py inside the jit)
+# ---------------------------------------------------------------------------
+
+def all_finite(loss, grads: Dict[str, Any]):
+    """Scalar bool: loss and every gradient leaf finite.  SparseGrad
+    contributes its rows (ids are ints, always finite)."""
+    import jax.numpy as jnp
+
+    from ..core.selected_rows import SparseGrad
+
+    ok = jnp.all(jnp.isfinite(jnp.asarray(loss).astype(jnp.float32)))
+    for g in grads.values():
+        parts = (g.rows,) if isinstance(g, SparseGrad) else (g,)
+        for a in parts:
+            ok = ok & jnp.all(jnp.isfinite(a.astype(jnp.float32)))
+    return ok
+
+
+def scale_grads(grads: Dict[str, Any], factor) -> Dict[str, Any]:
+    """grads * factor, preserving SparseGrad structure and leaf dtypes
+    (master grads are f32; the multiply must not upcast bf16 leaves)."""
+    from ..core.selected_rows import SparseGrad
+
+    def one(g):
+        if isinstance(g, SparseGrad):
+            return SparseGrad(g.ids, (g.rows * factor).astype(g.rows.dtype),
+                              g.dense_shape)
+        return (g * factor).astype(g.dtype)
+
+    return {k: one(g) for k, g in grads.items()}
+
+
+def snapshot_env(env: Dict[str, Any], names) -> Dict[str, Any]:
+    """Pre-update values of every arrayish env entry in `names` — what
+    a skipped step rolls back to."""
+    import numpy as np
+
+    return {n: env[n] for n in names
+            if n in env and (hasattr(env[n], "dtype")
+                             or isinstance(env[n], np.ndarray))}
+
+
+def select_updates(finite, env: Dict[str, Any],
+                   pre: Dict[str, Any]) -> None:
+    """env[n] = where(finite, updated, pre-update) for every
+    snapshotted name the update ops rewrote — pure selects, so the step
+    stays ONE fused XLA computation (no lax.cond branch dispatch, no
+    host sync)."""
+    import jax.numpy as jnp
+
+    for n, old in pre.items():
+        new = env.get(n)
+        if new is None or new is old:
+            continue
+        env[n] = jnp.where(finite, new, old).astype(
+            getattr(new, "dtype", None) or jnp.asarray(new).dtype)
+
+
+def guard_telemetry_update(tel: Dict[str, Any], finite,
+                           cfg: UpdateGuardConfig) -> Dict[str, Any]:
+    """Accumulate the skip counter and advance the loss-scale schedule
+    (device-side, inside the trace)."""
+    import jax.numpy as jnp
+
+    out = dict(tel)
+    skipped = (~finite).astype(jnp.int32)
+    out["skipped_update_steps"] = tel["skipped_update_steps"] + skipped
+    ls = cfg.loss_scaling
+    if ls is None:
+        return out
+    scale = jnp.asarray(tel["loss_scale"], jnp.float32)
+    good = jnp.asarray(tel["ls_good_steps"], jnp.int32)
+    bad = jnp.asarray(tel["ls_bad_steps"], jnp.int32)
+    good = jnp.where(finite, good + 1, 0).astype(jnp.int32)
+    bad = jnp.where(finite, 0, bad + 1).astype(jnp.int32)
+    decr = bad >= ls.decr_every_n_nan_or_inf
+    scale = jnp.where(
+        decr, jnp.maximum(scale * ls.decr_ratio, ls.min_loss_scaling),
+        scale)
+    bad = jnp.where(decr, 0, bad).astype(jnp.int32)
+    incr = good >= ls.incr_every_n_steps
+    scale = jnp.where(
+        incr, jnp.minimum(scale * ls.incr_ratio, ls.max_loss_scaling),
+        scale)
+    good = jnp.where(incr, 0, good).astype(jnp.int32)
+    out["loss_scale"] = scale
+    out["ls_good_steps"] = good
+    out["ls_bad_steps"] = bad
+    return out

@@ -1,0 +1,134 @@
+"""Watchdog + retry: deadline-guarded compile/dispatch and bounded
+exponential-backoff retries.
+
+Generalizes bench.py's two hard-won lessons into reusable machinery:
+
+- backend init can HANG, not just error (r03: driver rc=124 with no
+  JSON line) — so `probe_backend` runs the init + one tiny matmul in a
+  SUBPROCESS with a hard timeout; an in-process try/except never fires
+  on a hang,
+- a hung XLA compile/dispatch must become a recorded error, not eat
+  the caller's whole budget — `Deadline` is the SIGALRM watchdog
+  bench.py wrapped each model in, now shared by bench, contrib.Trainer
+  (`step_deadline_s`) and `ServingEngine.start()` (warmup deadline).
+
+SIGALRM only exists on the main thread: off the main thread `Deadline`
+degrades to a no-op (recorded on the instance) rather than failing —
+a watchdog must never be the thing that crashes the worker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+from .errors import RetriesExhaustedError, WatchdogTimeout
+
+
+class Deadline:
+    """Wall-clock watchdog around a region: raises `WatchdogTimeout`
+    (with the region name in `details`) when the body exceeds
+    `seconds`.  Best-effort — a C call that never re-enters the
+    interpreter cannot be interrupted; `seconds <= 0` disables."""
+
+    def __init__(self, seconds: float, what: str = "guarded region"):
+        self.seconds = float(seconds)
+        self.what = what
+        self.armed = False
+        self._old = None
+
+    def __enter__(self):
+        import signal
+
+        if self.seconds <= 0:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self  # SIGALRM is main-thread-only; degrade to no-op
+
+        def _fire(signum, frame):
+            raise WatchdogTimeout(
+                f"{self.what} exceeded {self.seconds:.0f}s deadline",
+                what=self.what, deadline_s=self.seconds)
+
+        self._old = signal.signal(signal.SIGALRM, _fire)
+        # SIGALRM takes whole seconds; round up so Deadline(0.5) fires
+        signal.alarm(max(1, int(-(-self.seconds // 1))))
+        self.armed = True
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        if self.armed:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, self._old)
+            self.armed = False
+        return False
+
+
+def probe_backend(timeout_s: float,
+                  platform_env: str = "BENCH_PLATFORM") -> Optional[str]:
+    """Fail-fast backend health check: init the backend and run one
+    tiny matmul in a SUBPROCESS with a hard timeout.  Returns None when
+    healthy, else a short failure description (hang vs error is
+    distinguished).  `platform_env` names the env var whose value, if
+    set, pins jax_platforms inside the probe (the sitecustomize stomps
+    JAX_PLATFORMS, so only the config route works)."""
+    import os
+    import subprocess
+    import sys
+
+    code = ("import os, jax;"
+            f"plat = os.environ.get({platform_env!r});"
+            "plat and jax.config.update('jax_platforms', plat);"
+            "import jax.numpy as jnp;"
+            "d = jax.devices();"
+            "x = jnp.ones((128, 128), jnp.bfloat16);"
+            "(x @ x).block_until_ready();"
+            "print('BACKEND_OK', d[0].device_kind)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=dict(os.environ))
+    except subprocess.TimeoutExpired:
+        return (f"backend init did not complete within {timeout_s:.0f}s "
+                f"(hang, not error)")
+    if r.returncode != 0 or "BACKEND_OK" not in r.stdout:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+        return "backend init failed: " + " | ".join(tail)
+    return None
+
+
+def retry_call(fn: Callable, *, retries: int = 3,
+               base_delay_s: float = 0.5, max_delay_s: float = 30.0,
+               retry_on: Tuple[Type[BaseException], ...]
+               = (Exception,),
+               on_retry: Optional[Callable[[int, BaseException, float],
+                                           None]] = None,
+               sleep: Callable[[float], None] = time.sleep):
+    """Call `fn()` with up to `retries` re-attempts on transient
+    failure, sleeping base_delay_s * 2**attempt (capped) between
+    attempts — deterministic backoff so tests can assert the schedule
+    via an injected `sleep`.  `on_retry(attempt, exc, delay_s)` is the
+    observation hook.  Raises `RetriesExhaustedError` (chaining the
+    final error) when every attempt fails; non-retryable exceptions
+    propagate immediately."""
+    if retries < 0:
+        raise ValueError("retries must be >= 0")
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except retry_on as exc:  # noqa: PERF203 — retry loop
+            last = exc
+            if attempt == retries:
+                break
+            delay = min(base_delay_s * (2.0 ** attempt), max_delay_s)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+    raise RetriesExhaustedError(
+        f"{retries + 1} attempt(s) failed; last error: {last}",
+        attempts=retries + 1, last_error=f"{type(last).__name__}: {last}"
+    ) from last

@@ -23,8 +23,10 @@ Quick start (or `paddle_tpu.contrib.serve(...)`):
 """
 
 from .admission import (AdmissionController,  # noqa: F401
-                        DeadlineExceededError, QueueFullError,
-                        ServingClosedError, ServingError)
+                        CircuitBreaker, CircuitOpenError,
+                        DeadlineExceededError, ExecutorFailureError,
+                        QueueFullError, ServingClosedError,
+                        ServingError)
 from .batcher import DynamicBatcher, Request  # noqa: F401
 from .engine import (BucketConfig, BucketMissError,  # noqa: F401
                      ServingEngine)

@@ -13,9 +13,18 @@ owns all three:
 - **per-request deadlines** — `deadline_for()` stamps an absolute
   monotonic deadline on each request; the batcher drops expired
   requests *before* dispatch (`DeadlineExceededError`), never after,
-- **health/drain state machine** — CREATED → RUNNING → DRAINING →
-  STOPPED.  Draining stops admission immediately but lets queued work
-  finish, so a rolling restart never drops accepted requests.
+- **health/drain state machine** — CREATED → RUNNING ⇄ DEGRADED →
+  DRAINING → STOPPED.  Draining stops admission immediately but lets
+  queued work finish, so a rolling restart never drops accepted
+  requests,
+- **circuit breaker** — `failure_threshold` CONSECUTIVE executor
+  failures flip RUNNING → DEGRADED: submits fast-reject with
+  `CircuitOpenError` (no queueing, no device contact) until the
+  cooldown elapses, then exactly ONE half-open probe request is
+  admitted; its success closes the breaker (back to RUNNING), its
+  failure re-opens it for another cooldown.  A dead executor thus
+  costs each caller microseconds, not a queue-full timeout, and
+  recovery is automatic.
 
 All serving errors derive from `ServingError` and carry a structured
 `details` dict (`as_dict()`), so a frontend can serialize rejections
@@ -26,11 +35,12 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 # -- state machine values (strings, so health() dicts are json-ready) ---
 CREATED = "created"
 RUNNING = "running"
+DEGRADED = "degraded"   # breaker open: shedding, probing for recovery
 DRAINING = "draining"
 STOPPED = "stopped"
 
@@ -74,6 +84,110 @@ class ServingClosedError(ServingError):
     kind = "serving_closed"
 
 
+class CircuitOpenError(ServingError):
+    """Fast-reject: the engine is DEGRADED (breaker open after
+    consecutive executor failures) and this request is not the
+    half-open probe."""
+
+    kind = "circuit_open"
+
+
+class ExecutorFailureError(ServingError):
+    """The batch dispatch (executor call) failed; every future in the
+    batch resolves with this structured wrapper around the raw error."""
+
+    kind = "executor_failure"
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (closed → open → half-open).
+
+    Deliberately mechanism-only: the AdmissionController maps breaker
+    state onto the serving state machine, the engine reports dispatch
+    outcomes.  `clock` is injectable so tests drive the cooldown
+    deterministically.  Thread-safe.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 5,
+                 cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        if cooldown_s <= 0:
+            raise ValueError("cooldown_s must be > 0")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self.opens = 0          # lifetime transition counters (stats)
+        self.closes = 0
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def record_failure(self) -> bool:
+        """One executor failure; True when this flips the breaker OPEN
+        (from closed at threshold, or a failed half-open probe)."""
+        with self._lock:
+            self._consecutive_failures += 1
+            should_open = (
+                self._state == self.HALF_OPEN
+                or (self._state == self.CLOSED
+                    and self._consecutive_failures
+                    >= self.failure_threshold))
+            if should_open:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self.opens += 1
+            return should_open
+
+    def record_success(self) -> bool:
+        """One executor success; True when this CLOSES an open/half-open
+        breaker (recovery)."""
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state in (self.OPEN, self.HALF_OPEN):
+                self._state = self.CLOSED
+                self._opened_at = None
+                self.closes += 1
+                return True
+            return False
+
+    def allow(self) -> bool:
+        """May a request proceed right now?  CLOSED: yes.  OPEN: only
+        once the cooldown elapsed — that request becomes THE half-open
+        probe (state moves to HALF_OPEN so concurrent submits keep
+        shedding until the probe resolves)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN and (
+                    self._clock() - self._opened_at >= self.cooldown_s):
+                self._state = self.HALF_OPEN
+                return True
+            return False
+
+    def cooldown_remaining_s(self) -> float:
+        with self._lock:
+            if self._opened_at is None:
+                return 0.0
+            return max(0.0, self.cooldown_s
+                       - (self._clock() - self._opened_at))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self._state,
+                    "consecutive_failures": self._consecutive_failures,
+                    "failure_threshold": self.failure_threshold,
+                    "opens": self.opens, "closes": self.closes}
+
+
 class AdmissionController:
     """Admission decisions + the health/drain state machine.
 
@@ -83,13 +197,15 @@ class AdmissionController:
     """
 
     def __init__(self, queue_capacity: int,
-                 default_deadline_ms: Optional[float] = None):
+                 default_deadline_ms: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         if queue_capacity < 1:
             raise ValueError("queue_capacity must be >= 1")
         if default_deadline_ms is not None and default_deadline_ms <= 0:
             raise ValueError("default_deadline_ms must be > 0")
         self.queue_capacity = int(queue_capacity)
         self.default_deadline_ms = default_deadline_ms
+        self.breaker = breaker
         self._state = CREATED
         self._lock = threading.Lock()
 
@@ -110,7 +226,7 @@ class AdmissionController:
         with self._lock:
             if self._state in (DRAINING, STOPPED):
                 return  # drain is idempotent
-            if self._state != RUNNING:
+            if self._state not in (RUNNING, DEGRADED):
                 raise ServingClosedError(
                     f"cannot drain from state {self._state!r}",
                     state=self._state)
@@ -120,12 +236,44 @@ class AdmissionController:
         with self._lock:
             self._state = STOPPED
 
+    # -- circuit breaker ------------------------------------------------
+    def record_dispatch_result(self, ok: bool) -> Optional[str]:
+        """Feed one executor outcome to the breaker and mirror its
+        state onto the serving state machine.  Returns "opened" /
+        "closed" on a transition (the engine emits the matching
+        serving_breaker_* event), else None."""
+        if self.breaker is None:
+            return None
+        if ok:
+            if self.breaker.record_success():
+                with self._lock:
+                    if self._state == DEGRADED:
+                        self._state = RUNNING
+                return "closed"
+            return None
+        if self.breaker.record_failure():
+            with self._lock:
+                if self._state == RUNNING:
+                    self._state = DEGRADED
+            return "opened"
+        return None
+
     # -- admission ------------------------------------------------------
     def check(self, inflight: int):
         """Admit one request given the current in-flight count, or
         raise the structured rejection.  Called under the batcher's
         lock, so the count cannot race past capacity."""
-        if self._state != RUNNING:
+        if self._state == DEGRADED:
+            # breaker open: shed in microseconds UNLESS this request is
+            # the half-open probe (capacity still applies to the probe)
+            if not self.breaker.allow():
+                raise CircuitOpenError(
+                    "engine degraded: executor failing; request shed "
+                    "(circuit open)", state=self._state,
+                    breaker=self.breaker.snapshot(),
+                    retry_after_s=round(
+                        self.breaker.cooldown_remaining_s(), 3))
+        elif self._state != RUNNING:
             raise ServingClosedError(
                 f"engine is {self._state}; not accepting requests",
                 state=self._state)
@@ -148,5 +296,7 @@ class AdmissionController:
 
     def health(self, **extra: Any) -> Dict[str, Any]:
         out = {"state": self._state, "capacity": self.queue_capacity}
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
         out.update(extra)
         return out

@@ -44,7 +44,8 @@ import numpy as np
 from ..inference import AnalysisConfig, Predictor
 from ..observe.events import RunEventLog
 from ..observe.monitoring import runtime_stats
-from .admission import AdmissionController, ServingError
+from .admission import (AdmissionController, CircuitBreaker,
+                        ExecutorFailureError, ServingError)
 from .batcher import DynamicBatcher, Request
 from .stats import ServingStats
 
@@ -128,6 +129,15 @@ class ServingEngine:
         memory).  Default: on for TPU backends, off for CPU.  Leave off
         if you run() the shared Predictor yourself with device-resident
         feeds you reuse.
+    breaker: serving circuit breaker (admission.CircuitBreaker) —
+        `breaker.failure_threshold` CONSECUTIVE dispatch failures flip
+        admission to DEGRADED (submits fast-reject with a structured
+        CircuitOpenError) until a half-open probe succeeds.  Default: a
+        CircuitBreaker(failure_threshold=5, cooldown_s=5).  Pass
+        breaker=False to disable.
+    warmup_deadline_s: wall-clock budget for the start() bucket-ladder
+        warmup (resilience.Deadline): a hung XLA compile raises a
+        structured WatchdogTimeout instead of stalling the rollout.
     """
 
     def __init__(self, model: Union[str, AnalysisConfig, Predictor],
@@ -138,8 +148,15 @@ class ServingEngine:
                  event_log: Optional[RunEventLog] = None,
                  log_path: Optional[str] = None,
                  stats_window: int = 256,
-                 donate_feeds: Optional[bool] = None):
+                 donate_feeds: Optional[bool] = None,
+                 breaker: Union[CircuitBreaker, bool, None] = None,
+                 warmup_deadline_s: Optional[float] = None):
+        # duck-typed: anything with run()/compile_signature() serves
+        # (a resilience.FlakyPredictor proxy in chaos tests, a custom
+        # wrapper in production)
         self.predictor = (model if isinstance(model, Predictor)
+                          or (hasattr(model, "run")
+                              and hasattr(model, "compile_signature"))
                           else Predictor(model))
         self.buckets = buckets or BucketConfig()
         feed_names = self.predictor.get_input_names()
@@ -190,8 +207,14 @@ class ServingEngine:
         self.stats = ServingStats(event_log=event_log,
                                   window=stats_window)
         self._event_log = event_log
+        if breaker is None:
+            breaker = CircuitBreaker(failure_threshold=5, cooldown_s=5.0)
+        elif breaker is False:
+            breaker = None
+        self.warmup_deadline_s = warmup_deadline_s
         self.admission = AdmissionController(
-            queue_capacity, default_deadline_ms=default_deadline_ms)
+            queue_capacity, default_deadline_ms=default_deadline_ms,
+            breaker=breaker)
         self.batcher = DynamicBatcher(
             self._dispatch, self.admission,
             max_batch_size=self.buckets.batch_sizes[-1],
@@ -223,9 +246,13 @@ class ServingEngine:
                 donate_feeds=self._donate)
         snap = runtime_stats.snapshot()
         t0 = time.perf_counter()
-        for spec in self._bucket_specs():
-            self.predictor.compile_signature(
-                spec, donate_feeds=self._donate)
+        from ..resilience.watchdog import Deadline
+
+        with Deadline(self.warmup_deadline_s or 0,
+                      what="serving warmup (bucket-ladder compile)"):
+            for spec in self._bucket_specs():
+                self.predictor.compile_signature(
+                    spec, donate_feeds=self._donate)
         seconds = time.perf_counter() - t0
         delta = runtime_stats.delta(snap)
         self.stats.record_warmup(self.buckets.n_buckets,
@@ -268,7 +295,16 @@ class ServingEngine:
             queue_depth=self.batcher.inflight,
             buckets=self.buckets.n_buckets,
             completed=self.stats.completed,
+            executor_failures=self.stats.executor_failures,
             post_warmup_compiles=self.stats.post_warmup_compiles())
+
+    def _breaker_event(self, kind: str, **fields):
+        """serving_breaker_open/close: state-transition events a pager
+        rule can key on."""
+        if self._event_log is not None:
+            self._event_log.event(
+                kind, state=self.admission.state,
+                breaker=self.admission.breaker.snapshot(), **fields)
 
     # -- request path ---------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
@@ -285,6 +321,8 @@ class ServingEngine:
         except ServingError as e:
             if e.kind == "queue_full":
                 self.stats.record_shed()
+            elif e.kind == "circuit_open":
+                self.stats.record_circuit_reject()
             raise
         self.stats.record_submit(self.batcher.queue_depth)
         return req.future
@@ -407,8 +445,23 @@ class ServingEngine:
                 elems_real += n * row
                 elems_padded += bucket_b * row
         t0 = time.perf_counter()
-        outs = self.predictor.run(feed)
+        try:
+            outs = self.predictor.run(feed)
+        except BaseException as e:
+            # one executor outcome per dispatch feeds the breaker; the
+            # batcher resolves every future in the batch with the
+            # structured wrapper raised here (never silently dropped)
+            self.stats.record_executor_failure()
+            if self.admission.record_dispatch_result(False) == "opened":
+                self._breaker_event("serving_breaker_open",
+                                    failed_batch_size=n)
+            raise ExecutorFailureError(
+                f"executor dispatch failed for batch of {n}: "
+                f"{type(e).__name__}: {e}",
+                error_type=type(e).__name__, batch_size=n) from e
         exec_ms = (time.perf_counter() - t0) * 1e3
+        if self.admission.record_dispatch_result(True) == "closed":
+            self._breaker_event("serving_breaker_close")
         self.stats.record_batch(n, bucket_b, elems_real, elems_padded,
                                 exec_ms)
         now = time.monotonic()

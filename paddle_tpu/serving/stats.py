@@ -54,6 +54,8 @@ class ServingStats:
         self.shed = 0
         self.deadline_misses = 0
         self.bucket_misses = 0
+        self.executor_failures = 0   # failed dispatches (batches)
+        self.circuit_rejects = 0     # fast-rejects while DEGRADED
         self.batches = 0
         self._slots = 0           # sum of bucket batch sizes dispatched
         self._real = 0            # sum of real requests dispatched
@@ -94,6 +96,14 @@ class ServingStats:
         with self._lock:
             self.bucket_misses += 1
 
+    def record_executor_failure(self):
+        with self._lock:
+            self.executor_failures += 1
+
+    def record_circuit_reject(self):
+        with self._lock:
+            self.circuit_rejects += 1
+
     def record_batch(self, n_real: int, bucket_batch: int,
                      elems_real: float, elems_padded: float,
                      exec_ms: float):
@@ -126,6 +136,8 @@ class ServingStats:
                 "shed": self.shed,
                 "deadline_misses": self.deadline_misses,
                 "bucket_misses": self.bucket_misses,
+                "executor_failures": self.executor_failures,
+                "circuit_rejects": self.circuit_rejects,
                 "batches": self.batches,
                 "max_queue_depth": self.max_queue_depth,
                 "batch_occupancy": round(self._real / self._slots, 4)

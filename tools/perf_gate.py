@@ -146,6 +146,13 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
         regressions.append(f"{name}: candidate errored: "
                            f"{cand['error']}")
         return
+    if cand.get("skipped_update_steps"):
+        # bench honesty: a throughput number that "improved" by
+        # skipping optimizer math is not a number at all
+        regressions.append(
+            f"{name}: {cand['skipped_update_steps']} optimizer "
+            f"update(s) SKIPPED inside the measured window (non-finite "
+            f"taint) — throughput/MFU not comparable")
     if "mfu" in base and "mfu" in cand:
         drop = (base["mfu"] - cand["mfu"]) / base["mfu"]
         line = (f"{name}.mfu: {base['mfu']:.4f} -> {cand['mfu']:.4f} "
@@ -264,6 +271,14 @@ def main() -> int:
     if candidate.get("probe_hazard", {}).get("probe_loop_pids"):
         print("perf_gate: candidate ran with probe_loop.sh attached "
               "(~5x hazard) — not gateable", file=sys.stderr)
+        return 2
+    if candidate.get("nonfinite_flag") or \
+            candidate.get("skipped_update_steps"):
+        print("perf_gate: candidate measured windows contained "
+              f"non-finite steps (nonfinite={candidate.get('nonfinite_steps')}, "
+              f"skipped_updates={candidate.get('skipped_update_steps')})"
+              " — numbers produced while training was diverging or "
+              "updates were skipped are not gateable", file=sys.stderr)
         return 2
 
     regressions, report, compared = gate(

@@ -35,6 +35,7 @@ MODULES = [
     "paddle_tpu.contrib",
     "paddle_tpu.imperative",
     "paddle_tpu.observe",
+    "paddle_tpu.resilience",
     "paddle_tpu.serving",
     "paddle_tpu.profiler",
 ]

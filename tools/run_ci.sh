@@ -91,6 +91,117 @@ print("serving smoke OK:",
                          "post_warmup_compiles")})
 EOF
 
+echo "== resilience chaos smoke (cpu) =="
+# the fault-tolerance contract end-to-end (docs/RESILIENCE.md): inject
+# NaN at step 3 -> the guard skips exactly that update; corrupt the
+# newest checkpoint shard -> a restarted Trainer resumes from the last
+# good serial with a ckpt_fallback event; an executor failure burst
+# flips the serving breaker to DEGRADED and a half-open probe recovers
+# it to RUNNING.  No unstructured crash anywhere.
+python - <<'EOF'
+import os, tempfile, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.contrib import CheckpointConfig, Trainer
+from paddle_tpu.resilience import FlakyPredictor, chaos, enable_update_guard
+from paddle_tpu.serving import (BucketConfig, CircuitBreaker,
+                                CircuitOpenError, ExecutorFailureError,
+                                ServingEngine)
+
+d = tempfile.mkdtemp()
+log = os.path.join(d, "events.jsonl")
+
+def train_func():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+def opt_func():
+    return fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+
+def reader():
+    r = np.random.RandomState(0)
+    for _ in range(6):
+        yield {"x": r.rand(8, 4).astype(np.float32),
+               "y": r.rand(8, 1).astype(np.float32)}
+
+# -- NaN at step 3: guard skips exactly that update --------------------
+t = Trainer(train_func, opt_func,
+            checkpoint_config=CheckpointConfig(os.path.join(d, "ck"),
+                                               step_interval=2),
+            telemetry=observe.TelemetryConfig(interval=100,
+                                              log_path=log))
+enable_update_guard(t.train_program)
+t.train(num_epochs=1, reader=chaos.nan_reader(reader, at_step=3))
+tel = t.last_telemetry  # the end-of-train window flush
+assert tel.steps == 6 and tel.skipped_update_steps == 1, tel.as_dict()
+params = {v.name: np.asarray(t.scope.find_var(v.name))
+          for v in t.train_program.list_vars() if v.persistable}
+assert all(np.isfinite(p).all() for p in params.values()), \
+    "NaN leaked into parameters past the guard"
+ids = t._list_checkpoints()
+assert ids, "no checkpoints saved"
+
+# -- corrupt newest shard: resume falls back to the prior serial -------
+chaos.corrupt_shard(os.path.join(d, "ck", f"ckpt_{ids[-1]}"))
+t2 = Trainer(train_func, opt_func,
+             checkpoint_config=CheckpointConfig(os.path.join(d, "ck"),
+                                                step_interval=2),
+             telemetry=observe.TelemetryConfig(interval=100,
+                                               log_path=log))
+events = observe.read_events(log)
+falls = [e for e in events if e["event"] == "ckpt_fallback"]
+resumes = [e for e in events if e["event"] == "ckpt_resume"]
+assert falls and falls[-1]["serial"] == ids[-1] \
+    and falls[-1]["error"]["error"] == "checkpoint_corrupt", falls[-1:]
+assert resumes and resumes[-1]["serial"] == ids[-2] \
+    and resumes[-1]["fallback"] is True, resumes[-1:]
+
+# -- serving breaker: failure burst -> DEGRADED -> probe -> RUNNING ----
+md = os.path.join(d, "model")
+main, startup = fluid.Program(), fluid.Program()
+scope = fluid.Scope()
+with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+    x = layers.data("x", shape=[8], append_batch_size=True)
+    pred = layers.fc(x, size=4)
+    exe = fluid.Executor(); exe.run(startup)
+    fluid.io.save_inference_model(md, ["x"], [pred], exe,
+                                  main_program=main)
+engine = ServingEngine(
+    FlakyPredictor(fluid.Predictor(md), fail_first=2),
+    {"x": np.zeros(8, np.float32)}, buckets=BucketConfig((1, 2)),
+    max_wait_ms=0, queue_capacity=8,
+    breaker=CircuitBreaker(failure_threshold=2, cooldown_s=0.2))
+engine.start()
+x0 = np.ones(8, np.float32)
+for _ in range(2):
+    try:
+        engine.infer({"x": x0}, timeout_s=60)
+        raise AssertionError("injected executor failure not raised")
+    except ExecutorFailureError as e:
+        assert e.as_dict()["error"] == "executor_failure"
+assert engine.health()["state"] == "degraded", engine.health()
+try:
+    engine.infer({"x": x0}, timeout_s=60)
+    raise AssertionError("expected circuit_open fast-reject")
+except CircuitOpenError as e:
+    assert e.as_dict()["error"] == "circuit_open"
+time.sleep(0.25)
+engine.infer({"x": x0}, timeout_s=60)   # half-open probe succeeds
+assert engine.health()["state"] == "running", engine.health()
+engine.close()
+print("chaos smoke OK:",
+      {"skipped_update_steps": tel.skipped_update_steps,
+       "ckpt_fallback_serial": falls[-1]["serial"],
+       "resumed_serial": resumes[-1]["serial"],
+       "breaker": engine.health()["breaker"]["state"]})
+EOF
+
 echo "== perf gate (schema + synthetic-regression smoke, cpu) =="
 # 1. the fresh bench line must satisfy the observability schema
 python tools/perf_gate.py --schema --candidate /tmp/bench_ci_line.json
