@@ -439,12 +439,24 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
 
 
 def bench_lstm(batch_size: int, steps: int, warmup: int,
-               max_len: int = 128):
+               max_len: int = 128, pallas_rnn: bool = False,
+               rnn_unroll: int = 1):
     """Stacked dynamic LSTM LM (BASELINE.json tracked config #4,
     reference benchmark/fluid/models/stacked_dynamic_lstm.py):
-    tokens/sec through the lax.scan recurrence.  The scan serializes
-    128 small matmuls per layer, so MFU against the MXU peak is
-    reported for context but throughput is the tracked axis."""
+    tokens/sec through the recurrence.  The scan path serializes 128
+    small matmuls per layer, so MFU against the MXU peak is reported
+    for context but throughput is the tracked axis (perf_gate compares
+    tokens_per_sec/examples_per_sec, numerator-free).
+
+    The two scan-bound levers (docs/RNN.md, A/B'd by run_ab lstm
+    variants): --rnn-unroll N unrolls the lax.scan body; --pallas-rnn
+    swaps the recurrence for the blocked fused Pallas kernel
+    (ops/pallas/recurrence.py), whose custom calls take their MFU
+    numerator from the kernel cost registry.  The scan path's MFU
+    numerator is XLA's aggregate, which counts while BODIES ONCE
+    (undercounts the recurrence by ~T) — tagged, kept for artifact
+    continuity with r05; the trip-corrected analytic number lives in
+    tools/roofline.py."""
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
@@ -453,22 +465,33 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main, startup), fluid.scope_guard(scope):
-        model = lstm.build_model(max_len=max_len, use_amp=False)
+        model = lstm.build_model(max_len=max_len, use_amp=False,
+                                 pallas_rnn=pallas_rnn,
+                                 rnn_unroll=rnn_unroll)
         _enable_observability(main)
         exe = fluid.Executor()
         exe.run(startup)
         feed = {k: jnp.asarray(v) for k, v in
                 lstm.make_fake_batch(batch_size, max_len).items()}
-        cost = exe.cost_analysis(main, feed=feed,
-                                 fetch_list=[model["loss"]])
+        if pallas_rnn:
+            step_flops, flop_src = _registry_flops(exe, main, feed,
+                                                   model["loss"])
+        else:
+            cost = exe.cost_analysis(main, feed=feed,
+                                     fetch_list=[model["loss"]])
+            step_flops = float(cost.get("flops", 0.0))
+            flop_src = "xla(loop-bodies-once)"
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
                                               warmup, scope=scope)
     return _mfu_result(
-        float(cost.get("flops", 0.0)), steps, elapsed,
+        step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
                                  1),
+         "examples_per_sec": round(batch_size * steps / elapsed, 1),
          "batch_size": batch_size, "max_len": max_len,
+         "pallas_rnn": pallas_rnn, "rnn_unroll": rnn_unroll,
+         "flop_count": flop_src,
          "last_loss": last_loss,
          **_tel_fields(tel)})
 
@@ -818,6 +841,16 @@ def main():
                    help="transformer: rematerialize encoder/decoder "
                         "layers (HBM for FLOPs; pair with a larger "
                         "--batch)")
+    p.add_argument("--pallas-rnn", action="store_true",
+                   help="lstm: route every dynamic_lstm recurrence "
+                        "through the blocked fused Pallas kernel "
+                        "(ops/pallas/recurrence.py; A/B candidate — "
+                        "default stays scan until a recorded "
+                        "throughput win in AB_r06.json)")
+    p.add_argument("--rnn-unroll", type=int, default=1,
+                   help="lstm: lax.scan unroll factor for the "
+                        "recurrence (A/B candidate, bit-identical "
+                        "numerics; default 1 until a recorded win)")
     p.add_argument("--pallas-attn", action="store_true",
                    help="transformer: route flash attention through "
                         "the tiled Pallas kernel instead of the XLA "
@@ -1040,7 +1073,8 @@ def main():
              args.warmup, use_amp=amp, use_flash=not args.no_flash)
     if args.model in ("all", "lstm"):
         _run("lstm", bench_lstm, args.batch or 128, args.steps,
-             args.warmup)
+             args.warmup, pallas_rnn=args.pallas_rnn,
+             rnn_unroll=args.rnn_unroll)
     if args.model in ("all", "deepfm"):
         _run("deepfm", bench_deepfm, args.batch or 4096, args.steps,
              args.warmup)

@@ -494,11 +494,14 @@ class StaticRNN:
 
     Differentiable end-to-end (lax.scan), so append_backward trains
     through it — the replay machinery of recurrent_op.cc:311 is subsumed
-    by jax AD.
+    by jax AD.  `unroll` unrolls the scan body by that factor (the
+    scan-bound perf lever, docs/RNN.md); results are bit-identical to
+    unroll=1.
     """
 
-    def __init__(self, name: Optional[str] = None):
+    def __init__(self, name: Optional[str] = None, unroll: int = 1):
         self.helper = LayerHelper("static_rnn", name=name)
+        self._unroll = int(unroll)
         self._program = default_main_program()
         self._sub = None
         self._step_inputs = []   # [outer_name, inner_name]
@@ -532,7 +535,8 @@ class StaticRNN:
                    "step_inputs": self._step_inputs,
                    "memories": self._memories,
                    "step_outputs": self._step_outputs,
-                   "final_states": []},
+                   "final_states": [],
+                   "unroll": self._unroll},
         )
 
     def step_input(self, x: Variable) -> Variable:
@@ -614,11 +618,13 @@ class DynamicRNN:
 
     Per-example masking replaces the reference's lod_rank_table
     sort-by-length + shrink_rnn_memory machinery; outputs carry the input's
-    `.seq_len` companion so sequence_* layers compose.
+    `.seq_len` companion so sequence_* layers compose.  `unroll` unrolls
+    the scan body (docs/RNN.md); results are bit-identical to unroll=1.
     """
 
-    def __init__(self, name: Optional[str] = None):
+    def __init__(self, name: Optional[str] = None, unroll: int = 1):
         self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._unroll = int(unroll)
         self._program = default_main_program()
         self._sub = None
         self._step_inputs = []
@@ -653,7 +659,8 @@ class DynamicRNN:
                    "memories": self._memories,
                    "step_outputs": self._step_outputs,
                    "final_states": [],
-                   "seq_len": self._seq_len_name},
+                   "seq_len": self._seq_len_name,
+                   "unroll": self._unroll},
         )
         # propagate the seq_len companion to padded outputs
         from .sequence import _propagate_seq_len

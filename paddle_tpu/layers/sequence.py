@@ -84,10 +84,16 @@ def seq_len2_var(x: Variable):
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
                  bias_attr=None, use_peepholes=True, is_reverse=False,
                  gate_activation="sigmoid", cell_activation="tanh",
-                 candidate_activation="tanh", dtype="float32", name=None):
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 use_pallas=False, unroll=1):
     """reference layers/nn.py dynamic_lstm — input must be (N, T, 4*hidden)
     (the x-projection fc is applied by the caller, as in fluid); size is
-    4*hidden."""
+    4*hidden.
+
+    Scan-bound perf levers (docs/RNN.md): `unroll` unrolls the lax.scan
+    recurrence by that factor; `use_pallas` routes it through the
+    blocked fused Pallas kernel (no peepholes / non-default
+    activations)."""
     helper = LayerHelper("lstm", name=name)
     hidden = size // 4
     w = helper.create_parameter(param_attr, shape=[hidden, 4 * hidden],
@@ -113,7 +119,8 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
         attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
                "gate_activation": gate_activation,
                "cell_activation": cell_activation,
-               "candidate_activation": candidate_activation})
+               "candidate_activation": candidate_activation,
+               "use_pallas": use_pallas, "unroll": unroll})
     _propagate_seq_len(input, hidden_out)
     _propagate_seq_len(input, cell_out)
     return hidden_out, cell_out
@@ -123,7 +130,8 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                   use_peepholes=True, is_reverse=False,
                   gate_activation="sigmoid", cell_activation="tanh",
                   candidate_activation="tanh", proj_activation="tanh",
-                  dtype="float32", name=None, h_0=None, c_0=None):
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  unroll=1):
     """LSTM with recurrent projection (reference layers/nn.py
     dynamic_lstmp:655) — input (N, T, 4*hidden) pre-projected by the
     caller's fc; size is 4*hidden, proj_size the projection width.
@@ -156,7 +164,7 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                "gate_activation": gate_activation,
                "cell_activation": cell_activation,
                "candidate_activation": candidate_activation,
-               "proj_activation": proj_activation})
+               "proj_activation": proj_activation, "unroll": unroll})
     _propagate_seq_len(input, proj_out)
     _propagate_seq_len(input, cell_out)
     return proj_out, cell_out
@@ -195,7 +203,7 @@ def lod_reset(x, y=None, target_lod=None):
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation="sigmoid",
                 candidate_activation="tanh", h_0=None, dtype="float32",
-                name=None):
+                name=None, unroll=1):
     """reference layers/nn.py dynamic_gru — input (N, T, 3*size)."""
     helper = LayerHelper("gru", name=name)
     w = helper.create_parameter(param_attr, shape=[size, 3 * size],
@@ -214,7 +222,7 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
         outputs={"Hidden": [hidden_out], "LastH": [last_h]},
         attrs={"is_reverse": is_reverse,
                "gate_activation": gate_activation,
-               "activation": candidate_activation})
+               "activation": candidate_activation, "unroll": unroll})
     _propagate_seq_len(input, hidden_out)
     return hidden_out
 

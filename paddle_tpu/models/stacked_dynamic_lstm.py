@@ -14,7 +14,11 @@ from .. import layers, optimizer
 def build_model(vocab_size=5147, emb_dim=512, hidden_dim=512,
                 stacked_num=3, class_num=2, max_len=128,
                 learning_rate=1e-3, with_optimizer=True,
-                use_amp=False):
+                use_amp=False, pallas_rnn=False, rnn_unroll=1):
+    """`pallas_rnn` routes every dynamic_lstm through the blocked fused
+    Pallas recurrence kernel; `rnn_unroll` unrolls the lax.scan path by
+    that factor — the two scan-bound levers (docs/RNN.md), A/B'd by
+    tools/run_ab.py lstm variants."""
     data = layers.data(name="words", shape=[max_len], dtype="int64",
                        lod_level=1, append_batch_size=True)
     label = layers.data(name="label", shape=[1], dtype="int64")
@@ -29,13 +33,17 @@ def build_model(vocab_size=5147, emb_dim=512, hidden_dim=512,
                          num_flatten_dims=2)
     _propagate_seq_len(data, sentence)
     lstm_out, _cell = layers.dynamic_lstm(sentence, size=hidden_dim * 4,
-                                          use_peepholes=False)
+                                          use_peepholes=False,
+                                          use_pallas=pallas_rnn,
+                                          unroll=rnn_unroll)
     inputs = lstm_out
     for _ in range(stacked_num - 1):
         fc_in = layers.fc(inputs, size=hidden_dim * 4, num_flatten_dims=2)
         _propagate_seq_len(inputs, fc_in)
         inputs, _c = layers.dynamic_lstm(fc_in, size=hidden_dim * 4,
-                                         use_peepholes=False)
+                                         use_peepholes=False,
+                                         use_pallas=pallas_rnn,
+                                         unroll=rnn_unroll)
 
     last = layers.sequence_pool(inputs, pool_type="max")
     logit = layers.fc(last, size=class_num, act="softmax")

@@ -22,6 +22,13 @@ HloModuleProto (read with trace.py's dependency-free wire scanner):
   for reduce/reduce-window, recursive descent into fusions and called
   computations.  Transcendentals (exp/log/tanh/...) are tallied
   separately, matching XLA's flops-vs-transcendentals split.
+  `while` bodies are multiplied by the loop's TRIP COUNT when it is
+  recoverable from the scan-emitted counted-loop pattern
+  (`while_trip_count`) — XLA's own cost analysis counts loop bodies
+  ONCE, which undercounted scan-bound models (the r05 LSTM) by ~T and
+  made their rooflines fiction.  An unrecoverable loop falls back to
+  ×1 and is tagged with the loud `[loop?]` bucket instead of silently
+  reading as a straight-line body.
 - BYTES: the *materialized-buffers* model — after optimization each
   entry-computation instruction is one kernel that reads its operands
   from HBM once and writes its output once; fusion internals move no
@@ -171,7 +178,8 @@ class Instr:
     __slots__ = ("name", "opcode", "shape", "op_name", "id",
                  "operand_ids", "called_ids", "dot_dnums_buf",
                  "window_buf", "conv_dnums_buf", "feature_group_count",
-                 "custom_call_target")
+                 "custom_call_target", "literal_buf", "tuple_index",
+                 "comparison_direction")
 
     def __init__(self, buf: bytes):
         self.name = ""
@@ -186,6 +194,9 @@ class Instr:
         self.conv_dnums_buf = b""
         self.feature_group_count = 1
         self.custom_call_target = ""
+        self.literal_buf = b""
+        self.tuple_index = 0
+        self.comparison_direction = ""
         for f, _wt, v in _fields(buf):
             if f == 1:
                 self.name = _utf8(v)
@@ -195,6 +206,10 @@ class Instr:
                 self.shape = Shape(v)
             elif f == 7:
                 self.op_name = _utf8(_first(v, 2, b""))
+            elif f == 8:
+                self.literal_buf = v
+            elif f == 13:
+                self.tuple_index = int(v)
             elif f == 15:
                 self.window_buf = v
             elif f == 16:
@@ -211,6 +226,8 @@ class Instr:
                 self.called_ids.extend(_varints(v))
             elif f == 50:
                 self.feature_group_count = max(int(v), 1)
+            elif f == 63:
+                self.comparison_direction = _utf8(v)
 
 
 class Computation:
@@ -261,6 +278,114 @@ class HloModule:
         # fall back: the computation with the largest id is the entry
         # in XLA's numbering
         return self.computations[max(self.computations)]
+
+
+# --------------------------------------------------------------------------
+# while-loop trip counts (the scan undercount fix)
+# --------------------------------------------------------------------------
+
+def _literal_int(buf: bytes) -> Optional[int]:
+    """First integer of a LiteralProto (s32s=4 s64s=5 u32s=6 u64s=7
+    packed varints; u8s=3/s8s=15 raw bytes)."""
+    if not buf:
+        return None
+    for f, _wt, v in _fields(buf):
+        if f in (4, 5, 6, 7):
+            vals = _varints(v)
+            if vals:
+                return vals[0]
+        if f in (3, 15) and isinstance(v, bytes) and v:
+            return v[0]
+    return None
+
+
+def _resolve_through(comp: Computation, o: Optional[Instr]):
+    """Follow value-preserving wrappers (convert/copy/bitcast) to the
+    producing instruction."""
+    while (o is not None and o.opcode in ("convert", "copy", "bitcast")
+           and o.operand_ids):
+        o = comp.by_id.get(o.operand_ids[0])
+    return o
+
+
+def while_trip_count(module: HloModule, comp: Computation,
+                     instr: Instr) -> Optional[int]:
+    """Known trip count of a counted `while` (the lax.scan / fori_loop
+    induction pattern), or None when unrecoverable.
+
+    The scan-emitted pattern: the condition computation's root is
+    `compare(get-tuple-element(param, i), constant_T, LT)` and the body
+    increments tuple element i by a constant step from a constant init.
+    The bound comes from the condition; init/step are refined from the
+    while's operand tuple and the body root when visible and default to
+    the counted-loop convention (0, 1) otherwise.  A loop whose
+    CONDITION does not match (a genuine data-dependent `while` op
+    decode loop) returns None — callers fall back to ×1 with the loud
+    `[loop?]` bucket, never a silent guess.
+    """
+    if instr.opcode != "while":
+        return None
+    called = [module.computations.get(c) for c in instr.called_ids]
+    called = [c for c in called if c is not None]
+    cond = next((c for c in called if c.root is not None
+                 and c.root.opcode == "compare"), None)
+    body = next((c for c in called if c is not cond), None)
+    if cond is None or body is None:
+        return None
+    root = cond.root
+    ops = [_resolve_through(cond, cond.by_id.get(i))
+           for i in root.operand_ids]
+    if len(ops) != 2 or any(o is None for o in ops):
+        return None
+
+    def gte_index(o):
+        if o.opcode != "get-tuple-element" or not o.operand_ids:
+            return None
+        src = cond.by_id.get(o.operand_ids[0])
+        if src is None or src.opcode != "parameter":
+            return None
+        return o.tuple_index
+
+    direction = root.comparison_direction or "LT"
+    a, b = ops
+    if gte_index(a) is not None and b.opcode == "constant":
+        idx, bound, dir_ok = (gte_index(a), _literal_int(b.literal_buf),
+                              direction == "LT")
+    elif gte_index(b) is not None and a.opcode == "constant":
+        idx, bound, dir_ok = (gte_index(b), _literal_int(a.literal_buf),
+                              direction == "GT")
+    else:
+        return None
+    if bound is None or not dir_ok:
+        return None
+
+    # refine init from the while operand's tuple element, step from the
+    # body root's add-by-constant; both default to the (0, 1) counted-
+    # loop convention when optimization hid them
+    init, step = 0, 1
+    if instr.operand_ids:
+        arg = comp.by_id.get(instr.operand_ids[0])
+        if arg is not None and arg.opcode == "tuple" \
+                and idx < len(arg.operand_ids):
+            o = _resolve_through(comp, comp.by_id.get(arg.operand_ids[idx]))
+            if o is not None and o.opcode == "constant":
+                v = _literal_int(o.literal_buf)
+                if v is not None:
+                    init = v
+    broot = body.root
+    if broot is not None and broot.opcode == "tuple" \
+            and idx < len(broot.operand_ids):
+        o = _resolve_through(body, body.by_id.get(broot.operand_ids[idx]))
+        if o is not None and o.opcode == "add":
+            for oid in o.operand_ids:
+                c = _resolve_through(body, body.by_id.get(oid))
+                if c is not None and c.opcode == "constant":
+                    v = _literal_int(c.literal_buf)
+                    if v:
+                        step = v
+    if step <= 0:
+        return None
+    return max(0, -(-(bound - init) // step))
 
 
 # --------------------------------------------------------------------------
@@ -394,6 +519,14 @@ def _instr_flops(module: HloModule, comp: Computation, instr: Instr,
                 f, t = _computation_flops(module, sub, seen)
                 flops += f
                 transc += t
+        if op == "while":
+            # a while body runs trip-count times, not once (the r05
+            # scan undercount); unrecoverable loops stay at ×1 and are
+            # surfaced via the [loop?] bucket in instruction_costs
+            trip = while_trip_count(module, comp, instr)
+            if trip is not None:
+                flops *= trip
+                transc *= trip
         return flops, transc
     # custom-call: zero here; the Pallas registry injects at a higher
     # level so callers can see xla-vs-registry flops separately
@@ -436,10 +569,17 @@ def _registry_cost(kernel: str, instr: Instr, operands: List[Instr]):
 # per-instruction cost rows + bucketing
 # --------------------------------------------------------------------------
 
-def _bucket(module: HloModule, instr: Instr) -> str:
+def _bucket(module: HloModule, comp: Computation, instr: Instr) -> str:
     op = instr.opcode
     if op == "custom-call":
         return "custom_call"
+    if op == "while":
+        # "loop" when the trip count is recovered (flops already carry
+        # the multiplication); the LOUD "[loop?]" tag marks a body
+        # counted ONCE because the induction pattern was unrecoverable
+        # — a roofline reader must never mistake that for real coverage
+        trip = while_trip_count(module, comp, instr)
+        return "loop" if trip is not None else "[loop?]"
     if op == "dot":
         return "matmul"
     if op == "convolution":
@@ -476,12 +616,16 @@ def instruction_costs(proto: bytes) -> List[Dict[str, Any]]:
 
     Row keys: name, opcode, op_type (fluid attribution or None),
     bucket, flops, transcendentals, bytes, pallas_kernel (set when a
-    registered Pallas kernel's cost was injected at a custom call).
+    registered Pallas kernel's cost was injected at a custom call),
+    trip_count (while rows: the recovered loop trip count, already
+    multiplied into flops; None = unrecoverable, body counted once and
+    bucketed "[loop?]").
     `flops` already includes the injected registry flops; `xla_flops`
     carries the pre-injection analytic count.
     """
     # force kernel-cost registration before walking custom calls
     from ..ops.pallas import flash_attention as _fa  # noqa: F401
+    from ..ops.pallas import recurrence as _rc  # noqa: F401
     from ..ops.pallas import vocab_ce as _vc  # noqa: F401
 
     module = HloModule(proto)
@@ -491,7 +635,7 @@ def instruction_costs(proto: bytes) -> List[Dict[str, Any]]:
         operands = [entry.by_id[i] for i in instr.operand_ids
                     if i in entry.by_id]
         flops, transc = _instr_flops(module, entry, instr)
-        bucket = _bucket(module, instr)
+        bucket = _bucket(module, entry, instr)
         if instr.opcode in _NO_BYTES:
             nbytes = 0
         else:
@@ -517,6 +661,8 @@ def instruction_costs(proto: bytes) -> List[Dict[str, Any]]:
             "bytes": float(nbytes),
             "pallas_kernel": None,
         }
+        if instr.opcode == "while":
+            row["trip_count"] = while_trip_count(module, entry, instr)
         if instr.opcode == "custom-call":
             kernel = _pallas_kernel_of(instr.op_name)
             if kernel is not None:

@@ -137,6 +137,9 @@ def static_rnn_op(ctx, env, desc):
       memories:     [[pre_name, post_name, init_name]]
       step_outputs: [[inner_name, outer_name]]  outer gets (T, ...) stacked
       final_states: [[post_name, outer_name]]   (optional)
+      unroll:       lax.scan unroll factor (default 1) — the cheap
+                    XLA-side scan-bound lever (fewer while iterations,
+                    more work per iteration for the scheduler)
 
     reference: paddle/fluid/operators/recurrent_op.cc:222 (step-scope
     iteration) — here one lax.scan, reverse-differentiable by jax AD, so
@@ -147,6 +150,7 @@ def static_rnn_op(ctx, env, desc):
     memories = desc.attrs.get("memories", [])
     step_outputs = desc.attrs.get("step_outputs", [])
     final_states = desc.attrs.get("final_states", [])
+    unroll = int(desc.attrs.get("unroll", 1))
 
     init_carry = tuple(env[init] for _pre, _post, init in memories)
     xs = tuple(env[outer] for outer, _inner in step_inputs)
@@ -164,7 +168,7 @@ def static_rnn_op(ctx, env, desc):
         ys = tuple(e[inner] for inner, _outer in step_outputs)
         return new_carry, ys
 
-    final, ys = lax.scan(body, init_carry, xs)
+    final, ys = lax.scan(body, init_carry, xs, unroll=unroll)
     for (_inner, outer), y in zip(step_outputs, ys):
         env[outer] = y
     # final is ordered by memories; final_states maps post->outer
@@ -189,7 +193,8 @@ def dynamic_rnn_op(ctx, env, desc):
 
     attrs: sub_block, step_inputs [[outer, inner]], memories
     [[pre, post, init]], step_outputs [[inner, outer]], final_states
-    [[post, outer]], seq_len (name of the (B,) length var).
+    [[post, outer]], seq_len (name of the (B,) length var), unroll
+    (lax.scan unroll factor, default 1).
     """
     sub_block = desc.attrs["sub_block"]
     step_inputs = desc.attrs.get("step_inputs", [])
@@ -197,6 +202,7 @@ def dynamic_rnn_op(ctx, env, desc):
     step_outputs = desc.attrs.get("step_outputs", [])
     final_states = desc.attrs.get("final_states", [])
     seq_len = env[desc.attrs["seq_len"]]  # (B,) int
+    unroll = int(desc.attrs.get("unroll", 1))
 
     init_carry = tuple(env[init] for _pre, _post, init in memories)
     # batch-major (B, T, ...) → time-major (T, B, ...) for the scan
@@ -227,7 +233,7 @@ def dynamic_rnn_op(ctx, env, desc):
         return new_carry, ys
 
     ts = jnp.arange(t_max)
-    final, ys = lax.scan(body, init_carry, (ts, xs))
+    final, ys = lax.scan(body, init_carry, (ts, xs), unroll=unroll)
     for (_inner, outer), y in zip(step_outputs, ys):
         env[outer] = jnp.moveaxis(y, 0, 1)  # back to (B, T, ...)
     post_to_final = {post: f for (_pre, post, _init), f in zip(memories, final)}

@@ -40,7 +40,14 @@ def dynamic_lstm(ctx, ins, attrs):
     """Input (N, T, 4H) — already projected by the preceding fc, matching
     the reference contract (lstm_op.cc expects x @ W_x done outside).
     Weight (H, 4H) recurrent projection; Bias (1, 4H) or (1, 7H) with
-    peepholes."""
+    peepholes.
+
+    attrs `unroll` (lax.scan unroll factor, default 1) and `use_pallas`
+    (route the recurrence through the blocked fused Pallas kernel,
+    ops/pallas/recurrence.py) are the two scan-bound perf levers; the
+    kernel path rejects peepholes and non-default activations loudly
+    and is numerically parity-tested against the scan path
+    (tests/test_pallas_recurrence.py)."""
     from .sequence import _reject_nested
 
     _reject_nested(ins, "dynamic_lstm")
@@ -55,6 +62,8 @@ def dynamic_lstm(ctx, ins, attrs):
     cand_act = _act(attrs.get("candidate_activation", "tanh"))
     use_peepholes = attrs.get("use_peepholes", False)
     is_reverse = attrs.get("is_reverse", False)
+    unroll = int(attrs.get("unroll", 1))
+    use_pallas = bool(attrs.get("use_pallas", False))
 
     n, t, g4 = x.shape
     h_dim = g4 // 4
@@ -69,6 +78,21 @@ def dynamic_lstm(ctx, ins, attrs):
             w_oc = peep[2 * h_dim:]
     h_prev = h0 if h0 is not None else jnp.zeros((n, h_dim), x.dtype)
     c_prev = c0 if c0 is not None else jnp.zeros((n, h_dim), x.dtype)
+
+    if use_pallas:
+        from .pallas.recurrence import fused_lstm
+
+        # fused_lstm itself rejects peepholes / non-default activations
+        # loudly; x already carries the bias
+        hs_b, cs_b, h_last, c_last = fused_lstm(
+            x, w, h0=h_prev, c0=c_prev, seq_len=seq_len,
+            is_reverse=is_reverse, use_peepholes=use_peepholes,
+            gate_activation=attrs.get("gate_activation", "sigmoid"),
+            cell_activation=attrs.get("cell_activation", "tanh"),
+            candidate_activation=attrs.get("candidate_activation",
+                                           "tanh"))
+        return {"Hidden": [hs_b], "Cell": [cs_b],
+                "LastH": [h_last], "LastC": [c_last]}
 
     xs = jnp.swapaxes(x, 0, 1)  # (T, N, 4H)
     if is_reverse:
@@ -100,7 +124,7 @@ def dynamic_lstm(ctx, ins, attrs):
         return (h_new, c_new), (h_new, c_new)
 
     (h_last, c_last), (hs, cs) = lax.scan(step, (h_prev, c_prev),
-                                          (xs, steps))
+                                          (xs, steps), unroll=unroll)
     if is_reverse:
         hs = jnp.flip(hs, axis=0)
         cs = jnp.flip(cs, axis=0)
@@ -127,6 +151,7 @@ def dynamic_gru(ctx, ins, attrs):
     gate_act = _act(attrs.get("gate_activation", "sigmoid"))
     cand_act = _act(attrs.get("activation", "tanh"))
     is_reverse = attrs.get("is_reverse", False)
+    unroll = int(attrs.get("unroll", 1))
 
     n, t, g3 = x.shape
     h_dim = g3 // 3
@@ -158,7 +183,7 @@ def dynamic_gru(ctx, ins, attrs):
             h_new = jnp.where(valid, h_new, h)
         return h_new, h_new
 
-    h_last, hs = lax.scan(step, h_prev, (xs, steps))
+    h_last, hs = lax.scan(step, h_prev, (xs, steps), unroll=unroll)
     if is_reverse:
         hs = jnp.flip(hs, axis=0)
     return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
@@ -232,6 +257,7 @@ def lstmp(ctx, ins, attrs):
     proj_act = _act(attrs.get("proj_activation", "tanh"))
     use_peepholes = attrs.get("use_peepholes", False)
     is_reverse = attrs.get("is_reverse", False)
+    unroll = int(attrs.get("unroll", 1))
 
     n, t, g4 = x.shape
     h_dim = g4 // 4
@@ -275,7 +301,7 @@ def lstmp(ctx, ins, attrs):
         return (r_new, c_new), (r_new, c_new)
 
     (r_last, c_last), (rs, cs) = lax.scan(step, (r_prev, c_prev),
-                                          (xs, steps))
+                                          (xs, steps), unroll=unroll)
     if is_reverse:
         rs = jnp.flip(rs, axis=0)
         cs = jnp.flip(cs, axis=0)

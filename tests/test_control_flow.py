@@ -513,3 +513,64 @@ def test_tensor_array_to_tensor_axis_validation(exe):
     xv = np.random.RandomState(1).randn(3, 2, 4).astype(np.float32)
     (tv,) = exe.run(main, feed={"x": xv}, fetch_list=[tail])
     np.testing.assert_allclose(tv, np.stack(list(xv), axis=2))
+
+
+def test_static_rnn_unroll_equivalent(exe):
+    """The macro-op scan path where the Pallas kernel cannot apply:
+    StaticRNN(unroll=K) must compute the same recurrence.  XLA:CPU schedules/FMA-fuses
+    the unrolled bodies differently by ~1 ulp per step (measured in
+    tests/test_pallas_recurrence.py for the fused RNN ops); the
+    recurrence COMPOUNDS that over T steps, hence the few-ulp atol."""
+    T, B, D = 6, 3, 2
+
+    def build(unroll):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[T, B, D],
+                            append_batch_size=False)
+            z = layers.fill_constant([B, D], "float32", 0.0)
+            rnn = layers.StaticRNN(unroll=unroll)
+            with rnn.step():
+                xt = rnn.step_input(x)
+                acc = rnn.memory(init=z)
+                s = layers.tanh(layers.elementwise_add(acc, xt))
+                rnn.update_memory(acc, s)
+                rnn.step_output(s)
+            out = rnn()
+        return main, out
+
+    xv = np.random.RandomState(5).randn(T, B, D).astype(np.float32)
+    main1, out1 = build(1)
+    (base,) = exe.run(main1, feed={"x": xv}, fetch_list=[out1])
+    for k in (2, 4):
+        maink, outk = build(k)
+        (got,) = exe.run(maink, feed={"x": xv}, fetch_list=[outk])
+        np.testing.assert_allclose(got, base, rtol=0, atol=5e-6)
+
+
+def test_dynamic_rnn_unroll_equivalent(exe):
+    B, T, D = 3, 5, 2
+    lens = np.array([5, 3, 1], np.int32)
+
+    def build(unroll):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[B, T, D],
+                            append_batch_size=False, lod_level=1)
+            drnn = layers.DynamicRNN(unroll=unroll)
+            with drnn.block():
+                xt = drnn.step_input(x)
+                mem = drnn.memory(shape=[D], value=0.0)
+                s = layers.tanh(layers.elementwise_add(mem, xt))
+                drnn.update_memory(mem, s)
+                drnn.output(s)
+            out = drnn()
+        return main, out
+
+    xv = np.random.RandomState(6).randn(B, T, D).astype(np.float32)
+    feed = {"x": xv, "x.seq_len": lens}
+    main1, out1 = build(1)
+    (base,) = exe.run(main1, feed=feed, fetch_list=[out1])
+    main3, out3 = build(3)
+    (got,) = exe.run(main3, feed=feed, fetch_list=[out3])
+    np.testing.assert_allclose(got, base, rtol=0, atol=5e-6)

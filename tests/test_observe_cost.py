@@ -165,10 +165,12 @@ def test_kernel_costs_registered_for_every_scoped_kernel():
     # the bench numerator REFUSES custom calls without a registered
     # cost; every name= passed to pallas_call must therefore have one
     from paddle_tpu.ops import pallas as pallas_pkg
-    from paddle_tpu.ops.pallas import flash_attention, vocab_ce  # noqa: F401
+    from paddle_tpu.ops.pallas import (  # noqa: F401
+        flash_attention, recurrence, vocab_ce)
 
     expected = {"flash_fwd", "flash_dkv", "flash_dq",
-                "vocab_ce_fwd", "vocab_ce_dh", "vocab_ce_dw"}
+                "vocab_ce_fwd", "vocab_ce_dh", "vocab_ce_dw",
+                "lstm_fwd", "lstm_bwd"}
     assert expected <= set(pallas_pkg.KERNEL_COSTS), \
         sorted(pallas_pkg.KERNEL_COSTS)
     # and the registered fns compute from custom-call operand shapes
@@ -283,3 +285,104 @@ def test_fluid_op_of_sees_through_transform_wrappers():
     assert observe.fluid_op_of(
         "jit(step)/transpose(jvp(softmax:25))/mul") == "softmax"
     assert observe.fluid_op_of("jit(step)/jvp(fc_0)/add") is None
+
+
+# -- loop-aware attribution (ISSUE 5: the scan ×1 undercount fix) ----------
+
+def _scan_compiled(T=32, N=16, H=64):
+    from jax import lax
+
+    def f(xs, w, h0):
+        def step(h, x):
+            h = jnp.tanh(x + h @ w)
+            return h, h
+        _hl, hs = lax.scan(step, h0, xs)
+        return hs.sum()
+
+    xs = jnp.ones((T, N, H), jnp.float32)
+    w = jnp.ones((H, H), jnp.float32)
+    h0 = jnp.ones((N, H), jnp.float32)
+    g = jax.value_and_grad(f, argnums=(0, 1))
+    return jax.jit(g).lower(xs, w, h0).compile(), (T, N, H)
+
+
+def test_while_trip_count_recovered_from_scan():
+    compiled, (T, N, H) = _scan_compiled()
+    rows = cost.instruction_costs(cost.compiled_hlo_proto(compiled))
+    whiles = [r for r in rows if r["opcode"] == "while"]
+    assert whiles, "expected scan-emitted while loops at entry"
+    for r in whiles:
+        assert r["trip_count"] == T, (r["name"], r["trip_count"])
+        assert r["bucket"] == "loop"
+
+
+def test_scan_body_flops_multiplied_by_trip_count():
+    # the acceptance criterion: no more ×1 undercount.  XLA's own
+    # aggregate counts the while bodies ONCE; the analytic totals must
+    # carry the full T× recurrence work (fwd dot + 2 bwd dots).
+    compiled, (T, N, H) = _scan_compiled()
+    totals = cost.total_costs(cost.compiled_hlo_proto(compiled))
+    xla = cost.compiled_xla_flops(compiled)
+    analytic_bound = T * 2 * N * H * H * 3
+    assert totals["flops"] >= 0.9 * analytic_bound, (totals["flops"],
+                                                     analytic_bound)
+    assert totals["flops"] > 2 * xla, (totals["flops"], xla)
+
+
+def test_data_dependent_while_gets_loud_loopq_bucket():
+    from jax import lax
+
+    def f(x):
+        w = jnp.eye(8) * 1.01
+
+        def cond(c):
+            v, _ = c
+            return jnp.sum(v) < 100.0
+
+        def body(c):
+            v, i = c
+            return v @ w + 0.1, i + 1
+
+        v, _ = lax.while_loop(cond, body, (x, 0))
+        return v.sum()
+
+    compiled = jax.jit(f).lower(jnp.ones((8, 8), jnp.float32)).compile()
+    rows = cost.instruction_costs(cost.compiled_hlo_proto(compiled))
+    whiles = [r for r in rows if r["opcode"] == "while"]
+    assert whiles
+    for r in whiles:
+        assert r["trip_count"] is None
+        assert r["bucket"] == "[loop?]"
+
+
+def test_op_cost_table_lstm_step_attributes_trip_multiplied_flops():
+    """The lstm acceptance check chip-free: the dynamic_lstm-attributed
+    rows of a tiny train step must carry at least T× the per-step
+    recurrent GEMM (fwd), i.e. the scan body was multiplied, not
+    counted once."""
+    B, T, H = 4, 16, 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data(name="x", shape=[T, 4 * H], dtype="float32",
+                        lod_level=1)
+        lstm_out, _cell = layers.dynamic_lstm(x, size=4 * H,
+                                              use_peepholes=False)
+        last = layers.sequence_pool(lstm_out, pool_type="max")
+        loss = layers.mean(layers.fc(last, size=1))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": rng.rand(B, T, 4 * H).astype(np.float32),
+                "x.seq_len": np.full((B,), T, np.int32)}
+        rows = observe.op_cost_table(main, feed=feed,
+                                     fetch_list=[loss], exe=exe)
+    lstm_flops = sum(r["flops"] for r in rows
+                     if r["op_type"] == "dynamic_lstm")
+    # fwd recurrence alone: T steps of 2*B*H*4H; bwd adds ~2x more
+    fwd_gemm = T * 2 * B * H * 4 * H
+    assert lstm_flops >= fwd_gemm, (lstm_flops, fwd_gemm)
+    buckets = {r["bucket"] for r in rows
+               if r["op_type"] == "dynamic_lstm"}
+    assert "loop" in buckets, buckets

@@ -166,3 +166,33 @@ def test_full_longctx_train_step_lowers_for_tpu():
     # flash fwd+bwd (self + cross, enc + dec) and vocab-CE fwd+bwd all
     # reach Mosaic
     assert exp.mlir_module().count("tpu_custom_call") >= 5
+
+
+def test_fused_lstm_fwd_lowers_for_tpu():
+    from paddle_tpu.ops.pallas.recurrence import fused_lstm
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 16, 4 * 128), jnp.float32)
+    w = jnp.asarray(rng.randn(128, 4 * 128), jnp.float32)
+    sl = jnp.asarray(np.full(8, 16, np.int32))
+    exp = _export_tpu(
+        lambda x, w, sl: fused_lstm(x, w, seq_len=sl)[0], x, w, sl)
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_fused_lstm_bwd_lowers_for_tpu():
+    from paddle_tpu.ops.pallas.recurrence import fused_lstm
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 16, 4 * 128), jnp.float32)
+    w = jnp.asarray(rng.randn(128, 4 * 128), jnp.float32)
+
+    def loss(x, w):
+        hs, cs, hl, cl = fused_lstm(x, w, is_reverse=True)
+        return hs.sum() + cs.sum()
+
+    exp = _export_tpu(
+        lambda x, w: jax.grad(loss, argnums=(0, 1))(x, w), x, w)
+    # fwd kernel (residual recompute path) + bwd kernel both reach
+    # Mosaic
+    assert exp.mlir_module().count("tpu_custom_call") >= 2

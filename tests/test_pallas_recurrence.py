@@ -1,0 +1,219 @@
+"""Pallas fused LSTM recurrence kernel — parity against the scan path
+(ISSUE 5 tentpole).
+
+The kernel runs through the Pallas INTERPRETER on the CPU backend
+(tests/test_pallas_lowering.py separately proves the Mosaic lowering),
+so these tests pin numerics: forward AND gradients must match the
+lax.scan reference in ops/rnn.py bit-for-bit semantics-wise —
+including seq_len masking (state freezes past each row's end),
+is_reverse, and initial states — and the unsupported configurations
+(peepholes, non-default activations, nested lod2 inputs) must be
+rejected LOUDLY, never silently mis-computed.
+
+Also pins the cheap scan-side lever: `unroll=K` is a scheduling hint,
+so dynamic_lstm / dynamic_gru / lstmp outputs must be BIT-identical
+to unroll=1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import OpContext, get_op_impl
+
+from op_test import run_op
+
+
+def R(seed):
+    return np.random.RandomState(seed)
+
+
+N, T, H = 3, 10, 4  # T deliberately NOT a multiple of the time block
+
+
+def _lstm_ins(seed=0, with_states=False, with_seq_len=False,
+              peephole_bias=False):
+    r = R(seed)
+    h4 = 4 * H
+    ins = {
+        "Input": (r.randn(N, T, h4) * 0.3).astype(np.float32),
+        "Weight": (r.randn(H, h4) * 0.3).astype(np.float32),
+        "Bias": (r.randn(1, 7 * H if peephole_bias else h4)
+                 * 0.3).astype(np.float32),
+    }
+    if with_states:
+        ins["H0"] = (r.randn(N, H) * 0.3).astype(np.float32)
+        ins["C0"] = (r.randn(N, H) * 0.3).astype(np.float32)
+    if with_seq_len:
+        ins["SeqLen"] = np.array([T, T - 4, 3], np.int32)
+    return ins
+
+
+def _run_lstm(ins, attrs, slots=("Hidden", "Cell", "LastH", "LastC")):
+    impl = get_op_impl("dynamic_lstm")
+    jins = {s: [jnp.asarray(a)] for s, a in ins.items()}
+    out = impl(OpContext(jax.random.PRNGKey(0), 0), jins, dict(attrs))
+    return {s: np.asarray(out[s][0]) for s in slots}
+
+
+@pytest.mark.parametrize("with_states", [False, True])
+@pytest.mark.parametrize("with_seq_len", [False, True])
+@pytest.mark.parametrize("is_reverse", [False, True])
+def test_forward_matches_scan(with_states, with_seq_len, is_reverse):
+    ins = _lstm_ins(seed=7, with_states=with_states,
+                    with_seq_len=with_seq_len)
+    base = {"use_peepholes": False, "is_reverse": is_reverse}
+    ref = _run_lstm(ins, base)
+    got = _run_lstm(ins, {**base, "use_pallas": True})
+    for slot in ref:
+        np.testing.assert_allclose(
+            got[slot], ref[slot], rtol=2e-5, atol=2e-6,
+            err_msg=f"{slot} (states={with_states}, "
+                    f"seq_len={with_seq_len}, reverse={is_reverse})")
+
+
+@pytest.mark.parametrize("with_seq_len", [False, True])
+@pytest.mark.parametrize("is_reverse", [False, True])
+def test_grad_matches_scan(with_seq_len, is_reverse):
+    """Analytic-vs-analytic: jax.grad through the kernel's custom VJP
+    must equal jax.grad through the scan reference, for every
+    differentiable input, under a loss that weights Hidden AND Cell
+    (and the last states) so no gradient path is vacuously zero."""
+    ins = _lstm_ins(seed=11, with_states=True,
+                    with_seq_len=with_seq_len)
+    impl = get_op_impl("dynamic_lstm")
+    slots = ["Input", "Weight", "Bias", "H0", "C0"]
+
+    def loss_fn(use_pallas):
+        def f(*vals):
+            jins = {s: [v] for s, v in zip(slots, vals)}
+            if with_seq_len:
+                jins["SeqLen"] = [jnp.asarray(ins["SeqLen"])]
+            out = impl(OpContext(jax.random.PRNGKey(0), 0), jins,
+                       {"use_peepholes": False,
+                        "is_reverse": is_reverse,
+                        "use_pallas": use_pallas})
+            hs, cs = out["Hidden"][0], out["Cell"][0]
+            k1 = jnp.cos(jnp.arange(hs.size, dtype=jnp.float32)
+                         .reshape(hs.shape) * 0.1)
+            k2 = jnp.sin(jnp.arange(cs.size, dtype=jnp.float32)
+                         .reshape(cs.shape) * 0.07)
+            return (jnp.sum(hs * k1) + jnp.sum(cs * k2)
+                    + 0.5 * jnp.sum(out["LastH"][0])
+                    + 0.25 * jnp.sum(out["LastC"][0]))
+        return f
+
+    vals = tuple(jnp.asarray(ins[s]) for s in slots)
+    argnums = tuple(range(len(slots)))
+    g_ref = jax.grad(loss_fn(False), argnums=argnums)(*vals)
+    g_pal = jax.grad(loss_fn(True), argnums=argnums)(*vals)
+    for slot, a, b in zip(slots, g_pal, g_ref):
+        np.testing.assert_allclose(
+            a, b, rtol=3e-5, atol=3e-6,
+            err_msg=f"d{slot} (seq_len={with_seq_len}, "
+                    f"reverse={is_reverse})")
+
+
+def test_rejects_peepholes_loudly():
+    ins = _lstm_ins(seed=3, peephole_bias=True)
+    with pytest.raises(ValueError, match="peephole"):
+        _run_lstm(ins, {"use_peepholes": True, "use_pallas": True})
+
+
+def test_rejects_nonstandard_activations_loudly():
+    ins = _lstm_ins(seed=4)
+    with pytest.raises(ValueError, match="activation"):
+        _run_lstm(ins, {"use_peepholes": False, "use_pallas": True,
+                        "gate_activation": "relu"})
+
+
+def test_rejects_nested_lod2_loudly():
+    ins = _lstm_ins(seed=5)
+    ins["SeqLen"] = np.array([T, T, T], np.int32)
+    ins["SeqLen2"] = np.full((N, T), 1, np.int32)
+    with pytest.raises(NotImplementedError, match="nested"):
+        _run_lstm(ins, {"use_peepholes": False, "use_pallas": True})
+
+
+def test_fused_lstm_direct_rejections():
+    from paddle_tpu.ops.pallas.recurrence import fused_lstm
+
+    x = jnp.zeros((2, 4, 4 * H), jnp.float32)
+    w = jnp.zeros((H, 4 * H), jnp.float32)
+    with pytest.raises(ValueError, match="peephole"):
+        fused_lstm(x, w, use_peepholes=True)
+    with pytest.raises(ValueError, match="activation"):
+        fused_lstm(x, w, cell_activation="relu")
+    with pytest.raises(ValueError, match="4\\*H"):
+        fused_lstm(jnp.zeros((2, 4, 13), jnp.float32), w)
+
+
+# -- scan-path unroll: a scheduling knob, never a numerics knob ------------
+#
+# `unroll=K` traces the IDENTICAL step function K times per while
+# iteration — the math is the same by construction.  XLA:CPU is then
+# free to FMA-contract / schedule the unrolled bodies differently,
+# which was MEASURED to move results by at most one ulp (4.5e-8 at
+# these magnitudes; most elements stay bit-identical).  The assert
+# pins exactly that: same values up to 1 ulp, with zero tolerance for
+# any real numeric drift that would mean the lever changed semantics.
+
+_ULP = 1.2e-7  # one f32 ulp at magnitude ~1 (tanh-bounded outputs)
+
+
+def _assert_unroll_equiv(base, unr, what):
+    np.testing.assert_allclose(
+        unr, base, rtol=0, atol=_ULP,
+        err_msg=f"{what}: unroll changed numerics beyond backend "
+                f"scheduling (1 ulp)")
+
+
+def test_dynamic_lstm_unroll_equivalent():
+    ins = _lstm_ins(seed=21, with_seq_len=True)
+    base = run_op("dynamic_lstm", ins, {"use_peepholes": False},
+                  "Hidden")
+    for k in (2, 3, 8):
+        unr = run_op("dynamic_lstm", ins,
+                     {"use_peepholes": False, "unroll": k}, "Hidden")
+        _assert_unroll_equiv(base, unr, f"dynamic_lstm unroll={k}")
+
+
+def test_dynamic_gru_unroll_equivalent():
+    r = R(22)
+    ins = {"Input": (r.randn(2, 7, 3 * H) * 0.3).astype(np.float32),
+           "Weight": (r.randn(H, 3 * H) * 0.3).astype(np.float32)}
+    base = run_op("dynamic_gru", ins, {}, "Hidden")
+    unr = run_op("dynamic_gru", ins, {"unroll": 4}, "Hidden")
+    _assert_unroll_equiv(base, unr, "dynamic_gru unroll=4")
+
+
+def test_lstmp_unroll_equivalent():
+    r = R(23)
+    ins = {"Input": (r.randn(2, 6, 4 * H) * 0.3).astype(np.float32),
+           "Weight": (r.randn(3, 4 * H) * 0.3).astype(np.float32),
+           "ProjWeight": (r.randn(H, 3) * 0.3).astype(np.float32)}
+    base = run_op("lstmp", ins, {}, "Projection")
+    unr = run_op("lstmp", ins, {"unroll": 5}, "Projection")
+    _assert_unroll_equiv(base, unr, "lstmp unroll=5")
+
+
+# -- kernel cost registry (observe/cost.py injection contract) -------------
+
+def test_lstm_kernel_costs_registered():
+    from paddle_tpu.ops import pallas as pallas_pkg
+    from paddle_tpu.ops.pallas import recurrence  # noqa: F401
+
+    assert {"lstm_fwd", "lstm_bwd"} <= set(pallas_pkg.KERNEL_COSTS)
+    # dense-equivalent: the recurrent GEMM dominates — T*(2*N*H*4H)
+    xs = ((T, N, 4 * H), 4)
+    flops, nbytes = pallas_pkg.KERNEL_COSTS["lstm_fwd"](
+        [xs, ((H, 4 * H), 4)], [((T, N, H), 4)])
+    assert flops >= T * 2 * N * H * 4 * H
+    assert nbytes is None  # default materialized-buffers model
+    bflops, _ = pallas_pkg.KERNEL_COSTS["lstm_bwd"](
+        [xs, ((H, 4 * H), 4)], [xs])
+    assert bflops >= 2 * flops * 0.9  # bwd = two gemms vs fwd's one

@@ -38,7 +38,10 @@ import os
 import sys
 
 # headline metrics: higher is better, keyed by per-model detail entries
-# (requests_per_sec = the serving_engine offered-load line)
+# (requests_per_sec = the serving_engine offered-load line;
+# tokens_per_sec + examples_per_sec both gate the scan-bound lstm
+# entry — throughput, not MFU, is the tracked axis there because the
+# scan path's MFU numerator counts loop bodies once, see bench_lstm)
 _THROUGHPUT_KEYS = ("tokens_per_sec", "imgs_per_sec",
                     "examples_per_sec", "requests_per_sec")
 # serving latency: lower is better

@@ -131,6 +131,29 @@ def _transformer_costs(batch_size, max_length, use_flash, use_amp=True,
                                       exe=exe)
 
 
+def _lstm_costs(batch_size, max_len=128, pallas_rnn=False,
+                rnn_unroll=1):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import stacked_dynamic_lstm as lstm
+    from paddle_tpu.observe import cost as obs_cost
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        model = lstm.build_model(max_len=max_len, use_amp=False,
+                                 pallas_rnn=pallas_rnn,
+                                 rnn_unroll=rnn_unroll)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {k: np.asarray(v) for k, v in
+                lstm.make_fake_batch(batch_size, max_len).items()}
+        return obs_cost.program_costs(main, feed=feed,
+                                      fetch_list=[model["loss"]],
+                                      exe=exe)
+
+
 def _load_measured(paths):
     """{bench_detail_key: measured_mfu} from recorded bench artifacts
     (first artifact that loads wins per key)."""
@@ -160,6 +183,11 @@ def _measured_key(config_key):
         return "resnet50"
     if config_key == "transformer_bs64_len256_flash":
         return "transformer"
+    if config_key == "lstm_bs128_len128_scan":
+        # the scan-bound outlier — comparable now that while bodies
+        # carry their trip count (the ×1 undercount made the r05 lstm
+        # "roofline" fiction); the bench program is the scan path
+        return "lstm"
     return None
 
 
@@ -187,7 +215,7 @@ def _check_consistency(results, measured):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
-                   choices=["all", "resnet50", "transformer"])
+                   choices=["all", "resnet50", "transformer", "lstm"])
     p.add_argument("--batch", type=int, default=0)
     p.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
     p.add_argument("--flash", action="store_true",
@@ -233,6 +261,16 @@ def main():
                                         flash_pallas=True)
             results[f"transformer_bs{bs}_len256_pallas"] = _roofline(
                 totals, peak, bw)
+    if args.model in ("all", "lstm"):
+        # scan path: while bodies × trip count (the r05 fiction fix);
+        # pallas path: the fused-recurrence program with its registry
+        # kernel costs — both programs the lstm A/B actually runs
+        bs = args.batch or 128
+        totals = _lstm_costs(bs)
+        results[f"lstm_bs{bs}_len128_scan"] = _roofline(totals, peak, bw)
+        totals = _lstm_costs(bs, pallas_rnn=True)
+        results[f"lstm_bs{bs}_len128_pallas"] = _roofline(totals, peak,
+                                                          bw)
 
     measured = _load_measured(args.measured
                               if args.measured is not None

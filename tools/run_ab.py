@@ -1,12 +1,18 @@
 """A/B benchmark driver (VERDICT r3 item 1b): run bench.py once per
 perf-feature configuration on the real chip and write a combined
-AB_r05.json artifact with the winners, so every bench default reflects a
+AB artifact with the winners, so every bench default reflects a
 measured win.
 
-Usage: python tools/run_ab.py [--steps N] [--out AB_r05.json]
+Usage: python tools/run_ab.py [--steps N] [--out AB_r06.json]
 Each variant is a separate bench.py subprocess (fresh backend, no cache
 cross-talk); the probe inside bench.py keeps a dead backend from
 burning the timeout.
+
+r06 adds the scan-bound lstm variants (unroll sweep + the Pallas fused
+recurrence kernel vs the scan base).  Entries recorded off-chip carry
+their producing backend in each entry's `device` field — a
+CPU-recorded win ("cpu (assumed v5e peak)") documents the harness but
+does NOT flip a TPU bench default.
 """
 
 from __future__ import annotations
@@ -61,6 +67,17 @@ VARIANTS = [
     # fraction grows; dense attention stopped existing back at 8k)
     ("longctx_16k_bs1", ["--model", "longctx", "--seq", "16384",
                          "--batch", "1"]),
+    # scan-bound lstm (ISSUE 5): the r05 outlier at 0.078 MFU.  The
+    # unroll sweep is the cheap XLA-side lever (bit-identical
+    # numerics); pallas_rnn is the fused recurrence kernel.  wins()
+    # compares tokens/sec as everywhere — lstm MFU numerators are NOT
+    # comparable across these variants (scan entries count loop bodies
+    # once, pallas entries use the kernel registry).
+    ("lstm_base", ["--model", "lstm"]),
+    ("lstm_unroll2", ["--model", "lstm", "--rnn-unroll", "2"]),
+    ("lstm_unroll4", ["--model", "lstm", "--rnn-unroll", "4"]),
+    ("lstm_unroll8", ["--model", "lstm", "--rnn-unroll", "8"]),
+    ("lstm_pallas_rnn", ["--model", "lstm", "--pallas-rnn"]),
 ]
 
 
@@ -84,7 +101,9 @@ def _run_tag():
 
 
 def run_variant(args, extra):
-    cmd = [sys.executable, "bench.py", "--steps", str(args.steps)] + extra
+    cmd = ([sys.executable, "bench.py", "--steps", str(args.steps)]
+           + (args.bench_args.split() if args.bench_args else [])
+           + extra)
     t0 = time.time()
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
@@ -184,6 +203,11 @@ def compute_summary(results):
                                  "transformer_base"),
         "longctx_pallas_wins": wins(results, "longctx_8k_pallas",
                                     "longctx_8k_xla"),
+        "lstm_unroll2_wins": wins(results, "lstm_unroll2", "lstm_base"),
+        "lstm_unroll4_wins": wins(results, "lstm_unroll4", "lstm_base"),
+        "lstm_unroll8_wins": wins(results, "lstm_unroll8", "lstm_base"),
+        "lstm_pallas_rnn_wins": wins(results, "lstm_pallas_rnn",
+                                     "lstm_base"),
     }
 
 
@@ -191,9 +215,14 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r05.json")
+    p.add_argument("--out", default="AB_r06.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
+    p.add_argument("--bench-args", default=None,
+                   help="extra bench.py args prepended to every "
+                        "variant (e.g. '--batch 16' for an off-chip "
+                        "CPU recording — each entry's `device` field "
+                        "records the producing backend either way)")
     args = p.parse_args()
 
     run_tag = _run_tag()

@@ -38,6 +38,32 @@ print("telemetry smoke OK:",
       {k: out.get(k) for k in ("compile_s", "retraces", "peak_mem_bytes")})
 EOF
 
+echo "== scan-bound rnn flags smoke (cpu) =="
+# ISSUE 5: both scan-bound levers must stay wired end-to-end — the
+# bench lstm entry must accept --rnn-unroll + --pallas-rnn (fused
+# Pallas recurrence, interpret mode on CPU) and record both flags in
+# its JSON line; the kernel's interpret-mode parity suite (fwd + grad
+# vs the scan reference) is run explicitly so the flags can't rot.
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "lstm", "--batch", "4",
+     "--steps", "2", "--warmup", "1", "--rnn-unroll", "4",
+     "--pallas-rnn", "--probe-timeout", "0"],
+    capture_output=True, text=True, timeout=900)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["lstm"]
+assert "error" not in d, d
+assert d["rnn_unroll"] == 4 and d["pallas_rnn"] is True, d
+assert d["tokens_per_sec"] > 0 and d["examples_per_sec"] > 0
+print("rnn flags smoke OK:",
+      {k: d[k] for k in ("tokens_per_sec", "examples_per_sec",
+                         "pallas_rnn", "rnn_unroll", "flop_count")})
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_recurrence.py -q
+
 echo "== serving engine smoke (cpu) =="
 # the production-serving contract end-to-end: engine start (bucket
 # warmup) -> concurrent requests -> drain, with ZERO XLA compiles
