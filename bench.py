@@ -145,6 +145,68 @@ def _timed_loop(exe, program, feed_dev, loss, steps, warmup, scope=None):
     return elapsed, float(np.asarray(lv).reshape(-1)[0]), tel
 
 
+def _mem_fields(exe, program, feed, loss, scope=None):
+    """`mem_breakdown` for one training entry: per-bucket byte sums
+    (params / optimizer_state / gradients / activations / workspace,
+    donated, peak_bytes) of the measured step's buffer assignment
+    (observe.memory).  Reuses the executor's memoized AOT compile —
+    cost_analysis already paid it — so this is pure proto parsing.  A
+    backend without memory analysis degrades to the module-shapes
+    estimate (tagged via "source"), and any failure is recorded
+    in-band rather than killing the entry."""
+    try:
+        from paddle_tpu import observe
+
+        return {"mem_breakdown": observe.step_mem_breakdown(
+            program, feed=feed, fetch_list=[loss], scope=scope,
+            exe=exe)}
+    except Exception as e:  # noqa: BLE001 — observability must not
+        #                     take down the measurement it describes
+        return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
+
+
+def _predictor_mem(predictor):
+    """`mem_breakdown` of a serving entry: buffer accounting of the
+    predictor's largest compiled executable (no fluid program here, so
+    buckets are params vs workspace/activations by HLO scope only)."""
+    try:
+        from paddle_tpu import observe
+        from paddle_tpu.observe.memory import memory_report
+
+        compiled_cache = getattr(predictor, "_compiled", None) or {}
+        if not compiled_cache:
+            return {"mem_breakdown": None}
+        best = None
+        for entry in compiled_cache.values():
+            rep = memory_report(compiled=entry)
+            if best is None or rep["peak_bytes"] > best["peak_bytes"]:
+                best = rep
+        out = dict(best["breakdown"])
+        out["source"] = best["source"]
+        return {"mem_breakdown": out}
+    except Exception as e:  # noqa: BLE001
+        return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
+
+
+def _peak_mem_if_backend_up():
+    """observe.peak_memory_bytes() ONLY when this process already
+    initialized a backend: the refusal/probe-failure lines run before
+    any device contact, and creating a client just to read its stats
+    is itself a chip attach (the ~5x hazard those lines exist to
+    avoid).  Populated here, an OOM-shaped late failure is
+    distinguishable from a clean never-touched-the-device one."""
+    try:
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            return None
+    except Exception:  # noqa: BLE001 — private API, version-dependent
+        return None
+    from paddle_tpu.observe import monitoring
+
+    return monitoring.peak_memory_bytes()
+
+
 def _mfu_result(step_flops, steps, elapsed, extra):
     if step_flops <= 0:
         raise RuntimeError(
@@ -240,6 +302,8 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                 last_loss = float(np.asarray(lv).reshape(-1)[0])
                 cost = exe.cost_analysis(main, feed=next(feeder),
                                          fetch_list=[model["loss"]])
+                mem = _mem_fields(exe, main, next(feeder),
+                                  model["loss"])
             finally:
                 dev_feeder.reset()
         else:
@@ -248,6 +312,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
             elapsed, last_loss, tel = _timed_loop(
                 exe, main, feed, model["loss"], steps, warmup,
                 scope=scope)
+            mem = _mem_fields(exe, main, feed, model["loss"])
     imgs_per_sec = batch_size * steps / elapsed
     return _mfu_result(
         float(cost.get("flops", 0.0)), steps, elapsed,
@@ -255,7 +320,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
          "batch_size": batch_size, "amp": use_amp,
          "data_mode": data_mode, "data_format": data_format,
          "last_loss": last_loss,
-         **_tel_fields(tel),
+         **_tel_fields(tel), **mem,
          "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)})
 
 
@@ -380,6 +445,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
                                               warmup, scope=scope)
+        mem = _mem_fields(exe, main, feed, model["loss"])
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_length * steps
@@ -391,7 +457,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "recompute": recompute,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel)})
+         **_tel_fields(tel), **mem})
 
 
 def bench_bert(batch_size: int, steps: int, warmup: int,
@@ -427,6 +493,7 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
                                               warmup, scope=scope)
+        mem = _mem_fields(exe, main, feed, model["loss"])
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
@@ -435,7 +502,7 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
          "flash": use_flash,
          "flop_count": "dense-equivalent" if use_flash else "xla",
          "last_loss": last_loss,
-         **_tel_fields(tel)})
+         **_tel_fields(tel), **mem})
 
 
 def bench_lstm(batch_size: int, steps: int, warmup: int,
@@ -484,6 +551,7 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
                                               warmup, scope=scope)
+        mem = _mem_fields(exe, main, feed, model["loss"])
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
@@ -493,7 +561,7 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
          "pallas_rnn": pallas_rnn, "rnn_unroll": rnn_unroll,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel)})
+         **_tel_fields(tel), **mem})
 
 
 def bench_deepfm(batch_size: int, steps: int, warmup: int):
@@ -521,6 +589,7 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
         elapsed, last_loss, tel = _timed_loop(exe, main_p, feed,
                                               model["loss"], steps,
                                               warmup, scope=scope)
+        mem = _mem_fields(exe, main_p, feed, model["loss"])
     _, kind = _peak_flops()
     bytes_acc = float(cost.get("bytes accessed", 0.0))
     # v5e HBM ~819 GB/s: what fraction of the bandwidth roofline the
@@ -535,7 +604,7 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
         "step_bytes_accessed": bytes_acc,
         "hbm_roofline_frac": round(hbm_frac, 4),
         "last_loss": last_loss,
-        **_tel_fields(tel),
+        **_tel_fields(tel), **mem,
     }
 
 
@@ -622,7 +691,8 @@ def bench_serving(batch_size: int, iters: int = 50):
            "compute_ms": round(fp["compute_ms"], 3),
            "imgs_per_sec": round(batch_size / (fp["compute_ms"] / 1e3),
                                  1),
-           "batch_size": batch_size, "device": kind}
+           "batch_size": batch_size, "device": kind,
+           **_predictor_mem(predictor)}
     if results.get("int8", {}).get("error"):
         out["int8"] = results["int8"]
     elif "int8" in results:
@@ -747,6 +817,7 @@ def bench_serving_engine(batch_size: int, n_requests: int = 0,
         "warmup": snap.get("warmup"),
         "batch_size": batch_size, "n_requests": n_requests,
         "n_clients": n_clients, "device": kind,
+        **_predictor_mem(engine.predictor),
     }
 
 
@@ -937,7 +1008,10 @@ def main():
             "detail": {"probe_hazard": probe_tags},
             "compile_s": 0.0,
             "retraces": 0,
-            "peak_mem_bytes": None,
+            # non-None only if something already brought the backend
+            # up in-process — never attaches a client just to read it
+            "peak_mem_bytes": _peak_mem_if_backend_up(),
+            "mem_breakdown": None,
             "run_id": run_id,
             "git_sha": run_sha,
         }))
@@ -966,7 +1040,12 @@ def main():
                 "detail": {"backend_probe": {"error": err}},
                 "compile_s": 0.0,
                 "retraces": 0,
-                "peak_mem_bytes": None,
+                # the probe runs in a SUBPROCESS; if THIS process had
+                # already touched devices (an OOM-shaped death path),
+                # its high-water mark distinguishes OOM from dead-at-
+                # first-contact — else stays None without attaching
+                "peak_mem_bytes": _peak_mem_if_backend_up(),
+                "mem_breakdown": None,
                 "run_id": run_id,
                 "git_sha": run_sha,
             }
@@ -1227,6 +1306,18 @@ def main():
     result["compile_s"] = round(run_delta["compile_time_s"], 3)
     result["retraces"] = run_delta["retraces"]
     result["peak_mem_bytes"] = _obs_monitoring.peak_memory_bytes()
+    # top-line mem_breakdown = the single hungriest entry's buffer
+    # accounting (the binding constraint for "does this run fit"),
+    # tagged with which model it came from; every line carries the key
+    # (perf_gate --schema enforces it), None when nothing measured one
+    hungriest = None
+    for name, v in detail.items():
+        mb = v.get("mem_breakdown") if isinstance(v, dict) else None
+        if isinstance(mb, dict) and mb.get("peak_bytes"):
+            if hungriest is None \
+                    or mb["peak_bytes"] > hungriest["peak_bytes"]:
+                hungriest = dict(mb, model=name)
+    result["mem_breakdown"] = hungriest
     result["run_id"] = run_id
     result["git_sha"] = run_sha
     if probe_tags:

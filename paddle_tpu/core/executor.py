@@ -805,15 +805,23 @@ class Executor:
         self._aot_cache.clear()
 
     def compiled_step(self, program: Program, feed=None, fetch_list=None,
-                      scope: Optional[Scope] = None):
+                      scope: Optional[Scope] = None,
+                      with_names: bool = False):
         """AOT-compile the one-iteration step and return the jax
         Compiled object (cost_analysis(), as_text(), the optimized HLO
-        module via observe.cost.compiled_hlo_proto).  One extra XLA
-        compile beyond run()'s own jit cache (the jit-internal
-        executable is not introspectable); the traced step fn itself is
-        shared via the program cache, and the Compiled is memoized per
-        (program, feed-signature) so cost_analysis + observe.cost on
-        the same step compile once."""
+        module via observe.cost.compiled_hlo_proto, memory_analysis via
+        observe.memory).  One extra XLA compile beyond run()'s own jit
+        cache (the jit-internal executable is not introspectable); the
+        traced step fn itself is shared via the program cache, and the
+        Compiled is memoized per (program, feed-signature) so
+        cost_analysis + observe.cost/.memory on the same step compile
+        once.
+
+        with_names=True returns (compiled, arg_names): one
+        ("state"|"feed", var_name) label per flattened step argument in
+        jax's pytree leaf order — the HLO entry parameter order —
+        which is how observe.memory attributes entry-parameter buffers
+        to named state vars (params vs optimizer accumulators)."""
         feed = dict(feed or {})
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
@@ -824,11 +832,14 @@ class Executor:
                tuple((n, tuple(getattr(v, "shape", ()) or ()),
                       str(getattr(v, "dtype", type(v).__name__)))
                      for n, v in sorted(feed_arrays.items())))
-        compiled = self._aot_cache.get(key)
-        if compiled is None:
+        entry = self._aot_cache.get(key)
+        if entry is None:
+            from ..observe.memory import _arg_labels
+
             compiled = fn.lower(state, feed_arrays).compile()
-            self._aot_cache[key] = compiled
-        return compiled
+            entry = (compiled, _arg_labels(state, feed_arrays))
+            self._aot_cache[key] = entry
+        return entry if with_names else entry[0]
 
     def cost_analysis(self, program: Program, feed=None, fetch_list=None,
                       scope: Optional[Scope] = None):

@@ -25,6 +25,17 @@ Three pillars (docs/OBSERVE.md):
    registry at custom calls, and joining to fluid ops + measured
    device time (`op_cost_table`); tools/roofline.py and bench.py's
    Pallas MFU numerators are built on it.
+
+5. MEMORY — `memory.py` parses the optimized module's buffer
+   assignment (compiled.memory_analysis()), attributing every HBM
+   buffer to its fluid op and classifying it (params / optimizer_state
+   / gradients / activations / workspace, donated tallied):
+   `memory_report`/`memory_table` + `format_memory_table`, the
+   `memory_timeline` live-bytes curve (chrome-trace exportable), and
+   `plan_fit` — peak-HBM prediction for a candidate (batch, seq,
+   dtype, remat) config from two small probe compiles, without ever
+   compiling the candidate.  serving.ServingEngine validates its
+   bucket ladder with it; bench.py entries carry `mem_breakdown`.
 """
 
 from . import cost  # noqa: F401
@@ -32,6 +43,10 @@ from .cost import (bucket_summary, device_peaks,  # noqa: F401
                    format_cost_table, op_cost_table, program_costs)
 from .events import (RESILIENCE_EVENTS, SERVING_EVENTS,  # noqa: F401
                      RunEventLog, git_sha, new_run_id, read_events)
+from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401
+                     device_memory_budget, export_chrome_trace,
+                     format_memory_table, memory_report, memory_table,
+                     memory_timeline, plan_fit, step_mem_breakdown)
 from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
                       enable_telemetry, fetch_telemetry, init_telemetry,
                       telemetry_enabled)

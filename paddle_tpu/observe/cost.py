@@ -730,7 +730,10 @@ def program_costs(program, feed=None, fetch_list=None, scope=None,
                   exe=None) -> Dict[str, Any]:
     """Compile a fluid program's one-iteration step (AOT, shared with
     Executor.cost_analysis) and return `total_costs` of the optimized
-    module plus XLA's own aggregate flops for cross-checking."""
+    module plus XLA's own aggregate flops for cross-checking and the
+    step's peak device memory (`peak_hbm_bytes`, the buffer-assignment
+    allocation total from the same compile; None when the backend
+    exposes no memory analysis)."""
     from ..core.executor import Executor
 
     exe = exe or Executor()
@@ -739,6 +742,9 @@ def program_costs(program, feed=None, fetch_list=None, scope=None,
     proto = compiled_hlo_proto(compiled)
     out = total_costs(proto)
     out["xla_aggregate_flops"] = compiled_xla_flops(compiled)
+    from .memory import compiled_peak_bytes
+
+    out["peak_hbm_bytes"] = compiled_peak_bytes(compiled)
     return out
 
 

@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Optional
 # dashboard tailing an event log can filter on them)
 SERVING_EVENTS = (
     "serving_start",                # engine config at start()
+    "serving_memory_plan",          # pre-warmup bucket-ladder fit plan
+    #                                 (observe.memory probe prediction)
     "serving_warmup",               # bucket-ladder precompile summary
     "serving_window",               # periodic stats snapshot
     "serving_compile_post_warmup",  # LOUD: a shape leaked past buckets

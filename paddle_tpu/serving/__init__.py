@@ -28,6 +28,6 @@ from .admission import (AdmissionController,  # noqa: F401
                         QueueFullError, ServingClosedError,
                         ServingError)
 from .batcher import DynamicBatcher, Request  # noqa: F401
-from .engine import (BucketConfig, BucketMissError,  # noqa: F401
-                     ServingEngine)
+from .engine import (BucketConfig, BucketMemoryError,  # noqa: F401
+                     BucketMissError, ServingEngine)
 from .stats import ServingStats  # noqa: F401

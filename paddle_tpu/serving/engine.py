@@ -57,6 +57,16 @@ class BucketMissError(ServingError):
     kind = "bucket_miss"
 
 
+class BucketMemoryError(ServingError):
+    """A configured bucket's PREDICTED peak memory exceeds the device
+    budget — raised by start() BEFORE the ladder is AOT-compiled, from
+    the observe.memory fit planner's small-batch probes (structured:
+    carries the offending buckets with predicted bytes, the budget,
+    and the probe evidence)."""
+
+    kind = "bucket_memory"
+
+
 class BucketConfig:
     """The bounded shape ladder the engine is allowed to compile.
 
@@ -138,6 +148,16 @@ class ServingEngine:
     warmup_deadline_s: wall-clock budget for the start() bucket-ladder
         warmup (resilience.Deadline): a hung XLA compile raises a
         structured WatchdogTimeout instead of stalling the rollout.
+    memory_budget_bytes: device HBM budget the bucket ladder must fit.
+        None (default) reads the live device budget
+        (observe.memory.device_memory_budget(); None on backends that
+        report none, e.g. the CPU test mesh — validation is then
+        skipped).  When a budget is known, start() PREDICTS each bucket's
+        peak memory from two small probe compiles (batch 1 and 2 at
+        each seq bucket) and raises a structured BucketMemoryError for
+        impossible buckets BEFORE AOT-compiling the ladder — a
+        16-bucket warmup never burns 15 compiles to discover the 16th
+        OOMs.  Pass False to disable validation entirely.
     """
 
     def __init__(self, model: Union[str, AnalysisConfig, Predictor],
@@ -150,7 +170,8 @@ class ServingEngine:
                  stats_window: int = 256,
                  donate_feeds: Optional[bool] = None,
                  breaker: Union[CircuitBreaker, bool, None] = None,
-                 warmup_deadline_s: Optional[float] = None):
+                 warmup_deadline_s: Optional[float] = None,
+                 memory_budget_bytes: Union[int, bool, None] = None):
         # duck-typed: anything with run()/compile_signature() serves
         # (a resilience.FlakyPredictor proxy in chaos tests, a custom
         # wrapper in production)
@@ -212,6 +233,8 @@ class ServingEngine:
         elif breaker is False:
             breaker = None
         self.warmup_deadline_s = warmup_deadline_s
+        self.memory_budget_bytes = memory_budget_bytes
+        self.fit_plan: Optional[Dict[str, Any]] = None
         self.admission = AdmissionController(
             queue_capacity, default_deadline_ms=default_deadline_ms,
             breaker=breaker)
@@ -250,6 +273,9 @@ class ServingEngine:
 
         with Deadline(self.warmup_deadline_s or 0,
                       what="serving warmup (bucket-ladder compile)"):
+            # reject impossible buckets BEFORE burning a ladder of
+            # compiles on them (BucketMemoryError, structured)
+            self._validate_memory_budget()
             for spec in self._bucket_specs():
                 self.predictor.compile_signature(
                     spec, donate_feeds=self._donate)
@@ -386,22 +412,112 @@ class ServingEngine:
             out[n] = v
         return out, max_len
 
-    def _bucket_specs(self):
-        """ShapeDtypeStruct feed specs for every ladder combination."""
+    def _spec_for(self, bs: int, sl: Optional[int]):
+        """ShapeDtypeStruct feed spec of one (batch, seq) bucket."""
         import jax
 
+        spec: Dict[str, jax.ShapeDtypeStruct] = {}
+        for n, tpl in self._templates.items():
+            if n in self._ragged:
+                shape = (bs, sl) + tpl.shape[1:]
+                spec[f"{n}.seq_len"] = jax.ShapeDtypeStruct(
+                    (bs,), np.int32)
+            else:
+                shape = (bs,) + tpl.shape
+            spec[n] = jax.ShapeDtypeStruct(shape, tpl.dtype)
+        return spec
+
+    def _bucket_specs(self):
+        """ShapeDtypeStruct feed specs for every ladder combination."""
         for bs in self.buckets.batch_sizes:
             for sl in (self.buckets.seq_lens or (None,)):
-                spec: Dict[str, jax.ShapeDtypeStruct] = {}
-                for n, tpl in self._templates.items():
-                    if n in self._ragged:
-                        shape = (bs, sl) + tpl.shape[1:]
-                        spec[f"{n}.seq_len"] = jax.ShapeDtypeStruct(
-                            (bs,), np.int32)
-                    else:
-                        shape = (bs,) + tpl.shape
-                    spec[n] = jax.ShapeDtypeStruct(shape, tpl.dtype)
-                yield spec
+                yield self._spec_for(bs, sl)
+
+    def _validate_memory_budget(self):
+        """Predict every bucket's peak memory BEFORE the ladder warmup
+        and raise a structured BucketMemoryError for impossible buckets.
+
+        Inference peak is affine in batch at a fixed seq bucket (params
+        are constant, per-example activations scale), so two small
+        probe compiles per seq bucket (the observe.memory plan_fit
+        technique) predict the whole batch ladder — a 16-bucket warmup
+        never burns 15 compiles to discover the 16th OOMs.  Probe
+        executables land in the predictor's signature cache, so ladder
+        buckets at the probe sizes are not compiled twice.  Records the
+        full prediction table in `self.fit_plan`; skips silently (plan
+        tagged) when no budget is known or the backend exposes no
+        memory analysis."""
+        budget = self.memory_budget_bytes
+        if budget is False:
+            return
+        if budget is None or budget is True:
+            from ..observe.memory import device_memory_budget
+
+            budget = device_memory_budget()
+        if not budget:
+            self.fit_plan = {"skipped": "no device budget known",
+                             "budget_bytes": None}
+            return
+        from ..observe.memory import (PLAN_FIT_REL_TOL,
+                                      compiled_peak_bytes)
+
+        probe_bs = tuple(b for b in (1, 2)
+                         if b <= self.buckets.batch_sizes[-1]) or (1,)
+        buckets_plan: List[Dict[str, Any]] = []
+        bad: List[Dict[str, Any]] = []
+        for sl in (self.buckets.seq_lens or (None,)):
+            peaks = []
+            for b in probe_bs:
+                compiled = self.predictor.compile_signature(
+                    self._spec_for(b, sl), donate_feeds=self._donate)
+                peak = compiled_peak_bytes(compiled)
+                if peak is None:
+                    self.fit_plan = {
+                        "skipped": "backend exposes no memory analysis",
+                        "budget_bytes": int(budget)}
+                    return
+                peaks.append(int(peak))
+            if len(peaks) == 2:
+                slope = (peaks[1] - peaks[0]) / float(
+                    probe_bs[1] - probe_bs[0])
+                intercept = peaks[0] - slope * probe_bs[0]
+            else:
+                slope, intercept = 0.0, float(peaks[0])
+            for bs in self.buckets.batch_sizes:
+                if bs in probe_bs:
+                    pred, exact = peaks[probe_bs.index(bs)], True
+                else:
+                    pred = int(round(intercept + slope * bs))
+                    exact = False
+                row = {"batch_size": bs, "seq_len": sl,
+                       "predicted_peak_bytes": pred, "exact": exact,
+                       "fits": pred <= budget}
+                buckets_plan.append(row)
+                if not row["fits"]:
+                    bad.append(row)
+        self.fit_plan = {
+            "budget_bytes": int(budget),
+            "probe_batches": list(probe_bs),
+            "rel_tol": PLAN_FIT_REL_TOL,
+            "buckets": buckets_plan,
+        }
+        if self._event_log is not None:
+            self._event_log.event("serving_memory_plan", **self.fit_plan)
+        if bad:
+            raise BucketMemoryError(
+                f"{len(bad)}/{len(buckets_plan)} configured buckets "
+                f"predicted to exceed the device memory budget "
+                f"({budget / 1e9:.2f} GB): "
+                + ", ".join(f"bs{r['batch_size']}"
+                            + (f"/seq{r['seq_len']}"
+                               if r['seq_len'] else "")
+                            + f"≈{r['predicted_peak_bytes'] / 1e9:.2f}GB"
+                            for r in bad[:4])
+                + (" ..." if len(bad) > 4 else ""),
+                budget_bytes=int(budget),
+                offending_buckets=bad,
+                probe_batches=list(probe_bs),
+                plan=buckets_plan)
 
     def _dispatch(self, requests: Sequence[Request]):
         """Batcher callback: pad to the smallest fitting bucket,

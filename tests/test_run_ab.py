@@ -83,3 +83,44 @@ def test_wins_compares_wall_clock(run_ab):
     assert s["fused_ce_wins"] is False
     # pairs with no data at all stay None, never False/True
     assert s["nhwc_wins"] is None
+
+
+def _ok_mem(tok, peak):
+    return {"metric": "transformer_train_mfu", "value": 0.33,
+            "detail": {"transformer": {
+                "mfu": 0.33, "tokens_per_sec": tok,
+                "mem_breakdown": {"peak_bytes": peak,
+                                  "source": "buffer_assignment"}}}}
+
+
+def test_summary_reports_memory_delta_throughput_still_decides(run_ab):
+    # ISSUE 6: the memory delta rides the summary as CONTEXT; the
+    # throughput verdict is unchanged.  The live case this documents is
+    # the longctx remat A/B — remat lost throughput while saving
+    # memory, and both sides of that trade must be in the artifact.
+    r = {"transformer_base": _ok_mem(157129.5, 10_000_000_000),
+         "transformer_fused_ce": _ok_mem(153963.5, 8_000_000_000)}
+    s = run_ab.compute_summary(r)
+    assert s["fused_ce_wins"] is False  # slower, loses despite less mem
+    assert s["fused_ce_mem_delta_bytes"] == -2_000_000_000
+    assert s["fused_ce_mem_peaks"]["transformer_fused_ce"] \
+        == 8_000_000_000
+
+
+def test_mem_measure_no_data_discipline(run_ab):
+    # a failed variant must contribute None, never a fake memory win;
+    # an entry without mem_breakdown falls back to the line's host-side
+    # peak_mem_bytes, else None — and the summary then omits the keys
+    r = {"transformer_base": {"metric": "bench_failed", "value": 0.0,
+                              "detail": {}},
+         "transformer_fused_ce": _ok_mem(150000.0, 8_000_000_000)}
+    assert run_ab.mem_measure(r, "transformer_base") is None
+    assert run_ab.mem_measure(r, "transformer_fused_ce") \
+        == 8_000_000_000
+    s = run_ab.compute_summary(r)
+    assert "fused_ce_mem_delta_bytes" not in s
+    legacy = {"metric": "m", "value": 0.3, "peak_mem_bytes": 123,
+              "detail": {"transformer": {"mfu": 0.3,
+                                         "tokens_per_sec": 1.0}}}
+    assert run_ab.mem_measure({"transformer_base": legacy},
+                              "transformer_base") == 123

@@ -47,9 +47,12 @@ _THROUGHPUT_KEYS = ("tokens_per_sec", "imgs_per_sec",
 # serving latency: lower is better
 _LATENCY_KEYS = ("compute_ms",)
 
+# every bench line (success AND failure) must carry mem_breakdown —
+# None on failure lines, the per-bucket byte dict (observe.memory) on
+# measured ones; presence is the schema contract
 _SCHEMA_FIELDS = ("metric", "value", "unit", "vs_baseline", "detail",
-                  "compile_s", "retraces", "peak_mem_bytes", "run_id",
-                  "git_sha")
+                  "compile_s", "retraces", "peak_mem_bytes",
+                  "mem_breakdown", "run_id", "git_sha")
 
 
 def _salvage_detail(tail: str):
@@ -144,7 +147,7 @@ def check_schema(candidate):
 
 
 def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
-                   regressions, report):
+                   regressions, report, tol_mem=0.10):
     if "error" in cand and "error" not in base:
         regressions.append(f"{name}: candidate errored: "
                            f"{cand['error']}")
@@ -179,10 +182,26 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
             report.append(line)
             if rise > tol_lat:
                 regressions.append(line + f" exceeds tol {tol_lat:.0%}")
+    # peak memory: higher is worse (closer to OOM at the same shape).
+    # Compared only when BOTH sides measured a buffer-assignment peak —
+    # pre-r06 baselines carry no mem_breakdown and are skipped, and
+    # the estimate-quality "module-shapes" fallback never gates against
+    # a real buffer_assignment number (different accounting)
+    bmb, cmb = base.get("mem_breakdown"), cand.get("mem_breakdown")
+    if isinstance(bmb, dict) and isinstance(cmb, dict) \
+            and bmb.get("peak_bytes") and cmb.get("peak_bytes") \
+            and bmb.get("source") == cmb.get("source"):
+        rise = (cmb["peak_bytes"] - bmb["peak_bytes"]) \
+            / bmb["peak_bytes"]
+        line = (f"{name}.peak_hbm: {bmb['peak_bytes'] / 1e6:.1f}MB -> "
+                f"{cmb['peak_bytes'] / 1e6:.1f}MB ({rise:+.2%})")
+        report.append(line)
+        if rise > tol_mem:
+            regressions.append(line + f" exceeds tol {tol_mem:.0%}")
 
 
 def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
-         allow_missing=False):
+         tol_mem=0.10, allow_missing=False):
     """(regressions, report_lines, compared_count).  Only entries whose
     device kind matches are compared — a CPU smoke candidate never
     false-fails against chip numbers."""
@@ -208,7 +227,7 @@ def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
             continue
         compared += 1
         _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
-                       regressions, report)
+                       regressions, report, tol_mem=tol_mem)
         if "int8" in base and isinstance(cand.get("int8"), dict) \
                 and "error" not in base["int8"]:
             if "error" in cand["int8"]:
@@ -238,6 +257,11 @@ def main() -> int:
                         "(default 7%% — bench noise at 60 steps)")
     p.add_argument("--tol-latency", type=float, default=0.10,
                    help="tolerated relative serving-latency increase")
+    p.add_argument("--tol-peak-mem", type=float, default=0.10,
+                   help="tolerated relative peak-HBM increase per "
+                        "entry (mem_breakdown.peak_bytes; a step "
+                        "quietly growing toward OOM is a regression "
+                        "even when throughput holds)")
     p.add_argument("--allow-missing", action="store_true",
                    help="baseline entries absent from the candidate "
                         "are not regressions (partial --model runs)")
@@ -287,7 +311,7 @@ def main() -> int:
     regressions, report, compared = gate(
         baseline, candidate, tol_mfu=args.tol_mfu,
         tol_tp=args.tol_throughput, tol_lat=args.tol_latency,
-        allow_missing=args.allow_missing)
+        tol_mem=args.tol_peak_mem, allow_missing=args.allow_missing)
     for line in report:
         print("  " + line)
     if compared == 0:

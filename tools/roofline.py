@@ -62,6 +62,10 @@ def _roofline(totals, peak, bw):
     return {
         "flops": flops,
         "bytes": nbytes,
+        # predicted peak HBM of this config's step (buffer-assignment
+        # allocation total, observe.memory) — the "shape-limited"
+        # verdicts now carry their memory evidence in the same row
+        "peak_hbm_bytes": totals.get("peak_hbm_bytes"),
         "bytes_model": "materialized-buffers",
         "xla_aggregate_flops": totals.get("xla_aggregate_flops"),
         "pallas_registry_flops": totals.get("pallas_flops", 0.0),
@@ -242,11 +246,17 @@ def main():
     if bw is None:
         bw = 819e9  # CPU smoke: assume v5e HBM, recorded via `device`
 
+    from paddle_tpu.observe.memory import device_memory_budget
+
     results = {"device": kind, "peak_flops": peak, "hbm_bw": bw,
+               # None on backends reporting no budget (CPU smoke) —
+               # per-row peak_hbm_bytes is then structure evidence
+               # only, not a fit verdict (docs/OBSERVE.md caveat)
+               "hbm_budget_bytes": device_memory_budget(),
                "methodology": "observe.cost analytic "
                               "(materialized-buffers bytes, registry "
-                              "Pallas flops); supersedes "
-                              "ROOFLINE_r05.json"}
+                              "Pallas flops, buffer-assignment peak "
+                              "HBM); supersedes ROOFLINE_r05.json"}
     if args.model in ("all", "resnet50"):
         totals = _resnet_costs(args.batch or 128, args.layout)
         results[f"resnet50_{args.layout.lower()}_bs"

@@ -183,32 +183,71 @@ def measure(results, k):
     return None
 
 
+def mem_measure(results, k):
+    """Peak device bytes for variant k, or None for NO DATA.
+
+    Prefers the expected model entry's `mem_breakdown.peak_bytes`
+    (buffer-assignment analysis of the measured step, observe.memory)
+    and falls back to the line's host-side `peak_mem_bytes` high-water
+    mark.  Same no-data discipline as measure(): a failed variant
+    contributes None, never a 0 that fakes a memory win."""
+    d = results.get(k, {})
+    if "error" in d or "failed" in d or \
+            d.get("metric") == "bench_failed":
+        return None
+    detail = d.get("detail") or {}
+    model = _VARIANT_MODEL.get(k)
+    subs = (_model_entries(detail, model) if model is not None
+            else [sub for sub in detail.values() if isinstance(sub, dict)])
+    for sub in subs:
+        mb = sub.get("mem_breakdown")
+        if isinstance(mb, dict) and mb.get("peak_bytes"):
+            return int(mb["peak_bytes"])
+    return d.get("peak_mem_bytes") or None
+
+
 def wins(results, a, b):
     # a missing side must yield "no data", never a vacuous win —
-    # AB wins gate bench defaults (CLAUDE.md measured-wins-only)
+    # AB wins gate bench defaults (CLAUDE.md measured-wins-only).
+    # THROUGHPUT decides (the r05 MFU-numerator lesson); the memory
+    # delta rides the summary via mem_measure for context only.
     ma, mb = measure(results, a), measure(results, b)
     if ma is None or mb is None:
         return None
     return ma > mb
 
 
+# summary pairs: "<name>_wins" (throughput verdict) + the peak-memory
+# context keys.  longctx_recompute documents the r05 remat decision in
+# BYTES as well as MFU: remat won memory and lost throughput — both
+# sides of that trade now live in the artifact.
+_PAIRS = {
+    "nhwc": ("resnet50_nhwc", "resnet50_nchw"),
+    "fused_ce": ("transformer_fused_ce", "transformer_base"),
+    "fused_qkv": ("transformer_fused_qkv", "transformer_base"),
+    "pallas_attn": ("transformer_pallas_attn", "transformer_base"),
+    "longctx_pallas": ("longctx_8k_pallas", "longctx_8k_xla"),
+    "longctx_recompute": ("longctx_8k_recompute", "longctx_8k_pallas"),
+    "lstm_unroll2": ("lstm_unroll2", "lstm_base"),
+    "lstm_unroll4": ("lstm_unroll4", "lstm_base"),
+    "lstm_unroll8": ("lstm_unroll8", "lstm_base"),
+    "lstm_pallas_rnn": ("lstm_pallas_rnn", "lstm_base"),
+}
+
+
 def compute_summary(results):
-    return {
-        "nhwc_wins": wins(results, "resnet50_nhwc", "resnet50_nchw"),
-        "fused_ce_wins": wins(results, "transformer_fused_ce",
-                              "transformer_base"),
-        "fused_qkv_wins": wins(results, "transformer_fused_qkv",
-                               "transformer_base"),
-        "pallas_attn_wins": wins(results, "transformer_pallas_attn",
-                                 "transformer_base"),
-        "longctx_pallas_wins": wins(results, "longctx_8k_pallas",
-                                    "longctx_8k_xla"),
-        "lstm_unroll2_wins": wins(results, "lstm_unroll2", "lstm_base"),
-        "lstm_unroll4_wins": wins(results, "lstm_unroll4", "lstm_base"),
-        "lstm_unroll8_wins": wins(results, "lstm_unroll8", "lstm_base"),
-        "lstm_pallas_rnn_wins": wins(results, "lstm_pallas_rnn",
-                                     "lstm_base"),
-    }
+    out = {}
+    for name, (a, b) in _PAIRS.items():
+        out[f"{name}_wins"] = wins(results, a, b)
+        pa, pb = mem_measure(results, a), mem_measure(results, b)
+        if pa is not None and pb is not None:
+            # positive = variant a needs MORE memory than b; the
+            # throughput verdict above still decides defaults, but a
+            # loss bought with a big memory saving (remat) or a win
+            # paid for in HBM is now visible in the same artifact
+            out[f"{name}_mem_delta_bytes"] = pa - pb
+            out[f"{name}_mem_peaks"] = {a: pa, b: pb}
+    return out
 
 
 def main():

@@ -32,10 +32,82 @@ lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
 assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
 out = json.loads(lines[-1])
 assert out["compile_s"] > 0, out.get("compile_s")
+# ISSUE 6: every line carries mem_breakdown; a measured entry's is the
+# per-bucket byte dict from the buffer assignment
+mb = out["mem_breakdown"]
+assert isinstance(mb, dict) and mb.get("peak_bytes", 0) > 0, mb
+assert out["detail"]["deepfm"]["mem_breakdown"]["params"] > 0, \
+    out["detail"]["deepfm"].get("mem_breakdown")
 with open("/tmp/bench_ci_line.json", "w") as f:
     f.write(lines[-1])
 print("telemetry smoke OK:",
-      {k: out.get(k) for k in ("compile_s", "retraces", "peak_mem_bytes")})
+      {k: out.get(k) for k in ("compile_s", "retraces", "peak_mem_bytes")},
+      {k: mb.get(k) for k in ("model", "params", "peak_bytes", "source")})
+EOF
+
+echo "== memory observability smoke (cpu) =="
+# ISSUE 6 tentpole: the fit planner's probe-extrapolated peak must land
+# within its recorded tolerance (PLAN_FIT_REL_TOL) of the real
+# buffer-assignment measurement on this backend, and the serving
+# bucket-ladder validation must reject an impossible bucket BEFORE
+# compiling the ladder (docs/OBSERVE.md memory pillar)
+python - <<'EOF'
+import tempfile
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.observe.memory import PLAN_FIT_REL_TOL, compiled_peak_bytes
+from paddle_tpu.serving import (BucketConfig, BucketMemoryError,
+                                ServingEngine)
+
+main, startup = fluid.Program(), fluid.Program()
+scope = fluid.Scope()
+with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+    x = layers.data(name="x", shape=[32], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(layers.fc(x, size=64, act="relu"), size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    cand = {"x": jax.ShapeDtypeStruct((64, 32), "float32"),
+            "y": jax.ShapeDtypeStruct((64, 1), "float32")}
+    plan = observe.plan_fit(main, cand, fetch_list=[loss], exe=exe)
+    comp = exe.compiled_step(
+        main, feed={"x": np.zeros((64, 32), "f4"),
+                    "y": np.zeros((64, 1), "f4")}, fetch_list=[loss])
+    actual = compiled_peak_bytes(comp)
+    assert actual, "backend exposed no memory analysis"
+    rel = abs(plan["predicted_peak_bytes"] - actual) / actual
+    assert rel <= PLAN_FIT_REL_TOL, \
+        f"plan_fit off by {rel:.1%} (> {PLAN_FIT_REL_TOL:.0%}): " \
+        f"{plan['predicted_peak_bytes']} vs {actual}"
+
+# impossible bucket -> structured rejection before the ladder compiles
+d = tempfile.mkdtemp()
+main2, startup2 = fluid.Program(), fluid.Program()
+scope2 = fluid.Scope()
+with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+    xi = layers.data("x", shape=[16], append_batch_size=True)
+    pi = layers.fc(layers.fc(xi, size=32, act="relu"), size=4)
+    exe2 = fluid.Executor(); exe2.run(startup2)
+    fluid.io.save_inference_model(d, ["x"], [pi], exe2,
+                                  main_program=main2)
+try:
+    ServingEngine(d, {"x": np.zeros(16, np.float32)},
+                  buckets=BucketConfig((1, 2, 4, 8)),
+                  memory_budget_bytes=4096).start()
+    raise AssertionError("impossible bucket was not rejected")
+except BucketMemoryError as e:
+    bad = e.as_dict()["offending_buckets"]
+    assert bad and bad[-1]["batch_size"] == 8, bad
+print("memory smoke OK:",
+      {"predicted": plan["predicted_peak_bytes"], "measured": actual,
+       "rel_err": round(rel, 4), "tol": PLAN_FIT_REL_TOL,
+       "ladder_rejected": [b["batch_size"] for b in bad]})
 EOF
 
 echo "== scan-bound rnn flags smoke (cpu) =="
