@@ -165,6 +165,43 @@ def _mem_fields(exe, program, feed, loss, scope=None):
         return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
 
 
+def _ckpt_fields(exe, program, scope=None):
+    """Async-checkpoint observability for one training entry (ISSUE 7
+    satellite): one full sharded save of the measured program's state
+    into a throwaway dir, split into its blocking (device→host
+    snapshot) and background (serialize+manifest) portions —
+    `ckpt_blocking_ms` is what a save at this scale would steal from
+    the step loop, `ckpt_write_ms` what the async writer hides.
+    Failures are recorded in-band; the measurement they would describe
+    is already taken."""
+    import shutil
+    import tempfile
+
+    try:
+        import contextlib
+
+        from paddle_tpu import io as fluid_io
+        from paddle_tpu.core.executor import scope_guard
+
+        d = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            cm = scope_guard(scope) if scope is not None \
+                else contextlib.nullcontext()
+            with cm:
+                job = fluid_io.save_sharded(exe, d,
+                                            main_program=program,
+                                            async_=True).result(120)
+            return {"ckpt_blocking_ms": round(job.snapshot_ms, 3),
+                    "ckpt_write_ms": round(job.write_ms or 0.0, 3),
+                    "ckpt_bytes": job.bytes_total}
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 — observability must not
+        #                     take down the measurement it describes
+        return {"ckpt_blocking_ms": None,
+                "ckpt_error": f"{type(e).__name__}: {e}"}
+
+
 def _predictor_mem(predictor):
     """`mem_breakdown` of a serving entry: buffer accounting of the
     predictor's largest compiled executable (no fluid program here, so
@@ -313,6 +350,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                 exe, main, feed, model["loss"], steps, warmup,
                 scope=scope)
             mem = _mem_fields(exe, main, feed, model["loss"])
+        ck = _ckpt_fields(exe, main, scope)
     imgs_per_sec = batch_size * steps / elapsed
     return _mfu_result(
         float(cost.get("flops", 0.0)), steps, elapsed,
@@ -320,7 +358,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
          "batch_size": batch_size, "amp": use_amp,
          "data_mode": data_mode, "data_format": data_format,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem,
+         **_tel_fields(tel), **mem, **ck,
          "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)})
 
 
@@ -446,6 +484,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                                               model["loss"], steps,
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main, feed, model["loss"])
+        ck = _ckpt_fields(exe, main, scope)
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_length * steps
@@ -457,7 +496,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "recompute": recompute,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem})
+         **_tel_fields(tel), **mem, **ck})
 
 
 def bench_bert(batch_size: int, steps: int, warmup: int,
@@ -494,6 +533,7 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
                                               model["loss"], steps,
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main, feed, model["loss"])
+        ck = _ckpt_fields(exe, main, scope)
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
@@ -502,7 +542,7 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
          "flash": use_flash,
          "flop_count": "dense-equivalent" if use_flash else "xla",
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem})
+         **_tel_fields(tel), **mem, **ck})
 
 
 def bench_lstm(batch_size: int, steps: int, warmup: int,
@@ -552,6 +592,7 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
                                               model["loss"], steps,
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main, feed, model["loss"])
+        ck = _ckpt_fields(exe, main, scope)
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
@@ -561,7 +602,7 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
          "pallas_rnn": pallas_rnn, "rnn_unroll": rnn_unroll,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem})
+         **_tel_fields(tel), **mem, **ck})
 
 
 def bench_deepfm(batch_size: int, steps: int, warmup: int):
@@ -590,6 +631,7 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
                                               model["loss"], steps,
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main_p, feed, model["loss"])
+        ck = _ckpt_fields(exe, main_p, scope)
     _, kind = _peak_flops()
     bytes_acc = float(cost.get("bytes accessed", 0.0))
     # v5e HBM ~819 GB/s: what fraction of the bandwidth roofline the
@@ -604,7 +646,7 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
         "step_bytes_accessed": bytes_acc,
         "hbm_roofline_frac": round(hbm_frac, 4),
         "last_loss": last_loss,
-        **_tel_fields(tel), **mem,
+        **_tel_fields(tel), **mem, **ck,
     }
 
 

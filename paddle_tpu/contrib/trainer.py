@@ -29,6 +29,16 @@ from ..core.executor import Executor, Scope, scope_guard
 from ..core.program import Program, default_main_program, program_guard
 
 
+# versioned schema of the `train_state` payload inside
+# __trainer_state__.json (docs/RESILIENCE.md, exact-resume section).
+# v1: rng_key, telemetry (loss-scale/guard counters), data_cursor,
+# unique_name_ids, optional reader_state.  A NEWER version on disk is
+# rejected loudly (CheckpointFormatError); older/absent payloads load
+# with whatever they carry (pre-v1 checkpoints resume params+cursor
+# only, as before).
+TRAIN_STATE_VERSION = 1
+
+
 class BeginEpochEvent:
     def __init__(self, epoch_id: int):
         self.epoch = epoch_id
@@ -54,15 +64,24 @@ class EndStepEvent:
 
 
 class CheckpointConfig:
-    """reference contrib/trainer.py CheckpointConfig:100."""
+    """reference contrib/trainer.py CheckpointConfig:100.
+
+    async_save: take only the device→host snapshot on the training
+    thread and run the serialization/manifest phase on a background
+    SnapshotWriter (resilience.preempt) — a save then stalls the step
+    loop for `snapshot_ms`, not the full write time.  Write failures
+    surface as structured CheckpointWriteErrors on the next save or at
+    train end, never silently (docs/RESILIENCE.md)."""
 
     def __init__(self, checkpoint_dir: Optional[str] = None,
                  max_num_checkpoints: int = 3,
-                 epoch_interval: int = 1, step_interval: int = 10):
+                 epoch_interval: int = 1, step_interval: int = 10,
+                 async_save: bool = False):
         self.checkpoint_dir = checkpoint_dir or "checkpoints"
         self.max_num_checkpoints = max(1, int(max_num_checkpoints))
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
+        self.async_save = bool(async_save)
 
 
 class Trainer:
@@ -82,7 +101,8 @@ class Trainer:
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  place=None, checkpoint_config: Optional[CheckpointConfig]
                  = None, scope: Optional[Scope] = None, telemetry=None,
-                 step_deadline_s: Optional[float] = None):
+                 step_deadline_s: Optional[float] = None,
+                 preempt_drain: bool = False):
         """telemetry: an observe.TelemetryConfig — enables the
         device-side StepTelemetry accumulator on the train program and
         publishes a window (telemetry means + compile/retrace/dispatch
@@ -93,10 +113,21 @@ class Trainer:
 
         step_deadline_s: wall-clock watchdog around each training step
         (resilience.Deadline) — a hung compile/dispatch raises a
-        structured WatchdogTimeout instead of stalling forever."""
+        structured WatchdogTimeout instead of stalling forever.
+
+        preempt_drain: install the SIGTERM/SIGINT drain handler at
+        train() start (resilience.preempt; main-thread-only, degrades
+        to a no-op elsewhere).  On a signal the in-flight step
+        finishes, any in-flight async save is awaited, an EMERGENCY
+        checkpoint is written, `preempt_drain`/`ckpt_emergency` events
+        are emitted, and train() raises TrainingPreempted carrying
+        PREEMPT_EXIT_CODE.  The drain-flag check itself always runs —
+        tests (and embedders with their own signal plumbing) can call
+        resilience.preempt.request_drain() directly."""
         self.checkpoint_cfg = checkpoint_config
         self.telemetry_cfg = telemetry
         self.step_deadline_s = step_deadline_s
+        self.preempt_drain = bool(preempt_drain)
         self.scope = scope or Scope()
         self.startup_program = Program()
         self.train_program = Program()
@@ -116,6 +147,18 @@ class Trainer:
                 self.train_outputs = [outs]
             optimizer = optimizer_func()
             optimizer.minimize(self.train_outputs[0])
+            # generated-name counters at the end of the build: saved in
+            # every checkpoint's train_state and compared at resume — a
+            # build whose counters drifted (e.g. run outside
+            # unique_name.guard()) would silently bind saved arrays to
+            # the wrong variables; the comparison makes it loud
+            self._uname_ids = dict(unique_name.generator.ids)
+        self._ckpt_writer = None       # lazy SnapshotWriter (async_save)
+        self._pending_save = None      # in-flight resilience.PendingSave
+        self._active_reader = None
+        self._resume_reader_state = None
+        self.ckpt_stats = {"saves": 0, "blocking_ms": 0.0,
+                           "write_ms": 0.0, "bytes": 0}
         self._event_log = None
         if self.telemetry_cfg is not None:
             from .. import observe
@@ -166,35 +209,196 @@ class Trainer:
               + " ".join(f"{k}={v}" for k, v in fields.items()),
               file=sys.stderr)
 
-    def _save_checkpoint(self, serial: int, epoch: int, step: int):
+    # -- full-state capture (bit-exact resume; docs/RESILIENCE.md) ------
+    def _capture_train_state(self, epoch: int, step: int) -> dict:
+        """Everything a bit-exact resume needs BEYOND the persistable
+        arrays: the RNG stream (dropout), the telemetry accumulator
+        (dynamic loss-scale value + good/bad counters, guard skip
+        counter), the data cursor, optional reader state, and the
+        generated-name counters of the build (drift detector)."""
+        from ..core.executor import RNG_STATE_VAR
+        from ..observe.metrics import TELEMETRY_VAR
+
+        st = {
+            "version": TRAIN_STATE_VERSION,
+            "data_cursor": {"epoch": epoch, "step_in_epoch": step},
+            "unique_name_ids": dict(self._uname_ids),
+        }
+        rng = self.scope.find_var(RNG_STATE_VAR)
+        if rng is not None:
+            arr = np.asarray(rng)
+            st["rng_key"] = {"dtype": str(arr.dtype),
+                             "data": arr.tolist()}
+        tel = self.scope.find_var(TELEMETRY_VAR)
+        if tel is not None:
+            st["telemetry"] = {k: np.asarray(v).item()
+                               for k, v in tel.items()}
+        reader = self._active_reader
+        if reader is not None and hasattr(reader, "state_dict"):
+            st["reader_state"] = reader.state_dict()
+        return st
+
+    def _validate_train_state(self, st: dict) -> None:
+        """Version + build-identity gate, BEFORE any array loads."""
+        from ..resilience.errors import (CheckpointFormatError,
+                                         CheckpointStateMismatchError)
+
+        version = int(st.get("version", 0))
+        if version > TRAIN_STATE_VERSION:
+            raise CheckpointFormatError(
+                f"checkpoint train_state version {version} is newer "
+                f"than this build reads (<= {TRAIN_STATE_VERSION})",
+                version=version, supported=TRAIN_STATE_VERSION)
+        saved = st.get("unique_name_ids")
+        if saved is not None and dict(saved) != dict(self._uname_ids):
+            drift = sorted(
+                k for k in set(saved) | set(self._uname_ids)
+                if saved.get(k) != self._uname_ids.get(k))
+            raise CheckpointStateMismatchError(
+                "generated-name counters of this build do not match "
+                "the checkpoint's — the training program was built "
+                "with different unique_name state (was it built "
+                "outside unique_name.guard()?).  Loading would bind "
+                f"saved arrays to the wrong variables.  Drifted keys: "
+                f"{drift[:8]}", drifted_keys=drift[:32],
+                saved_count=len(saved), built_count=len(self._uname_ids))
+
+    def _restore_train_state(self, st: dict) -> None:
+        """Write the captured non-array state back into the scope (the
+        arrays were already loaded)."""
+        import jax.numpy as jnp
+
+        from ..core.executor import RNG_STATE_VAR
+        from ..observe.metrics import TELEMETRY_VAR, init_telemetry
+
+        rng = st.get("rng_key")
+        if rng is not None:
+            self.scope.set_var(
+                RNG_STATE_VAR,
+                jnp.asarray(np.array(rng["data"],
+                                     dtype=np.dtype(rng["dtype"]))))
+        tel = st.get("telemetry")
+        if tel is not None:
+            fresh = init_telemetry()
+            for k, v in tel.items():
+                if k in fresh:  # dtype template: i32 vs f32 per field
+                    fresh[k] = np.asarray(fresh[k]).dtype.type(v)
+            self.scope.set_var(TELEMETRY_VAR, fresh)
+        self._resume_reader_state = st.get("reader_state")
+
+    # -- save ------------------------------------------------------------
+    def _save_checkpoint(self, serial: int, epoch: int, step: int,
+                         emergency: bool = False,
+                         force_sync: bool = False):
+        import time as _time
+
         root = self._ckpt_root()
         path = os.path.join(root, f"ckpt_{serial}")
+        t0 = _time.perf_counter()
+        use_async = (self.checkpoint_cfg.async_save and not force_sync)
+        if use_async:
+            # surface a PREVIOUS background write's failure before
+            # starting a new save (async errors are deferred, not lost)
+            self._writer().check()
+            # bounded queue: a save requested while one is in flight
+            # waits for it — two saves never interleave their files
+            self._await_pending(surface=True)
         if os.path.isdir(path) and not os.path.exists(
                 os.path.join(path, "__trainer_state__.json")):
             # leftover of a save that died mid-write (torn): clear it so
             # stale shard files cannot mix with the fresh save
             shutil.rmtree(path, ignore_errors=True)
         os.makedirs(path, exist_ok=True)
+        trainer_state = {"epoch": epoch, "step": step, "serial": serial,
+                         "train_state":
+                         self._capture_train_state(epoch, step)}
         with scope_guard(self.scope):
-            # sharded writer: each process persists only its own array
-            # shards (io.py save_sharded) — scales to mp/fsdp state that
+            # sharded snapshot: each process copies only its own array
+            # shards device→host (io.py) — scales to mp/fsdp state that
             # must never gather to one host
-            fluid_io.save_sharded(self.exe, path,
-                                  main_program=self.train_program)
-        with open(os.path.join(path, "__trainer_state__.json"), "w") as f:
-            json.dump({"epoch": epoch, "step": step, "serial": serial}, f)
+            job = fluid_io.prepare_sharded_save(
+                self.exe, path, main_program=self.train_program)
+
+        def _finalize():
+            # ordering: shards → manifest (io.py, written LAST there) →
+            # trainer state.  The trainer-state file marks the serial
+            # visible to _list_checkpoints, so a death anywhere earlier
+            # leaves a torn — never a half-resumable — directory.
+            tmp = os.path.join(path, "__trainer_state__.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(trainer_state, f)
+            os.replace(tmp,
+                       os.path.join(path, "__trainer_state__.json"))
+            self._rotate()
+            self.ckpt_stats["saves"] += 1
+            self.ckpt_stats["write_ms"] += job.write_ms or 0.0
+            self.ckpt_stats["bytes"] = job.bytes_total
+            self._emit("ckpt_save", serial=serial, epoch=epoch,
+                       step=step,
+                       snapshot_ms=round(job.snapshot_ms, 3),
+                       write_ms=round(job.write_ms or 0.0, 3),
+                       bytes=job.bytes_total, asynchronous=use_async,
+                       emergency=emergency)
+
+        if use_async:
+            self._pending_save = self._writer().submit(
+                job, finalize=_finalize)
+            # blocking cost = snapshot + any wait-for-previous, i.e.
+            # exactly the time the step loop lost to this save
+            self.ckpt_stats["blocking_ms"] += (
+                (_time.perf_counter() - t0) * 1000.0)
+        else:
+            job.write()
+            _finalize()
+            self.ckpt_stats["blocking_ms"] += (
+                (_time.perf_counter() - t0) * 1000.0)
+
+    def _writer(self):
+        if self._ckpt_writer is None:
+            from ..resilience.preempt import SnapshotWriter
+
+            self._ckpt_writer = SnapshotWriter()
+        return self._ckpt_writer
+
+    def _await_pending(self, surface: bool, timeout: float = 600.0):
+        """Wait out an in-flight async save.  surface=True re-raises a
+        write failure (the per-save contract); surface=False logs it
+        as a loud ckpt_async_error and continues — the drain path must
+        still write its emergency checkpoint after a failed save."""
+        pending, self._pending_save = self._pending_save, None
+        if pending is None and self._ckpt_writer is None:
+            return
+        from ..resilience.errors import CheckpointError
+
+        try:
+            if pending is not None:
+                pending.result(timeout)
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait_idle(timeout)
+        except (CheckpointError, TimeoutError) as e:
+            fields = (e.as_dict() if isinstance(e, CheckpointError)
+                      else {"error": "timeout", "message": str(e)})
+            self._emit("ckpt_async_error", error=fields)
+            if surface:
+                raise
+
+    def _rotate(self):
         # rotate (reference keeps max_num_checkpoints, deleting oldest)
+        root = self._ckpt_root()
         ids = self._list_checkpoints()
         while len(ids) > self.checkpoint_cfg.max_num_checkpoints:
             victim = os.path.join(root, f"ckpt_{ids.pop(0)}")
             shutil.rmtree(victim, ignore_errors=True)
 
     def _load_checkpoint(self, path: str) -> dict:
-        """Load one checkpoint dir (arrays + trainer cursor) or raise a
-        structured CheckpointError (resilience/errors.py)."""
-        from ..resilience.errors import (CheckpointCorruptError,
-                                         CheckpointNotFoundError)
-
+        """Load one checkpoint dir (trainer cursor + train_state +
+        arrays) or raise a structured CheckpointError
+        (resilience/errors.py).  The trainer state is read and
+        validated FIRST: a version/name-drift mismatch fails loudly
+        before any array touches the scope."""
+        st = self._read_trainer_state(path)
+        train_state = st.get("train_state") or {}
+        self._validate_train_state(train_state)
         with scope_guard(self.scope):
             if os.path.exists(os.path.join(path,
                                            fluid_io.SHARD_MANIFEST)):
@@ -210,6 +414,13 @@ class Trainer:
                 # checkpoint from the pre-sharded combined format
                 fluid_io.load_persistables(self.exe, path,
                                            main_program=self.train_program)
+        self._restore_train_state(train_state)
+        return st
+
+    def _read_trainer_state(self, path: str) -> dict:
+        from ..resilience.errors import (CheckpointCorruptError,
+                                         CheckpointNotFoundError)
+
         state_path = os.path.join(path, "__trainer_state__.json")
         try:
             with open(state_path) as f:
@@ -228,13 +439,19 @@ class Trainer:
         newest-first, and a torn/corrupt/incomplete one is skipped with
         a loud `ckpt_fallback` record — never a raw numpy/JSON error,
         never a silent fresh start when an older valid serial exists."""
-        from ..resilience.errors import CheckpointError
+        from ..resilience.errors import (CheckpointError,
+                                         CheckpointStateMismatchError)
 
         ids = self._list_checkpoints()
         for serial in reversed(ids):
             path = os.path.join(self._ckpt_root(), f"ckpt_{serial}")
             try:
                 st = self._load_checkpoint(path)
+            except CheckpointStateMismatchError:
+                # NOT a fallback case: every serial was written by the
+                # same (drifted-relative-to-us) build — walking to an
+                # older one would mis-bind identically.  Fail loudly.
+                raise
             except CheckpointError as e:
                 self._emit("ckpt_fallback", serial=serial,
                            error=e.as_dict())
@@ -255,8 +472,20 @@ class Trainer:
               = None, reader: Optional[Callable] = None,
               feed_order: Optional[Sequence[str]] = None):
         """reader: callable -> iterable of feed dicts (or tuples aligned
-        with feed_order)."""
+        with feed_order).  Bit-exact resume additionally requires the
+        reader to be DETERMINISTIC (same stream every run — e.g.
+        data.decorator.shuffle(seed=...)); a reader exposing
+        state_dict()/load_state_dict() gets its state checkpointed and
+        restored too."""
+        from ..resilience import preempt
+
         handler = event_handler or (lambda e: None)
+        if self.preempt_drain:
+            preempt.install_preempt_handler()
+        self._active_reader = reader
+        if (self._resume_reader_state is not None and reader is not None
+                and hasattr(reader, "load_state_dict")):
+            reader.load_state_dict(self._resume_reader_state)
         serial = ((self._list_checkpoints() or [-1])[-1] + 1
                   if self.checkpoint_cfg else 0)
         fetch = [o.name for o in self.train_outputs]
@@ -315,6 +544,10 @@ class Trainer:
                         self._event_log.event("checkpoint",
                                               serial=serial - 1,
                                               epoch=epoch, step=step)
+                if preempt.drain_requested():
+                    # the in-flight step already finished (we are at a
+                    # step boundary); checkpoint and get out
+                    self._drain(serial, epoch, step)
             if skip > 0:
                 raise RuntimeError(
                     f"resume cursor expected at least {skip} more batches "
@@ -326,12 +559,57 @@ class Trainer:
                 self._save_checkpoint(serial, epoch + 1, 0)
                 serial += 1
             handler(EndEpochEvent(epoch))
+            if preempt.drain_requested():
+                self._drain(serial, epoch + 1, 0)
+        # a background write still in flight must land (and a failed
+        # one must surface) before train() returns green
+        self._await_pending(surface=True)
         if self.telemetry_cfg is not None:
             # flush the partial final window so no steps go unreported
             self._publish_telemetry(num_epochs - 1, -1, tel_snap)
             if self._event_log:
-                self._event_log.event("train_end",
-                                      num_epochs=num_epochs)
+                self._event_log.event(
+                    "train_end", num_epochs=num_epochs,
+                    ckpt_saves=self.ckpt_stats["saves"],
+                    # the async win, recorded: how long the step loop
+                    # actually stalled vs how long writes took
+                    ckpt_blocking_ms=round(
+                        self.ckpt_stats["blocking_ms"], 3),
+                    ckpt_write_ms=round(
+                        self.ckpt_stats["write_ms"], 3))
+
+    def _drain(self, serial: int, epoch: int, step: int):
+        """Preemption drain (docs/RESILIENCE.md): called at a step
+        boundary once the drain flag is up.  Awaits any in-flight async
+        save (its failure is logged, not fatal — the emergency save
+        below is the one that must land), writes a SYNCHRONOUS
+        emergency checkpoint, emits the drain events, and raises
+        TrainingPreempted carrying the distinct exit code."""
+        from ..resilience import preempt
+        from ..resilience.errors import TrainingPreempted
+
+        reason = preempt.drain_reason() or "requested"
+        self._emit("preempt_drain", reason=reason, epoch=epoch,
+                   step=step)
+        em_serial = None
+        if self.checkpoint_cfg:
+            self._await_pending(surface=False)
+            self._save_checkpoint(serial, epoch, step, emergency=True,
+                                  force_sync=True)
+            em_serial = serial
+            self._emit("ckpt_emergency", serial=serial, epoch=epoch,
+                       step=step)
+        # the drain request is CONSUMED by this drain: the flag is
+        # process-global, so leaving it set would instantly re-drain a
+        # train() call that resumes in-process after catching
+        # TrainingPreempted (the subprocess relaunch path never sees
+        # the stale flag — this is for embedders/tests)
+        preempt.clear_drain()
+        raise TrainingPreempted(
+            f"training drained after preemption ({reason}) at epoch "
+            f"{epoch} step {step}; emergency checkpoint serial: "
+            f"{em_serial}", reason=reason, epoch=epoch, step=step,
+            serial=em_serial, exit_code=preempt.PREEMPT_EXIT_CODE)
 
     # -- telemetry -------------------------------------------------------
     last_telemetry = None
@@ -372,6 +650,10 @@ class Trainer:
                 main_program=self.train_program)
 
     def stop(self):
+        if self._ckpt_writer is not None:
+            # flush the writer; a silently-dropped last checkpoint must
+            # surface here, not on the next preemption
+            self._ckpt_writer.close()
         self.exe.close()
 
 

@@ -25,19 +25,28 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size: int):
-    """Pool-shuffle with a bounded buffer (decorator.py shuffle)."""
+def shuffle(reader, buf_size: int, seed=None):
+    """Pool-shuffle with a bounded buffer (decorator.py shuffle).
+
+    With `seed` the shuffle order is drawn from a PRIVATE
+    `random.Random(seed)` re-seeded on every `reader_()` call — the
+    stream is then a pure function of (seed, underlying reader), so a
+    process killed and relaunched replays the exact same feed order.
+    contrib.Trainer's bit-exact resume guarantee requires deterministic
+    readers; the seedless form uses the global RNG and is NOT
+    resume-safe (documented in docs/RESILIENCE.md)."""
 
     def reader_():
+        rng = _random.Random(seed) if seed is not None else _random
         buf: List = []
         for sample in reader():
             buf.append(sample)
             if len(buf) >= buf_size:
-                _random.shuffle(buf)
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
         if buf:
-            _random.shuffle(buf)
+            rng.shuffle(buf)
             yield from buf
 
     return reader_

@@ -28,7 +28,8 @@ from .core.desc import (PROGRAM_FORMAT_VERSION, dump_program_dict,
                         load_program_dict)
 from .core.executor import Executor, Scope, global_scope
 from .core.program import Parameter, Program, Variable
-from .resilience.errors import (CheckpointCorruptError,
+from .resilience.errors import (CheckpointBarrierTimeoutError,
+                                CheckpointCorruptError,
                                 CheckpointFormatError,
                                 CheckpointIncompleteError,
                                 CheckpointNotFoundError)
@@ -261,16 +262,124 @@ def _shard_entries(value):
     return owners
 
 
-def save_sharded(executor: Executor, dirname: str,
-                 main_program: Optional[Program] = None,
-                 vars: Optional[Sequence[Variable]] = None):
-    """Save persistables with every process writing only its own shards
-    (no single-host gather).  Layout: `shards_p{proc}.npz` per process +
-    a manifest mapping each variable to its shard indices/files."""
+class ShardedSaveJob:
+    """One prepared sharded save, split into its two phases:
+
+    - the BLOCKING snapshot already happened in `prepare_sharded_save`
+      (device→host copy of every shard this process owns; that is the
+      only part a training step loop must wait for, recorded as
+      `snapshot_ms`),
+    - `write()` is the deferrable phase: CRC, zip serialization, the
+      cross-process barrier, manifest-written-LAST — safe to run on a
+      background writer thread (resilience.preempt.SnapshotWriter).
+
+    A barrier timeout inside `write()` cleans up this process's own
+    shard files before re-raising, so a dead-peer save leaves neither
+    a manifest (torn-checkpoint invariant) nor orphaned shards.
+    """
+
+    def __init__(self, dirname: str, proc: int, local_arrays: dict,
+                 meta: dict, snapshot_ms: float):
+        self.dirname = dirname
+        self.proc = proc
+        self.local_arrays = local_arrays
+        self.meta = meta
+        self.snapshot_ms = snapshot_ms
+        self.bytes_total = sum(a.nbytes for a in local_arrays.values())
+        self.write_ms: Optional[float] = None
+
+    def write(self) -> "ShardedSaveJob":
+        import time as _time
+
+        from .resilience.chaos import delaypoint, failpoint
+
+        t0 = _time.perf_counter()
+        dirname, proc = self.dirname, self.proc
+        # chaos hook: tests arm a delay here to prove a slow write
+        # phase does not stall the step loop (async acceptance test)
+        delaypoint("ckpt:write")
+        try:
+            np.savez(os.path.join(dirname, f"shards_p{proc}.npz"),
+                     **self.local_arrays)
+            # per-shard CRC32 sidecar: each process records checksums
+            # for the shards it wrote; proc 0 folds every sidecar into
+            # the manifest after the barrier (it cannot checksum bytes
+            # it never held)
+            crcs = {k: zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+                    for k, a in self.local_arrays.items()}
+            with open(os.path.join(dirname, f"shards_p{proc}.crc.json"),
+                      "w") as f:
+                json.dump(crcs, f)
+            _barrier("save_sharded:shards")
+        except CheckpointBarrierTimeoutError:
+            self._cleanup_partial()
+            raise
+        # fault-injection point (resilience/chaos.py): the
+        # torn-checkpoint tests simulate preemption exactly here —
+        # shards on disk, no manifest yet
+        failpoint("ckpt:before_manifest")
+        # the manifest is written LAST and only once all processes'
+        # shard files exist — its presence marks the checkpoint
+        # complete, so a process preempted mid-save can never leave a
+        # torn-but-loadable checkpoint behind
+        if proc == 0:
+            all_crcs: dict = {}
+            for sfile in {sh["file"] for m in self.meta.values()
+                          for sh in m["shards"]}:
+                cpath = os.path.join(
+                    dirname, sfile.replace(".npz", ".crc.json"))
+                try:
+                    with open(cpath) as f:
+                        all_crcs.update(json.load(f))
+                except (OSError, json.JSONDecodeError):
+                    pass  # CRC is best-effort at save; load tolerates gaps
+            for m in self.meta.values():
+                for sh in m["shards"]:
+                    if sh["key"] in all_crcs:
+                        sh["crc32"] = all_crcs[sh["key"]]
+            tmp = os.path.join(dirname, SHARD_MANIFEST + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump({"version": PROGRAM_FORMAT_VERSION,
+                           "vars": self.meta}, f, indent=1)
+            os.replace(tmp, os.path.join(dirname, SHARD_MANIFEST))
+        try:
+            _barrier("save_sharded:manifest")
+        except CheckpointBarrierTimeoutError:
+            # proc 0 already renamed the manifest: the checkpoint is
+            # complete and loadable; non-zero procs only lose the sync.
+            # Do NOT delete shards the manifest now references.
+            raise
+        self.write_ms = (_time.perf_counter() - t0) * 1000.0
+        return self
+
+    def _cleanup_partial(self) -> None:
+        """Best-effort removal of this process's shard files after a
+        failed shards-barrier: no manifest exists (or will), so the
+        directory must not accumulate orphaned partial shards that a
+        later save to the same dir could mix with."""
+        for name in (f"shards_p{self.proc}.npz",
+                     f"shards_p{self.proc}.crc.json"):
+            try:
+                os.remove(os.path.join(self.dirname, name))
+            except OSError:
+                pass
+
+
+def prepare_sharded_save(executor: Executor, dirname: str,
+                         main_program: Optional[Program] = None,
+                         vars: Optional[Sequence[Variable]] = None
+                         ) -> ShardedSaveJob:
+    """The blocking snapshot phase of a sharded save: resolve shard
+    ownership and copy every locally-owned shard device→host.  Returns
+    a ShardedSaveJob whose `write()` performs the rest (callable
+    inline for a synchronous save, or on a background writer)."""
+    import time as _time
+
     import jax
 
     from .core.program import default_main_program
 
+    t0 = _time.perf_counter()
     program = main_program or default_main_program()
     if vars is None:
         vars = _collect(program, lambda v: v.persistable)
@@ -305,58 +414,143 @@ def save_sharded(executor: Executor, dirname: str,
             "dtype": str(np.dtype(val.dtype)),
             "shards": shards_meta,
         }
-    np.savez(os.path.join(dirname, f"shards_p{proc}.npz"), **local_arrays)
-    # per-shard CRC32 sidecar: each process records checksums for the
-    # shards it wrote; proc 0 folds every sidecar into the manifest
-    # after the barrier (it cannot checksum bytes it never held)
-    crcs = {k: zlib.crc32(a.tobytes()) & 0xFFFFFFFF
-            for k, a in local_arrays.items()}
-    with open(os.path.join(dirname, f"shards_p{proc}.crc.json"),
-              "w") as f:
-        json.dump(crcs, f)
-    _barrier("save_sharded:shards")
-    # fault-injection point (resilience/chaos.py): the torn-checkpoint
-    # tests simulate preemption exactly here — shards on disk, no
-    # manifest yet
-    from .resilience.chaos import failpoint
-
-    failpoint("ckpt:before_manifest")
-    # the manifest is written LAST and only once all processes' shard
-    # files exist — its presence marks the checkpoint complete, so a
-    # process preempted mid-save can never leave a torn-but-loadable
-    # checkpoint behind
-    if proc == 0:
-        all_crcs: dict = {}
-        for sfile in {sh["file"] for m in meta.values()
-                      for sh in m["shards"]}:
-            cpath = os.path.join(
-                dirname, sfile.replace(".npz", ".crc.json"))
-            try:
-                with open(cpath) as f:
-                    all_crcs.update(json.load(f))
-            except (OSError, json.JSONDecodeError):
-                pass  # CRC is best-effort at save; load tolerates gaps
-        for m in meta.values():
-            for sh in m["shards"]:
-                if sh["key"] in all_crcs:
-                    sh["crc32"] = all_crcs[sh["key"]]
-        tmp = os.path.join(dirname, SHARD_MANIFEST + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump({"version": PROGRAM_FORMAT_VERSION, "vars": meta},
-                      f, indent=1)
-        os.replace(tmp, os.path.join(dirname, SHARD_MANIFEST))
-    _barrier("save_sharded:manifest")
+    return ShardedSaveJob(dirname, proc, local_arrays, meta,
+                          snapshot_ms=(_time.perf_counter() - t0) * 1000.0)
 
 
-def _barrier(tag: str):
+def save_sharded(executor: Executor, dirname: str,
+                 main_program: Optional[Program] = None,
+                 vars: Optional[Sequence[Variable]] = None,
+                 async_: bool = False, writer=None):
+    """Save persistables with every process writing only its own shards
+    (no single-host gather).  Layout: `shards_p{proc}.npz` per process +
+    a manifest mapping each variable to its shard indices/files.
+
+    With `async_=True` only the device→host snapshot happens on the
+    calling thread; the serialization/barrier/manifest phase runs on a
+    background SnapshotWriter (the given `writer`, else a process-wide
+    default) and the returned `resilience.PendingSave` tracks it —
+    write failures surface as structured CheckpointWriteErrors on the
+    writer's next submit/wait/close, never silently.  Synchronous saves
+    return the completed ShardedSaveJob (timings on it)."""
+    job = prepare_sharded_save(executor, dirname,
+                               main_program=main_program, vars=vars)
+    if not async_:
+        return job.write()
+    if writer is None:
+        from .resilience.preempt import default_writer
+
+        writer = default_writer()
+    return writer.submit(job)
+
+
+# barrier ordinal: appended to the KV-store key namespace so repeated
+# barriers with the same tag (every save reuses "save_sharded:shards")
+# never collide.  Barriers are collective — every process calls them in
+# the same order — so a local counter agrees across processes.
+_barrier_seq = 0
+
+
+def _dist_client():
+    """The process's distributed-runtime KV client, when multi-process
+    jax was initialized (parallel.init_distributed); else None."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — private API, version-dependent
+        return None
+
+
+def barrier_timeout_s() -> float:
+    """Checkpoint-barrier timeout (seconds).  Generous default — a
+    slow peer flushing a big shard is normal; a dead one should fail
+    in minutes, not hang the job forever.  Override via
+    PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S."""
+    try:
+        return float(os.environ.get(
+            "PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S", "600"))
+    except ValueError:
+        return 600.0
+
+
+def _barrier(tag: str, timeout_s: Optional[float] = None):
     """Cross-process sync for multi-host checkpointing (no-op
-    single-process)."""
+    single-process), with a timeout: a peer that died mid-save raises
+    a structured CheckpointBarrierTimeoutError naming the missing
+    ranks instead of hanging the survivors forever.
+
+    Implementation: each process publishes an arrival key in the
+    distributed KV store, then waits for every peer's key.  On timeout
+    the un-published keys identify exactly which ranks never arrived.
+    Without a KV client (unusual: process_count > 1 implies
+    init_distributed ran) it falls back to sync_global_devices on a
+    watchdog thread — same timeout, but missing ranks unknown."""
+    import time as _time
+
     import jax
 
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if jax.process_count() <= 1:
+        return
+    if timeout_s is None:
+        timeout_s = barrier_timeout_s()
+    global _barrier_seq
+    seq = _barrier_seq
+    _barrier_seq += 1
+    client = _dist_client()
+    if client is None:
+        _barrier_fallback(tag, timeout_s)
+        return
+    prefix = f"ptpu_ckpt_barrier/{tag}/{seq}/"
+    proc = jax.process_index()
+    client.key_value_set(prefix + str(proc), "ok")
+    deadline = _time.monotonic() + timeout_s
+    missing = []
+    for p in range(jax.process_count()):
+        if p == proc:
+            continue
+        remaining_ms = max(1, int((deadline - _time.monotonic()) * 1000))
+        try:
+            client.blocking_key_value_get(prefix + str(p), remaining_ms)
+        except Exception:  # noqa: BLE001 — jaxlib raises XlaRuntimeError
+            missing.append(p)
+    if missing:
+        raise CheckpointBarrierTimeoutError(
+            f"checkpoint barrier {tag!r} timed out after {timeout_s:.0f}s"
+            f" waiting for rank(s) {missing} (of "
+            f"{jax.process_count()} processes) — peer died or wedged "
+            f"inside a sharded save", tag=tag, timeout_s=timeout_s,
+            missing_ranks=missing, dirname=None,
+            process_count=jax.process_count())
 
-        multihost_utils.sync_global_devices(tag)
+
+def _barrier_fallback(tag: str, timeout_s: float):
+    """sync_global_devices with a join-timeout watchdog (no KV client:
+    cannot name missing ranks)."""
+    import threading
+
+    from jax.experimental import multihost_utils
+
+    err: list = []
+
+    def _sync():
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except Exception as e:  # noqa: BLE001 — re-raised on the caller
+            err.append(e)
+
+    t = threading.Thread(target=_sync, name=f"ckpt-barrier-{tag}",
+                         daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise CheckpointBarrierTimeoutError(
+            f"checkpoint barrier {tag!r} timed out after "
+            f"{timeout_s:.0f}s (sync_global_devices fallback — missing "
+            f"ranks unknown)", tag=tag, timeout_s=timeout_s,
+            missing_ranks=[], dirname=None)
+    if err:
+        raise err[0]
 
 
 def _assemble_index(meta, files, dirname, index):

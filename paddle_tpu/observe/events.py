@@ -33,13 +33,21 @@ SERVING_EVENTS = (
     "serving_breaker_close",        # half-open probe succeeded; RUNNING
 )
 
-# resilience event kinds (docs/RESILIENCE.md): checkpoint fallback and
-# guard lifecycle, emitted by contrib.Trainer / the chaos CI smoke
+# resilience event kinds (docs/RESILIENCE.md): checkpoint fallback,
+# save telemetry, and preemption-drain lifecycle, emitted by
+# contrib.Trainer / the chaos CI smoke
 RESILIENCE_EVENTS = (
     "ckpt_fallback",        # a serial was skipped (torn/corrupt), with
     #                         the structured CheckpointError as_dict()
     "ckpt_resume",          # resumed; fallback=True when not newest
     "ckpt_resume_failed",   # NO valid serial existed — fresh start
+    "ckpt_save",            # one save: snapshot_ms (blocking) vs
+    #                         write_ms (background) + bytes + async flag
+    "ckpt_async_error",     # LOUD: a background write failed (the
+    #                         structured CheckpointWriteError as_dict())
+    "preempt_drain",        # SIGTERM/SIGINT received: finishing the
+    #                         in-flight step, then emergency-saving
+    "ckpt_emergency",       # the drain path's final checkpoint landed
 )
 
 
@@ -99,6 +107,11 @@ class RunEventLog:
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        # async checkpoint writers emit ckpt_save from their background
+        # thread; serialize record writes so lines never interleave
+        import threading
+
+        self._wlock = threading.Lock()
         begin: Dict[str, Any] = {"git_sha": git_sha(),
                                  "argv": list(sys.argv)}
         begin.update(_backend_info())
@@ -113,8 +126,9 @@ class RunEventLog:
         rec = {"ts": round(time.time(), 3), "run_id": self.run_id,
                "event": kind}
         rec.update(fields)
-        self._f.write(json.dumps(rec, default=_jsonable) + "\n")
-        self._f.flush()
+        with self._wlock:
+            self._f.write(json.dumps(rec, default=_jsonable) + "\n")
+            self._f.flush()
         return rec
 
     def telemetry_window(self, telemetry, **extra: Any) -> Dict[str, Any]:

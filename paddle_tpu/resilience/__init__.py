@@ -15,20 +15,34 @@ failures): this subsystem survives them (docs/RESILIENCE.md):
   exponential backoff) — shared by bench.py, Trainer, ServingEngine,
 - the serving circuit breaker lives with its state machine in
   `paddle_tpu.serving.admission` (DEGRADED state, `CircuitBreaker`),
-- `chaos`: deterministic fault injectors (failpoints, NaN batches,
-  shard corruption, torn checkpoints, executor failure bursts) that
-  the tests and the CI chaos smoke use to prove all of the above.
+- `preempt`: preemption tolerance — `SnapshotWriter` (async checkpoint
+  writes: blocking device→host snapshot, background CRC+manifest-last
+  write, failures surfaced as structured `CheckpointWriteError`s) and
+  the SIGTERM/SIGINT drain controller contrib.Trainer uses to finish
+  the in-flight step, write an emergency checkpoint, and exit with
+  `PREEMPT_EXIT_CODE`,
+- `chaos`: deterministic fault injectors (failpoints, delaypoints, NaN
+  batches, shard corruption, torn checkpoints, executor failure
+  bursts) that the tests and the CI chaos smoke use to prove all of
+  the above.
 """
 
 from . import chaos  # noqa: F401
+from . import preempt  # noqa: F401
 from .chaos import (ChaosKilled, FlakyPredictor,  # noqa: F401
                     corrupt_file, corrupt_shard, nan_reader,
                     poison_feed, tear_checkpoint)
-from .errors import (CheckpointCorruptError,  # noqa: F401
-                     CheckpointError, CheckpointFormatError,
-                     CheckpointIncompleteError, CheckpointNotFoundError,
-                     ResilienceError, RetriesExhaustedError,
+from .errors import (CheckpointBarrierTimeoutError,  # noqa: F401
+                     CheckpointCorruptError, CheckpointError,
+                     CheckpointFormatError, CheckpointIncompleteError,
+                     CheckpointNotFoundError, CheckpointStateMismatchError,
+                     CheckpointWriteError, ResilienceError,
+                     RetriesExhaustedError, TrainingPreempted,
                      WatchdogTimeout)
 from .guard import (LossScaleConfig, UpdateGuardConfig,  # noqa: F401
                     enable_update_guard, guard_config)
+from .preempt import (PREEMPT_EXIT_CODE, PendingSave,  # noqa: F401
+                      SnapshotWriter, clear_drain, drain_requested,
+                      install_preempt_handler, request_drain,
+                      uninstall_preempt_handler)
 from .watchdog import Deadline, probe_backend, retry_call  # noqa: F401

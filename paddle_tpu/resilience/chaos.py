@@ -41,6 +41,7 @@ class ChaosKilled(ResilienceError):
 # ---------------------------------------------------------------------------
 
 _armed: Dict[str, int] = {}
+_delays: Dict[str, tuple] = {}  # name -> (seconds, remaining hits)
 
 
 def arm(name: str, times: int = 1) -> None:
@@ -48,13 +49,23 @@ def arm(name: str, times: int = 1) -> None:
     _armed[name] = int(times)
 
 
+def arm_delay(name: str, seconds: float, times: int = 1) -> None:
+    """Arm delaypoint `name` to SLEEP `seconds` on its next `times`
+    hits — the slow-disk/slow-fsync injection the async-checkpoint
+    tests use to prove the step loop is not blocked by the write
+    phase (a failpoint kills; a delaypoint stalls)."""
+    _delays[name] = (float(seconds), int(times))
+
+
 def disarm(name: str) -> None:
     _armed.pop(name, None)
+    _delays.pop(name, None)
 
 
 def clear() -> None:
-    """Disarm every failpoint (test teardown)."""
+    """Disarm every failpoint and delaypoint (test teardown)."""
     _armed.clear()
+    _delays.clear()
 
 
 def failpoint(name: str) -> None:
@@ -69,6 +80,20 @@ def failpoint(name: str) -> None:
         _armed[name] = left - 1
     raise ChaosKilled(f"failpoint {name!r} fired (simulated death)",
                       failpoint=name)
+
+
+def delaypoint(name: str) -> None:
+    """Production-code hook: no-op unless `arm_delay(name, s)` was
+    called, then sleeps the armed duration (once per armed count)."""
+    entry = _delays.get(name)
+    if not entry:
+        return
+    seconds, left = entry
+    if left <= 1:
+        _delays.pop(name, None)
+    else:
+        _delays[name] = (seconds, left - 1)
+    time.sleep(seconds)
 
 
 # ---------------------------------------------------------------------------

@@ -71,6 +71,57 @@ class CheckpointFormatError(CheckpointError):
     kind = "checkpoint_format"
 
 
+class CheckpointWriteError(CheckpointError):
+    """An asynchronous checkpoint write failed in the background writer
+    thread.  Raised on the NEXT save/close/wait — never swallowed: a
+    training run whose checkpoints silently stopped landing has no
+    recovery story the day it is preempted.  `details` carries the
+    original error and the dirname of the save that failed."""
+
+    kind = "checkpoint_write_failed"
+
+
+class CheckpointBarrierTimeoutError(CheckpointError):
+    """A cross-process checkpoint barrier did not complete within its
+    timeout — some peer died (or wedged) inside a sharded save.
+    `details` names the barrier `tag`, the `timeout_s`, and
+    `missing_ranks`: the process indices that never arrived (empty when
+    the runtime cannot attribute ranks — see io._barrier fallback)."""
+
+    kind = "checkpoint_barrier_timeout"
+
+
+class CheckpointStateMismatchError(CheckpointError):
+    """The checkpoint's recorded build state (generated-name counters,
+    train_state schema) does not match the resuming process's build —
+    loading would silently bind saved arrays to the WRONG variables.
+    Raised loudly instead; `details` names the first divergence.  The
+    classic cause: the resuming program was built outside
+    `unique_name.guard()` (CLAUDE.md gotcha)."""
+
+    kind = "checkpoint_state_mismatch"
+
+
+# ---------------------------------------------------------------------------
+# Preemption (resilience/preempt.py, contrib.Trainer drain path)
+# ---------------------------------------------------------------------------
+
+class TrainingPreempted(ResilienceError):
+    """The training loop drained after a preemption signal (SIGTERM/
+    SIGINT, or an injected `request_drain`): the in-flight step
+    finished, an emergency checkpoint was written, and the run must now
+    exit with `exit_code` (resilience.preempt.PREEMPT_EXIT_CODE) so the
+    scheduler can tell a drained exit from a crash.  `details` carries
+    the drain reason and the emergency checkpoint serial (None when no
+    checkpoint_config was active)."""
+
+    kind = "training_preempted"
+
+    @property
+    def exit_code(self) -> int:
+        return int(self.details.get("exit_code", 1))
+
+
 # ---------------------------------------------------------------------------
 # Watchdog / retry (resilience/watchdog.py)
 # ---------------------------------------------------------------------------

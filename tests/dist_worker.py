@@ -71,10 +71,46 @@ def main():
     # with the uninterrupted single-process reference, proving the
     # MULTI-PROCESS per-shard save/load path is lossless
     ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    # optional chaos mode (test_dist barrier-timeout test):
+    # "die_before_save" — worker 1 dies abruptly right before the
+    # sharded save, worker 0 must get a structured
+    # CheckpointBarrierTimeoutError naming rank 1, not hang
+    mode = sys.argv[5] if len(sys.argv) > 5 else None
 
     init_distributed(trainer_id=trainer_id, num_trainers=2,
                      coordinator=coordinator)
     assert jax.process_count() == 2, jax.process_count()
+
+    if mode == "die_before_save":
+        # Barrier chaos (ISSUE 7): exercises only the distributed KV
+        # runtime the checkpoint barrier rides — deliberately NO
+        # cross-process XLA computation, so the test stays valid on
+        # CPU backends without multiprocess collectives.  Worker 1
+        # dies abruptly inside the save window; worker 0 must get a
+        # structured CheckpointBarrierTimeoutError naming rank 1 and
+        # clean up its partial shard files.
+        main_prog, startup, loss = build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        if trainer_id == 1:
+            # simulated preemption: no shard file, no barrier arrival
+            # — worker 0 is on its own.  os._exit runs no cleanup,
+            # like a real SIGKILL.
+            sys.stdout.flush()
+            os._exit(17)
+        from paddle_tpu.resilience import CheckpointBarrierTimeoutError
+        try:
+            fluid.io.save_sharded(exe, ckpt_dir,
+                                  main_program=main_prog)
+            print("BARRIER_UNEXPECTED_OK", flush=True)
+        except CheckpointBarrierTimeoutError as e:
+            print("BARRIER_TIMEOUT " + json.dumps(e.as_dict()),
+                  flush=True)
+        # _exit skips distributed-shutdown teardown that would wait on
+        # the dead peer
+        sys.stdout.flush()
+        os._exit(0)
+
     mesh = make_mesh({"dp": jax.device_count()})
 
     main_prog, startup, loss = build()

@@ -48,12 +48,17 @@ def _single_process_reference(accum=1):
     return losses
 
 
-def _run_trainers(accum=1, timeout=240, ckpt_dir=None):
+def _run_trainers(accum=1, timeout=240, ckpt_dir=None, mode=None,
+                  extra_env=None):
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # worker sets cpu itself
+    if extra_env:
+        env.update(extra_env)
     extra = [str(ckpt_dir)] if ckpt_dir else []
+    if mode:
+        extra.append(mode)
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(tid), coordinator, str(accum)]
@@ -118,6 +123,47 @@ def test_two_trainer_sharded_ckpt_roundtrip(tmp_path):
     for shard in ("shards_p0.npz", "shards_p1.npz"):
         assert shard in files
         assert len(np.load(ck / shard).files) > 0, f"{shard} is empty"
+
+
+@pytest.mark.slow
+def test_dead_peer_in_sharded_save_is_barrier_timeout_not_hang(tmp_path):
+    """Crash chaos for the multi-process save barrier (ISSUE 7): worker
+    1 dies abruptly INSIDE the sharded-save window; worker 0 must get a
+    structured CheckpointBarrierTimeoutError naming the missing rank
+    within the configured timeout — never hang — and must clean up its
+    partial shard files so the directory holds neither a manifest
+    (manifest-last invariant) nor orphaned shards."""
+    import time
+
+    ck = tmp_path / "chaos_ckpt"
+    t0 = time.monotonic()
+    outs = _run_trainers(
+        accum=1, ckpt_dir=ck, mode="die_before_save", timeout=180,
+        extra_env={"PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S": "8"})
+    elapsed = time.monotonic() - t0
+    rc0, out0, err0 = outs[0]
+    rc1, _out1, _err1 = outs[1]
+    assert rc1 == 17, f"worker 1 should have died abruptly: {_err1}"
+    assert rc0 == 0, f"worker 0 crashed:\n{out0}\n{err0}"
+    lines = [ln for ln in out0.splitlines()
+             if ln.startswith("BARRIER_TIMEOUT ")]
+    assert lines, ("worker 0 never reported the barrier timeout "
+                   f"(hang or wrong error):\n{out0}\n{err0}")
+    payload = json.loads(lines[0][len("BARRIER_TIMEOUT "):])
+    assert payload["error"] == "checkpoint_barrier_timeout"
+    assert payload["missing_ranks"] == [1]
+    assert payload["tag"] == "save_sharded:shards"
+    assert payload["timeout_s"] == 8.0
+    # bounded: the whole 2-worker run (incl. jax startup) finished in
+    # startup + ~8s of barrier wait, nowhere near a hang
+    assert elapsed < 150, f"took {elapsed:.0f}s — barrier hung?"
+    # no manifest (the save never completed) and worker 0's partial
+    # shard files were cleaned up on the timeout path
+    if ck.exists():
+        files = sorted(p.name for p in ck.iterdir())
+        assert "__shards__.json" not in files, files
+        assert "shards_p0.npz" not in files, files
+        assert "shards_p0.crc.json" not in files, files
 
 
 @pytest.mark.slow

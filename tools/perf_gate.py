@@ -14,8 +14,10 @@ Two modes, matching what each environment can actually verify:
 - SCHEMA mode (--schema; the CPU-smoke half run by tools/run_ci.sh):
   validate that a bench JSON line carries the observability contract —
   metric/value/unit/vs_baseline/detail plus compile_s/retraces/
-  peak_mem_bytes/run_id/git_sha (docs/OBSERVE.md) — so a chip-less CI
-  still catches a broken artifact shape before it burns a chip run.
+  peak_mem_bytes/run_id/git_sha (docs/OBSERVE.md), and per training
+  entry the checkpoint-cost fields (ckpt_blocking_ms/ckpt_write_ms,
+  docs/RESILIENCE.md) — so a chip-less CI still catches a broken
+  artifact shape before it burns a chip run.
 
 Baselines load from either a raw bench JSON line/file or a driver
 wrapper ({"tail": ..., "parsed": ...}); a truncated wrapper tail (the
@@ -143,6 +145,19 @@ def check_schema(candidate):
               if f not in candidate]
     if not isinstance(candidate.get("detail"), dict):
         errors.append("detail is not an object")
+        return errors
+    # checkpoint-cost observability (ISSUE 7): every measured TRAINING
+    # entry (it carries last_loss; serving/failure lines do not) must
+    # report what a sharded save at that scale steals from the step
+    # loop (ckpt_blocking_ms, None when the probe itself failed) vs
+    # what the async writer hides (ckpt_write_ms)
+    for name, entry in candidate["detail"].items():
+        if not isinstance(entry, dict) or "error" in entry:
+            continue
+        if "last_loss" in entry and "ckpt_blocking_ms" not in entry:
+            errors.append(f"detail.{name}: training entry missing "
+                          f"ckpt_blocking_ms (async-checkpoint cost "
+                          f"observability)")
     return errors
 
 

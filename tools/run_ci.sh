@@ -300,6 +300,18 @@ print("chaos smoke OK:",
        "breaker": engine.health()["breaker"]["state"]})
 EOF
 
+echo "== crash-resume smoke (cpu) =="
+# ISSUE 7 (docs/RESILIENCE.md, preemption): SIGKILL a REAL training
+# subprocess at a random mid step, relaunch, auto-resume — final
+# params must be BIT-identical to an uninterrupted control and no
+# torn checkpoint may be loadable (trainer state written strictly
+# last); then the SIGTERM drain path — the worker must exit with the
+# DISTINCT preempt code (77, not 143) after writing an emergency
+# checkpoint (ckpt_emergency event), and its resumed run must match
+# the control bit-for-bit too.  Platform is pinned inside the scripts
+# (JAX_PLATFORMS env is too late here — sitecustomize imports jax).
+python tests/test_preempt.py --ci-smoke
+
 echo "== perf gate (schema + synthetic-regression smoke, cpu) =="
 # 1. the fresh bench line must satisfy the observability schema
 python tools/perf_gate.py --schema --candidate /tmp/bench_ci_line.json
