@@ -362,6 +362,31 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
          "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)})
 
 
+def _layout_fields(exe, program, feed, loss):
+    """`layout_share` for a transformer/longctx entry: the LAYOUT
+    bucket's fraction of the measured step's modeled HBM bytes
+    (observe.cost.layout_byte_share over the optimized module — copy/
+    transpose/bitcast-convert instructions and fusions rooted at one).
+    This is the r05 longctx diagnostic (~15.9 s copy/transpose vs
+    ~5.0 s kernel) as a standing artifact field; tools/perf_gate.py
+    gates its regression (--tol-layout-share) so transpose traffic can
+    never silently creep back after the head-major layout (ISSUE 8)
+    deleted it.  Reuses the memoized AOT compile — pure proto parsing;
+    failures are recorded in-band, never killing the entry."""
+    try:
+        from paddle_tpu.observe import cost as obs_cost
+
+        compiled = exe.compiled_step(program, feed=feed,
+                                     fetch_list=[loss])
+        share = obs_cost.layout_byte_share(
+            obs_cost.compiled_hlo_proto(compiled))
+        return {"layout_share": round(share, 4)}
+    except Exception as e:  # noqa: BLE001 — observability must not
+        #                     take down the measurement it describes
+        return {"layout_share": None,
+                "layout_share_error": f"{type(e).__name__}: {e}"}
+
+
 def _registry_flops(exe, program, feed, loss):
     """MFU numerator for a Pallas-active program, computed NATIVELY:
     XLA's aggregate flops of the optimized step (custom calls count
@@ -429,14 +454,15 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                       use_flash: bool = True, use_fused_ce: bool = False,
                       fused_qkv: bool = False, moe_experts: int = 0,
                       flash_pallas: bool = False,
-                      recompute: bool = False):
+                      recompute: bool = False,
+                      head_major: bool = False):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
     def build(flash, fused_ce=use_fused_ce, fq=None, moe=None,
-              pallas=None, rc=None):
+              pallas=None, rc=None, hm=None):
         return transformer.build_model(
             src_vocab_size=32000, trg_vocab_size=32000,
             max_length=max_length, n_layer=6, n_head=8, d_model=512,
@@ -446,7 +472,8 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
             moe_experts=moe_experts if moe is None else moe,
             flash_pallas=flash_pallas if pallas is None else pallas,
             recompute=recompute if rc is None else rc,
-            flash_cross=flash and max_length > 1024)
+            flash_cross=flash and max_length > 1024,
+            head_major=head_major if hm is None else hm)
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -465,7 +492,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
             # Pallas, no recompute) carries the algorithmic flop count
             step_flops = _dense_equiv_flops(
                 feed, lambda: build(False, fused_ce=False, fq=False,
-                                    pallas=False, rc=False),
+                                    pallas=False, rc=False, hm=False),
                 platform="cpu" if max_length > 1024 else None)
             flop_src = ("dense-equivalent(cpu-twin)"
                         if max_length > 1024 else "dense-equivalent")
@@ -484,6 +511,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                                               model["loss"], steps,
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main, feed, model["loss"])
+        layout = _layout_fields(exe, main, feed, model["loss"])
         ck = _ckpt_fields(exe, main, scope)
     return _mfu_result(
         step_flops, steps, elapsed,
@@ -493,10 +521,10 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "amp": use_amp, "flash": use_flash,
          "flash_pallas": flash_pallas, "fused_ce": use_fused_ce,
          "fused_qkv": fused_qkv, "moe_experts": moe_experts,
-         "recompute": recompute,
+         "recompute": recompute, "head_major": head_major,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem, **ck})
+         **_tel_fields(tel), **mem, **layout, **ck})
 
 
 def bench_bert(batch_size: int, steps: int, warmup: int,
@@ -968,6 +996,15 @@ def main():
                    help="transformer: route flash attention through "
                         "the tiled Pallas kernel instead of the XLA "
                         "composition (A/B candidate)")
+    p.add_argument("--head-major", action="store_true",
+                   help="transformer/longctx: keep attention "
+                        "activations in the flash kernels' head-major "
+                        "head-grouped layout end-to-end — zero "
+                        "transpose traffic at kernel boundaries "
+                        "(ISSUE 8, docs/LAYOUT.md).  Forces the flash "
+                        "op for decoder cross attention.  A/B "
+                        "candidate: default stays off until a recorded "
+                        "throughput win in AB_r07.json")
     p.add_argument("--xla-attn", action="store_true",
                    help="longctx: force the XLA flash composition "
                         "instead of the Pallas kernel (the longctx "
@@ -1188,7 +1225,8 @@ def main():
              use_flash=not args.no_flash,
              use_fused_ce=bool(args.fused_ce),
              fused_qkv=args.fused_qkv, moe_experts=args.moe_experts,
-             flash_pallas=args.pallas_attn, recompute=args.recompute)
+             flash_pallas=args.pallas_attn, recompute=args.recompute,
+             head_major=args.head_major)
     if args.model in ("all", "bert"):
         _run("bert", bench_bert, args.batch or 32, args.steps,
              args.warmup, use_amp=amp, use_flash=not args.no_flash)
@@ -1240,7 +1278,8 @@ def main():
              max_length=seq, use_amp=amp, use_flash=True,
              use_fused_ce=args.fused_ce is not False,
              flash_pallas=not args.xla_attn,
-             recompute=args.recompute)
+             recompute=args.recompute,
+             head_major=args.head_major)
 
     # headline = min MFU across the two NORTH-STAR models (BASELINE.json
     # names ResNet-50 + Transformer for the >=35% bar); bert/lstm/deepfm

@@ -966,11 +966,14 @@ def grid_sampler(x, grid, name=None):
 
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                     use_pallas=None, sequence_parallel=False,
-                    name=None):
+                    layout="nhtd", n_head=None, name=None):
     """Fused multi-head attention over (N, H, T, D) tensors (see
     ops/attention.py).  The TPU-native replacement for composing
-    matmul+softmax+matmul by hand.  With sequence_parallel=True (or
-    "ring" / "ulysses") and a CompiledProgram mesh that has an `sp`
+    matmul+softmax+matmul by hand.  layout="nthd" + n_head takes the
+    head-major head-grouped (N, T, H*D) contract instead — what the
+    attn_qkv projection emits directly, so NOTHING transposes at the
+    kernel boundary (the ISSUE 8 layout).  With sequence_parallel=True
+    (or "ring" / "ulysses") and a CompiledProgram mesh that has an `sp`
     axis, the sequence dimension shards over sp and attention runs as
     ring attention (KV ppermute rotation) or Ulysses (head/sequence
     all-to-all; needs sp | n_head) — the long-context path; causal/
@@ -981,7 +984,10 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
     if bias is not None:
         ins["Bias"] = [bias]
     attrs = {"causal": causal, "use_pallas": use_pallas,
-             "sequence_parallel": sequence_parallel}
+             "sequence_parallel": sequence_parallel,
+             "layout": layout}
+    if n_head is not None:
+        attrs["n_head"] = int(n_head)
     if scale is not None:
         attrs["scale"] = float(scale)
     helper.append_op(type="flash_attention", inputs=ins,

@@ -17,7 +17,12 @@ from .transformer import encoder_layer, pre_post_process
 
 def bert_encoder(src_ids, sent_ids, input_mask_bias, vocab_size, max_len,
                  n_layer=12, n_head=12, d_model=768, d_inner=3072,
-                 dropout=0.1, use_flash=False, pipeline=False):
+                 dropout=0.1, use_flash=False, pipeline=False,
+                 head_major=False):
+    if head_major and not use_flash:
+        raise ValueError(
+            "head_major=True requires use_flash=True (the head-major "
+            "layout rides the flash op; see models/transformer.py)")
     init = TruncatedNormal(0.0, 0.02)
     word_emb = layers.embedding(
         src_ids, size=[vocab_size, d_model],
@@ -50,7 +55,8 @@ def bert_encoder(src_ids, sent_ids, input_mask_bias, vocab_size, max_len,
                 x = encoder_layer(x, input_mask_bias, n_head,
                                   d_model // n_head, d_model // n_head,
                                   d_model, d_inner, dropout,
-                                  use_flash=use_flash)
+                                  use_flash=use_flash,
+                                  head_major=head_major)
     return pre_post_process(None, x, "n")
 
 
@@ -58,7 +64,7 @@ def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
                 d_model=768, d_inner=3072, max_predictions=20,
                 learning_rate=1e-4, warmup_steps=10000, dropout=0.1,
                 with_optimizer=True, use_flash=False, use_amp=False,
-                pipeline=False):
+                pipeline=False, head_major=False):
     src_ids = layers.data(name="src_ids", shape=[max_len], dtype="int64")
     sent_ids = layers.data(name="sent_ids", shape=[max_len], dtype="int64")
     seq_len = layers.data(name="seq_len", shape=[], dtype="int32")
@@ -76,7 +82,8 @@ def build_model(vocab_size=30522, max_len=128, n_layer=12, n_head=12,
 
     enc = bert_encoder(src_ids, sent_ids, bias, vocab_size, max_len,
                        n_layer, n_head, d_model, d_inner, dropout,
-                       use_flash=use_flash, pipeline=pipeline)
+                       use_flash=use_flash, pipeline=pipeline,
+                       head_major=head_major)
 
     # --- masked LM head: gather masked positions per row
     gathered = _gather_rows(enc, mask_pos)

@@ -22,7 +22,59 @@ from ..initializer import Normal
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head, dropout_rate=0.0,
                          use_flash=False, fused_qkv=False,
-                         flash_pallas=None, causal=False):
+                         flash_pallas=None, causal=False,
+                         head_major=False):
+    if head_major:
+        # Head-major end-to-end (ISSUE 8): the attn_qkv projections'
+        # (N, T, H*d) head-grouped outputs feed the flash op's
+        # layout="nthd" contract DIRECTLY and its (N, T, H*d) output
+        # feeds attn_out — the (N,T,H*d)<->(N,H,T,d) transpose
+        # round-trip at every kernel boundary (the r05 longctx profile:
+        # ~15.9 s copy/transpose vs ~5.0 s kernel) ceases to exist.
+        # Layer names are IDENTICAL to the baseline path, so the
+        # Megatron column/row ShardingRules and the one-allreduce-per-
+        # block property are untouched (asserted in
+        # tests/test_head_major.py).
+        if keys is None and fused_qkv:
+            group = 2 * d_key + d_value
+            qkv = layers.fc(queries, size=group * n_head,
+                            num_flatten_dims=2, bias_attr=False,
+                            name="attn_qkv")
+            # head-grouped minor dim: [q_h|k_h|v_h] per head h — view
+            # as (N, T, H, group), slice the minor axis, merge back.
+            # reshape/slice only; no transpose.
+            r = layers.reshape(qkv, shape=[0, 0, n_head, group])
+            q = layers.reshape(
+                layers.slice(r, axes=[3], starts=[0], ends=[d_key]),
+                shape=[0, 0, n_head * d_key])
+            k = layers.reshape(
+                layers.slice(r, axes=[3], starts=[d_key],
+                             ends=[2 * d_key]),
+                shape=[0, 0, n_head * d_key])
+            v = layers.reshape(
+                layers.slice(r, axes=[3], starts=[2 * d_key],
+                             ends=[group]),
+                shape=[0, 0, n_head * d_value])
+        else:
+            if keys is None:  # self-attention
+                keys, values = queries, queries
+            q = layers.fc(queries, size=d_key * n_head,
+                          num_flatten_dims=2, bias_attr=False,
+                          name="attn_qkv")
+            k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
+                          bias_attr=False, name="attn_qkv")
+            v = layers.fc(values, size=d_value * n_head,
+                          num_flatten_dims=2, bias_attr=False,
+                          name="attn_qkv")
+        # NOTE: like the flash path below, head-major attention has no
+        # dropout on the attention weights (the flash op's contract)
+        ctx = layers.flash_attention(q, k, v, attn_bias,
+                                     scale=d_key ** -0.5,
+                                     causal=causal,
+                                     use_pallas=flash_pallas,
+                                     layout="nthd", n_head=n_head)
+        return layers.fc(ctx, size=d_model, num_flatten_dims=2,
+                         bias_attr=False, name="attn_out")
     if keys is None and fused_qkv:
         # Megatron-style fused QKV: ONE (D, (2dk+dv)·H) matmul instead
         # of three — a 3× wider MXU tile per layer.  The fused output
@@ -136,11 +188,13 @@ def _ffn_or_moe(x, d_inner, d_model, moe_experts, aux_list):
 
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
                   dropout, use_flash=False, fused_qkv=False,
-                  moe_experts=0, aux_list=None, flash_pallas=None):
+                  moe_experts=0, aux_list=None, flash_pallas=None,
+                  head_major=False):
     attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, attn_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
-        fused_qkv=fused_qkv, flash_pallas=flash_pallas)
+        fused_qkv=fused_qkv, flash_pallas=flash_pallas,
+        head_major=head_major)
     attn = pre_post_process(x, attn, "ad", dropout)
     ff = _ffn_or_moe(pre_post_process(None, attn, "n"), d_inner,
                      d_model, moe_experts, aux_list)
@@ -151,24 +205,27 @@ def decoder_layer(x, enc_out, self_bias, cross_bias, n_head, d_key, d_value,
                   d_model, d_inner, dropout, use_flash=False,
                   fused_qkv=False, moe_experts=0, aux_list=None,
                   flash_pallas=None, self_causal=False,
-                  flash_cross=False):
+                  flash_cross=False, head_major=False):
     self_attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, self_bias, d_key,
         d_value, d_model, n_head, dropout, use_flash=use_flash,
         fused_qkv=fused_qkv, flash_pallas=flash_pallas,
-        causal=self_causal)
+        causal=self_causal, head_major=head_major)
     self_attn = pre_post_process(x, self_attn, "ad", dropout)
     q = pre_post_process(None, self_attn, "n")
     # flash_cross routes CROSS attention through the flash op too
     # (key-padding bias, non-causal) — required at long sequence
     # lengths where the composed path would materialize the
     # (N, H, T, T) weight tensor; default off to keep the historically
-    # benched short-sequence program unchanged
+    # benched short-sequence program unchanged.  head_major forces it:
+    # a composed cross-attention would reintroduce the boundary
+    # transposes the head-major layout exists to delete.
     cross = multi_head_attention(q, enc_out, enc_out, cross_bias, d_key,
                                  d_value, d_model, n_head, dropout,
-                                 use_flash=flash_cross,
+                                 use_flash=flash_cross or head_major,
                                  flash_pallas=(flash_pallas
-                                               if flash_cross else None))
+                                               if flash_cross else None),
+                                 head_major=head_major)
     cross = pre_post_process(self_attn, cross, "ad", dropout)
     ff = _ffn_or_moe(pre_post_process(None, cross, "n"), d_inner,
                      d_model, moe_experts, aux_list)
@@ -225,7 +282,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 d_inner_hid=2048, dropout=0.1, label_smooth_eps=0.1,
                 use_flash=False, use_fused_ce=False, fused_qkv=False,
                 moe_experts=0, moe_aux_weight=0.01, flash_pallas=None,
-                recompute=False, pipeline=False, flash_cross=False):
+                recompute=False, pipeline=False, flash_cross=False,
+                head_major=False):
     """Build the full training graph; returns (avg_cost, logits, feeds).
     moe_experts > 0 swaps every FFN sublayer for a switch-MoE block
     (experts sharded over mp/ep) and folds the load-balance aux losses
@@ -235,7 +293,12 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
     pipeline=True tags the encoder and decoder stacks as two
     fluid.pipeline_scope groups: on a mesh with a "pp" axis each stack
     runs as a GPipe schedule over the pp stages
-    (parallel/pipeline_engine.py); on other meshes the tags are inert."""
+    (parallel/pipeline_engine.py); on other meshes the tags are inert.
+    head_major=True keeps every attention activation in the flash
+    kernels' head-major head-grouped layout end-to-end (no transpose at
+    any kernel boundary, docs/LAYOUT.md); it requires the flash op
+    (use_flash=True) and routes decoder CROSS attention through it
+    too."""
     import contextlib
 
     from ..core.program import (pipeline_scope, pipeline_segment,
@@ -251,6 +314,12 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
         if recompute:
             ctx.enter_context(recompute_scope())
         return ctx
+
+    if head_major and not use_flash:
+        raise ValueError(
+            "head_major=True requires use_flash=True: the composed "
+            "matmul+softmax attention path would reintroduce the "
+            "boundary transposes the head-major layout deletes")
 
     moe_aux: list = []
     src_word = layers.data(name="src_word", shape=[max_length],
@@ -288,7 +357,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                                   fused_qkv=fused_qkv,
                                   moe_experts=moe_experts,
                                   aux_list=moe_aux,
-                                  flash_pallas=flash_pallas)
+                                  flash_pallas=flash_pallas,
+                                  head_major=head_major)
     enc_out = pre_post_process(None, x, "n")
 
     # decoder
@@ -307,7 +377,8 @@ def transformer(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                                   aux_list=moe_aux,
                                   flash_pallas=flash_pallas,
                                   self_causal=self_causal,
-                                  flash_cross=flash_cross)
+                                  flash_cross=flash_cross,
+                                  head_major=head_major)
     dec_out = pre_post_process(None, y, "n")
 
     if use_fused_ce:
@@ -370,7 +441,7 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
                 with_optimizer=True, label_smooth_eps=0.1, use_flash=False,
                 use_amp=False, use_fused_ce=False, fused_qkv=False,
                 moe_experts=0, flash_pallas=None, recompute=False,
-                pipeline=False, flash_cross=False):
+                pipeline=False, flash_cross=False, head_major=False):
     avg_cost, logits, feeds = transformer(
         src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
         d_model // n_head, d_model // n_head, d_model, d_inner_hid,
@@ -378,7 +449,7 @@ def build_model(src_vocab_size=10000, trg_vocab_size=10000, max_length=64,
         use_fused_ce=use_fused_ce, fused_qkv=fused_qkv,
         moe_experts=moe_experts, flash_pallas=flash_pallas,
         recompute=recompute, pipeline=pipeline,
-        flash_cross=flash_cross)
+        flash_cross=flash_cross, head_major=head_major)
     if with_optimizer:
         lr = layers.noam_decay(d_model, warmup_steps)
         lr = layers.elementwise_mul(

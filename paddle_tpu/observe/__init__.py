@@ -39,8 +39,10 @@ Three pillars (docs/OBSERVE.md):
 """
 
 from . import cost  # noqa: F401
-from .cost import (bucket_summary, device_peaks,  # noqa: F401
-                   format_cost_table, op_cost_table, program_costs)
+from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
+                   device_peaks, flash_boundary_layout,
+                   format_cost_table, layout_byte_share, op_cost_table,
+                   program_costs)
 from .events import (RESILIENCE_EVENTS, SERVING_EVENTS,  # noqa: F401
                      RunEventLog, git_sha, new_run_id, read_events)
 from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401

@@ -832,6 +832,97 @@ def op_cost_table(program=None, feed=None, fetch_list=None, scope=None,
     return out
 
 
+def layout_byte_share(proto: bytes) -> float:
+    """Fraction of the step's modeled HBM traffic spent in the LAYOUT
+    bucket (copy/transpose/bitcast-convert + layout-rooted fusions) —
+    the r05 longctx diagnostic as one number.  bench.py records it as
+    `layout_share` on every transformer/longctx entry and
+    tools/perf_gate.py gates its regression (--tol-layout-share), so
+    transpose traffic can never silently creep back."""
+    rows = instruction_costs(proto)
+    total = sum(r["bytes"] for r in rows if r["bucket"] != "noop")
+    if not total:
+        return 0.0
+    layout = sum(r["bytes"] for r in rows if r["bucket"] == "layout")
+    return layout / total
+
+
+# copy/transpose opcodes — the subset of the layout bucket that is pure
+# relayout traffic (reshape/bitcast-convert can be free bitcasts; these
+# never are)
+_COPYISH = {"copy", "transpose", "copy-start", "copy-done"}
+
+
+def _is_copyish(module: HloModule, instr: Instr) -> bool:
+    if instr.opcode in _COPYISH:
+        return True
+    if instr.opcode == "fusion":
+        for cid in instr.called_ids:
+            sub = module.computations.get(cid)
+            if sub is not None and sub.root is not None \
+                    and sub.root.opcode in _COPYISH:
+                return True
+    return False
+
+
+def flash_boundary_layout(proto: bytes,
+                          kernel_prefix: str = "flash") -> List[Dict[str, str]]:
+    """Copy/transpose instructions ADJACENT (operand or user) to Pallas
+    flash custom calls in the entry computation — the ISSUE 8 "zero
+    transpose traffic at the kernel boundary" proof, asserted empty by
+    tests/test_head_major.py and the run_ci.sh layout smoke.  On a
+    backend where Pallas runs in interpret mode (CPU) there are no
+    custom calls and the list is trivially empty — pair this with
+    `copyish_instructions` / the program-level zero-`transpose`-ops
+    check for a chip-free proof."""
+    module = HloModule(proto)
+    entry = module.entry
+    users: Dict[int, List[Instr]] = {}
+    for instr in entry.instructions:
+        for oid in instr.operand_ids:
+            users.setdefault(oid, []).append(instr)
+    offenders = []
+    for instr in entry.instructions:
+        if instr.opcode != "custom-call":
+            continue
+        kern = _pallas_kernel_of(instr.op_name)
+        if not kern or not kern.startswith(kernel_prefix):
+            continue
+        neighbors = [entry.by_id[i] for i in instr.operand_ids
+                     if i in entry.by_id]
+        neighbors += users.get(instr.id, [])
+        for nb in neighbors:
+            if _is_copyish(module, nb):
+                offenders.append({"custom_call": instr.name,
+                                  "kernel": kern,
+                                  "neighbor": nb.name,
+                                  "opcode": nb.opcode})
+    return offenders
+
+
+def copyish_instructions(proto: bytes,
+                         op_types: Optional[set] = None) -> List[Dict[str, Any]]:
+    """Entry-computation copy/transpose instructions (incl. fusions
+    rooted at one), optionally restricted to rows attributed to the
+    given fluid op types.  The chip-free half of the boundary proof:
+    with Pallas in interpret mode the flash custom calls don't exist,
+    but a head-major program still must not contain transpose kernels
+    attributed to its attention ops."""
+    module = HloModule(proto)
+    entry = module.entry
+    out = []
+    for instr in entry.instructions:
+        if not _is_copyish(module, instr):
+            continue
+        op_type = fluid_op_of(instr.op_name)
+        if op_types is not None and op_type not in op_types:
+            continue
+        out.append({"name": instr.name, "opcode": instr.opcode,
+                    "op_type": op_type,
+                    "bytes": float(instr.shape.bytes)})
+    return out
+
+
 def bucket_summary(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
     """Collapse op_cost_table rows to per-bucket totals — the
     layout/copy/transpose share IS the r05 longctx diagnostic."""

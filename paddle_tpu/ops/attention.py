@@ -4,10 +4,13 @@ The reference composes attention from matmul/softmax primitives
 (nets.py scaled_dot_product_attention; the 2018 codebase has no fused
 kernel — SURVEY.md §5.7 marks this a capability gap to fill natively).
 `flash_attention` is the single-op attention: inputs Q/K/V laid out
-(N, H, T, D) plus an optional additive Bias; the default implementation
-is a numerically-stable lax composition (XLA fuses it well on TPU), and
-ops/pallas/flash_attention.py provides the tiled Pallas kernel used when
-`use_pallas` is set and we're on TPU (forward via custom_vjp).
+(N, H, T, D) — or, with layout="nthd" + the n_head attr, head-grouped
+(N, T, H*D), the head-major end-to-end contract that deletes every
+boundary transpose (ISSUE 8) — plus an optional additive Bias; the
+default implementation is a numerically-stable lax composition (XLA
+fuses it well on TPU), and ops/pallas/flash_attention.py provides the
+tiled Pallas kernel used when `use_pallas` is set and we're on TPU
+(forward via custom_vjp).
 """
 
 from __future__ import annotations
@@ -32,13 +35,55 @@ def _xla_attention(q, k, v, bias, scale, causal):
     return o
 
 
+def _xla_attention_nthd(q, k, v, bias, scale, causal, n_head):
+    """XLA composition over head-grouped (N, T, H*D) operands.  The
+    4D views are free reshapes (minor-dim split/merge) and the einsums
+    carry the head dim as a dot batch dim — XLA folds the operand
+    orderings into the dot dimension numbers, no boundary transpose."""
+    n, t_q, hd = q.shape
+    d = hd // n_head
+    q4 = q.reshape(n, t_q, n_head, d)
+    k4 = k.reshape(n, k.shape[1], n_head, d)
+    v4 = v.reshape(n, v.shape[1], n_head, d)
+    logits = jnp.einsum("nqhd,nkhd->nhqk", q4, k4) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        t_kk = logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_kk), jnp.bool_))
+        logits = jnp.where(mask, logits, -1e9)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    o = jnp.einsum("nhqk,nkhd->nqhd", weights.astype(q.dtype), v4)
+    return o.reshape(n, t_q, hd)
+
+
 @register_op("flash_attention")
 def flash_attention(ctx, ins, attrs):
     q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
     bias = opt_in(ins, "Bias")
+    layout = attrs.get("layout", "nhtd")
+    n_head = attrs.get("n_head", None)
+    if layout == "nthd":
+        # head-major end-to-end contract (ISSUE 8): operands are
+        # (N, T, H*D) head-grouped — exactly what the attn_qkv
+        # projection emits — and nothing transposes at this boundary
+        if not n_head:
+            raise ValueError("flash_attention layout='nthd' needs the "
+                             "n_head attr (operands are (N, T, H*D))")
+        if q.shape[-1] % int(n_head):
+            raise ValueError(
+                f"flash_attention nthd: minor dim {q.shape[-1]} not "
+                f"divisible by n_head {n_head}")
+        head_dim = q.shape[-1] // int(n_head)
+        t_axis, h_count = 1, int(n_head)
+    elif layout == "nhtd":
+        head_dim = q.shape[-1]
+        t_axis, h_count = 2, q.shape[1]
+    else:
+        raise ValueError(f"flash_attention: unknown layout {layout!r}")
     scale = attrs.get("scale", None)
     if scale is None:
-        scale = q.shape[-1] ** -0.5
+        scale = head_dim ** -0.5
     causal = attrs.get("causal", False)
     if attrs.get("sequence_parallel", False):
         # long-context path: shard the sequence axis over the mesh's
@@ -69,17 +114,17 @@ def flash_attention(ctx, ins, attrs):
                     "sequences / packed batches) or disable "
                     "sequence_parallel")
             sp = mesh.shape["sp"]
-            if q.shape[2] % sp != 0:
+            if q.shape[t_axis] % sp != 0:
                 raise ValueError(
                     f"sequence_parallel flash_attention: sequence "
-                    f"length {q.shape[2]} must be divisible by the sp "
-                    f"axis size ({sp}) — pad T to a multiple")
+                    f"length {q.shape[t_axis]} must be divisible by "
+                    f"the sp axis size ({sp}) — pad T to a multiple")
             strategy = "ring" if strategy0 is True else strategy0
             if strategy == "ulysses":
-                if q.shape[1] % sp != 0:
+                if h_count % sp != 0:
                     raise ValueError(
                         f"ulysses sequence_parallel: the sp axis "
-                        f"({sp}) must divide n_head ({q.shape[1]}) — "
+                        f"({sp}) must divide n_head ({h_count}) — "
                         f"use 'ring' for head counts below the sp "
                         f"degree")
                 from ..parallel.ring_attention import ulysses_attention
@@ -87,7 +132,8 @@ def flash_attention(ctx, ins, attrs):
                 o = ulysses_attention(
                     q, k, v, mesh, axis="sp", scale=scale,
                     causal=causal, use_pallas=attrs.get("use_pallas"),
-                    batch_axis=batch_axis)
+                    batch_axis=batch_axis, layout=layout,
+                    n_head=h_count)
                 return out(Out=o)
             from ..parallel.ring_attention import ring_attention
 
@@ -97,7 +143,8 @@ def flash_attention(ctx, ins, attrs):
             o = ring_attention(q, k, v, mesh, axis="sp", scale=scale,
                                causal=causal,
                                use_pallas=attrs.get("use_pallas"),
-                               batch_axis=batch_axis)
+                               batch_axis=batch_axis, layout=layout,
+                               n_head=h_count)
             return out(Out=o)
         # no sp axis in this compile: fall through to the local kernel
     if attrs.get("use_pallas", False):
@@ -105,7 +152,7 @@ def flash_attention(ctx, ins, attrs):
             # the tiled kernel takes a KEY-padding bias broadcastable
             # TO (N, 1, 1, Tk): every (right-aligned) dim must be 1 or
             # match the target
-            target = (q.shape[0], 1, 1, k.shape[2])
+            target = (q.shape[0], 1, 1, k.shape[t_axis])
             if b.ndim > 4:
                 return False
             for bd, td in zip(reversed(b.shape), reversed(target)):
@@ -117,11 +164,18 @@ def flash_attention(ctx, ins, attrs):
             # richer biases ((Tq, Tk) shapes, per-head biases) take the
             # documented XLA fallback — express causal+padding as
             # causal=True + a key bias to stay on the kernel
-            o = _xla_attention(q, k, v, bias, scale, causal)
+            if layout == "nthd":
+                o = _xla_attention_nthd(q, k, v, bias, scale, causal,
+                                        h_count)
+            else:
+                o = _xla_attention(q, k, v, bias, scale, causal)
             return out(Out=o)
         from .pallas.flash_attention import pallas_flash_attention
 
-        o = pallas_flash_attention(q, k, v, bias, scale, causal)
+        o = pallas_flash_attention(q, k, v, bias, scale, causal,
+                                   layout=layout, n_head=h_count)
+    elif layout == "nthd":
+        o = _xla_attention_nthd(q, k, v, bias, scale, causal, h_count)
     else:
         o = _xla_attention(q, k, v, bias, scale, causal)
     return out(Out=o)

@@ -11,7 +11,26 @@ and dq over k-blocks, both recomputing p = exp(s - lse) from the saved
 logsumexp — end-to-end O(T) memory so long-context training never
 materializes the score matrix.  Score blocks are kept in (k, q)
 orientation in the backward so the per-q lse/delta vectors broadcast
-along the TPU lane dimension (no transposes in-kernel).
+along the TPU lane dimension (no transposes in-kernel).  delta =
+rowsum(do*o) is recomputed in-kernel from the o/do tiles (cheap
+elementwise per block) instead of a separate XLA reduction, so NOTHING
+but the q/k/v/o/do/lse buffers crosses the kernel boundary.
+
+Two operand layouts, selected by `layout=`:
+
+- "nhtd" (historical): q/k/v arrive (N, H, T, D) and are folded to
+  (N*H, T, D) by a free reshape.
+- "nthd" (head-major end-to-end, ISSUE 8): q/k/v arrive (N, T, H*D)
+  head-grouped — EXACTLY what a (D_model -> H*D) projection emits — and
+  the batch*head fold happens in the GRID instead of the data: block
+  index maps pick head g%H of batch g//H out of the grouped minor dim.
+  No transpose ever exists in the program; the per-head (T, D) slab is
+  a strided DMA.  The kernel tile shapes are IDENTICAL to the folded
+  layout ((block, d) tiles), so the Mosaic lowering is the proven one.
+
+The additive key-padding bias stays (N, 1, 1, Tk) — one row per batch,
+never repeated per head (the index map reuses row g//H); its gradient
+is summed over heads outside the kernel.
 
 Ring-attention support (parallel/ring_attention.py): the kernel takes
 dynamic global position offsets (SMEM scalars) so causal masking works
@@ -54,10 +73,19 @@ _SOFTMAX_FWD_PER_SCORE = 8.0
 _SOFTMAX_BWD_PER_SCORE = 8.0
 
 
-def _attn_dims(operand_shapes):
-    (nh, t_q, d) = operand_shapes[0][0]
-    t_k = operand_shapes[1][0][1]
-    return nh, t_q, t_k, d
+def _attn_dims(operand_shapes, stat_dims):
+    """(nh, t_q, t_k, d) from the q/k operands plus the lse statistic's
+    dims — (nh, 8, t_q) sublane-replicated, or the pre-r07 (nh, t_q)
+    form (tolerated so old recorded protos stay analyzable).  Works for
+    BOTH layouts: folded (NH, T, D) operands have nh == q.shape[0]
+    (heads-per-batch 1 below), while head-major grouped (N, T, H*D)
+    operands recover H = nh // N from the statistic and split the
+    grouped minor dim."""
+    qd = operand_shapes[0][0]
+    kd = operand_shapes[1][0]
+    nh, t_q = stat_dims[0], stat_dims[-1]
+    heads = max(nh // max(qd[0], 1), 1)
+    return nh, t_q, kd[1], qd[2] // heads
 
 
 def _io_bytes(operand_shapes, result_shapes):
@@ -71,21 +99,23 @@ def _io_bytes(operand_shapes, result_shapes):
 
 
 def flash_fwd_cost(operand_shapes, result_shapes):
-    nh, t_q, t_k, d = _attn_dims(operand_shapes)
+    # result_shapes[-1] is the (nh, 8, t_q) lse output
+    nh, t_q, t_k, d = _attn_dims(operand_shapes, result_shapes[-1][0])
     flops = nh * t_q * t_k * (4.0 * d + _SOFTMAX_FWD_PER_SCORE)
     return flops, _io_bytes(operand_shapes, result_shapes)
 
 
 def flash_dkv_cost(operand_shapes, result_shapes):
     # carries dk + dv + the shared dp dot (dense-equivalent split with
-    # flash_dq_cost: together they sum to the dense backward's 4 dots)
-    nh, t_q, t_k, d = _attn_dims(operand_shapes)
+    # flash_dq_cost: together they sum to the dense backward's 4 dots).
+    # operand_shapes[5] is the (nh, 8, t_q) lse input.
+    nh, t_q, t_k, d = _attn_dims(operand_shapes, operand_shapes[5][0])
     flops = nh * t_q * t_k * (6.0 * d + 0.625 * _SOFTMAX_BWD_PER_SCORE)
     return flops, _io_bytes(operand_shapes, result_shapes)
 
 
 def flash_dq_cost(operand_shapes, result_shapes):
-    nh, t_q, t_k, d = _attn_dims(operand_shapes)
+    nh, t_q, t_k, d = _attn_dims(operand_shapes, operand_shapes[5][0])
     flops = nh * t_q * t_k * (2.0 * d + 0.375 * _SOFTMAX_BWD_PER_SCORE)
     return flops, _io_bytes(operand_shapes, result_shapes)
 
@@ -93,14 +123,13 @@ def flash_dq_cost(operand_shapes, result_shapes):
 def attention_cost(nh, t_q, t_k, d, dtype_bytes=4):
     """Dense-equivalent (flops, bytes) of one fwd+bwd flash attention —
     the sum of the three kernels' registry entries (test/parity
-    helper; q/k/v/do/o assumed dtype_bytes wide, lse/delta f32)."""
+    helper; q/k/v/do/o assumed dtype_bytes wide, lse f32)."""
     q = ((nh, t_q, d), dtype_bytes)
     k = ((nh, t_k, d), dtype_bytes)
     stat = ((nh, 8, t_q), 4)
-    lse = ((nh, t_q), 4)
-    fwd = flash_fwd_cost([q, k, k], [q, lse])
-    dkv = flash_dkv_cost([q, k, k, q, stat, stat], [k, k])
-    dq = flash_dq_cost([q, k, k, q, stat, stat], [q])
+    fwd = flash_fwd_cost([q, k, k], [q, stat])
+    dkv = flash_dkv_cost([q, k, k, q, q, stat], [k, k])
+    dq = flash_dq_cost([q, k, k, q, q, stat], [q])
     return (fwd[0] + dkv[0] + dq[0], fwd[1] + dkv[1] + dq[1])
 
 
@@ -129,6 +158,41 @@ def _offs(offs_ref):
     return offs_ref[0, 0], offs_ref[0, 1]
 
 
+def _tile(ref):
+    """The (block, d) tile of a q/k/v/o/do ref — both layouts block
+    these operands as (1, block, d); the leading dim is squeezed."""
+    return ref[0]
+
+
+# -- block-spec factories ---------------------------------------------------
+#
+# One grid for both layouts: (N*H, time blocks, time blocks).  The
+# difference is ONLY where a (1, block, d) tile lives in the array:
+# folded (NH, T, D) indexes (g, t, 0); head-major grouped (N, T, H*D)
+# indexes (g // H, t, g % H) — the block unit of the minor dim is d, so
+# block index g % H lands on head g % H's d-slice.  lse/delta stay in
+# the folded (NH, 8, T) form in both layouts (kernel-internal
+# statistics, never touching the model's activation layout).
+
+def _tile_spec(block, d, layout, h, tsel):
+    """BlockSpec for a (1, block, d) q/k/v/o/do tile; `tsel` maps the
+    non-head grid axes (a, b) to the time block index."""
+    from jax.experimental import pallas as pl
+
+    if layout == "nthd":
+        return pl.BlockSpec((1, block, d),
+                            lambda g, a, b: (g // h, tsel(a, b), g % h))
+    return pl.BlockSpec((1, block, d),
+                        lambda g, a, b: (g, tsel(a, b), 0))
+
+
+def _stat_spec(block_q, tsel):
+    from jax.experimental import pallas as pl
+
+    return pl.BlockSpec((1, 8, block_q),
+                        lambda g, a, b: (g, 0, tsel(a, b)))
+
+
 # -- forward ----------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, offs_ref, o_ref, lse_ref,
@@ -153,8 +217,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, offs_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0]                      # (block_q, d)
-        k = k_ref[0]                      # (block_k, d)
+        q = _tile(q_ref)                  # (block_q, d)
+        k = _tile(k_ref)                  # (block_k, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -184,7 +248,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, offs_ref, o_ref, lse_ref,
         # 0 * NaN would poison the accumulator even though p==0 there.
         v_rows = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, 1), 0)
-        vv = jnp.where(v_rows < t_k, v_ref[0], 0)
+        vv = jnp.where(v_rows < t_k, _tile(v_ref), 0)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -201,27 +265,39 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, offs_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k):
+def _fwd_dims(q, k, layout, n_head):
+    if layout == "nthd":
+        n, t_q, hd = q.shape
+        return n * n_head, t_q, k.shape[1], hd // n_head
+    nh, t_q, d = q.shape
+    return nh, t_q, k.shape[1], d
+
+
+def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k,
+               layout, n_head):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    nh, t_q, d = q.shape
-    t_k = k.shape[1]
+    nh, t_q, t_k, d = _fwd_dims(q, k, layout, n_head)
+    h = n_head
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
     grid = (nh, pl.cdiv(t_q, block_q), pl.cdiv(t_k, block_k))
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+        _tile_spec(block_q, d, layout, h, lambda a, b: a),
+        _tile_spec(block_k, d, layout, h, lambda a, b: b),
+        _tile_spec(block_k, d, layout, h, lambda a, b: b),
     ]
     args = [q, k, v]
     has_bias = bias is not None
     has_offs = offsets is not None
     if has_bias:
+        # bias is (N, 1, 1, Tk): one row per BATCH, the index map fans
+        # it out over heads — no per-head repeat ever materializes
         in_specs.append(
-            pl.BlockSpec((1, 1, 1, block_k), lambda h, i, j: (h, 0, 0, j)))
+            pl.BlockSpec((1, 1, 1, block_k),
+                         lambda g, a, b: (g // h, 0, 0, b)))
         args.append(bias)
     if has_offs:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
@@ -237,17 +313,21 @@ def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k):
                     causal=causal, block_q=block_q, block_k=block_k,
                     t_k=t_k)
 
-    o, lse = _pallas_call(
+    if layout == "nthd":
+        o_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    else:
+        o_shape = jax.ShapeDtypeStruct((nh, t_q, d), q.dtype)
+    o, lse8 = _pallas_call(
         kern,
         name="flash_fwd",
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda h, i, j: (h, 0, i)),
+            _tile_spec(block_q, d, layout, h, lambda a, b: a),
+            _stat_spec(block_q, lambda a, b: a),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nh, t_q, d), q.dtype),
+            o_shape,
             jax.ShapeDtypeStruct((nh, 8, t_q), jnp.float32),
         ],
         scratch_shapes=[
@@ -256,7 +336,7 @@ def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
     )(*args)
-    return o, lse[:, 0, :]
+    return o, lse8
 
 
 # -- backward kernels -------------------------------------------------------
@@ -266,7 +346,9 @@ def _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q, block_k):
 #   ds = p * (dp - delta),  delta = rowsum(do * o) - dlse
 #   dq = scale * ds k;      dk = scale * ds^T q;   db = sum_q ds
 # Score blocks are held transposed, sT: (block_k, block_q), so the per-q
-# vectors (lse, delta) broadcast along lanes.
+# vectors (lse, delta) broadcast along lanes.  delta is recomputed from
+# the o/do tiles in-kernel (elementwise, cheap) so no (NH, T) statistic
+# has to be produced by XLA between the kernels.
 
 def _bwd_p_ds(q, k, v, do, lse_row, delta_row, bias_col, q_off, k_off, *,
               scale, causal, kb, qb, block_q, block_k, t_q, t_k):
@@ -301,15 +383,26 @@ def _bwd_p_ds(q, k, v, do, lse_row, delta_row, bias_col, q_off, k_off, *,
 def _row_clean(ref, base, limit, block):
     """Load a (block, d) tile zeroing rows at absolute position >= limit
     (undefined padding of the final block)."""
-    x = ref[0]
+    x = _tile(ref)
     rows = base + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
     return jnp.where(rows < limit, x, 0)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    bias_ref, offs_ref, dk_ref, dv_ref, db_ref, dk_scr,
-                    dv_scr, db_scr, *, scale, causal, block_q, block_k,
-                    t_q, t_k):
+def _delta_row(do, o, dlse_ref):
+    """(1, block_q) delta = rowsum(do * o) [- dlse], recomputed from the
+    already-cleaned f32 tiles.  dlse arrives 8-sublane-stored with only
+    row 0 populated (the public wrapper slices lse8[:, 0, :]), so the
+    sublane SUM recovers it."""
+    delta = jnp.sum(do * o, axis=1)[None, :]
+    if dlse_ref is not None:
+        delta = delta - jnp.sum(dlse_ref[0], axis=0)[None, :]
+    return delta
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                    dlse_ref, bias_ref, offs_ref, dk_ref, dv_ref, db_ref,
+                    dk_scr, dv_scr, db_scr, *, scale, causal, block_q,
+                    block_k, t_q, t_k):
     from jax.experimental import pallas as pl
 
     kb = pl.program_id(1)
@@ -332,18 +425,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         q = _row_clean(q_ref, qb * block_q, t_q, block_q)
         do = _row_clean(do_ref, qb * block_q, t_q, block_q)
-        k = k_ref[0]
-        v = v_ref[0]
+        o = _row_clean(o_ref, qb * block_q, t_q, block_q)
+        k = _tile(k_ref)
+        v = _tile(v_ref)
         bias_col = None if bias_ref is None else \
             bias_ref[0].astype(jnp.float32)
+        do32 = do.astype(jnp.float32)
+        delta = _delta_row(do32, o.astype(jnp.float32), dlse_ref)
         p, ds = _bwd_p_ds(
             q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), do.astype(jnp.float32),
-            lse_ref[0, 0][None, :], delta_ref[0, 0][None, :], bias_col,
+            v.astype(jnp.float32), do32,
+            lse_ref[0, 0][None, :], delta, bias_col,
             q_off, k_off, scale=scale, causal=causal, kb=kb, qb=qb,
             block_q=block_q, block_k=block_k, t_q=t_q, t_k=t_k)
         dv_scr[:] += jax.lax.dot_general(
-            p, do.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p, do32, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scr[:] += scale * jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -359,9 +455,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             db_ref[0] = db_scr[:].astype(db_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   bias_ref, offs_ref, dq_ref, dq_scr, *, scale, causal,
-                   block_q, block_k, t_q, t_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                   dlse_ref, bias_ref, offs_ref, dq_ref, dq_scr, *,
+                   scale, causal, block_q, block_k, t_q, t_k):
     from jax.experimental import pallas as pl
 
     qb = pl.program_id(1)
@@ -380,14 +476,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         q = _row_clean(q_ref, qb * block_q, t_q, block_q)
         do = _row_clean(do_ref, qb * block_q, t_q, block_q)
+        o = _row_clean(o_ref, qb * block_q, t_q, block_q)
         k = _row_clean(k_ref, kb * block_k, t_k, block_k)
-        v = v_ref[0]
+        v = _tile(v_ref)
         bias_col = None if bias_ref is None else \
             bias_ref[0].astype(jnp.float32)
+        do32 = do.astype(jnp.float32)
+        delta = _delta_row(do32, o.astype(jnp.float32), dlse_ref)
         _, ds = _bwd_p_ds(
             q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), do.astype(jnp.float32),
-            lse_ref[0, 0][None, :], delta_ref[0, 0][None, :], bias_col,
+            v.astype(jnp.float32), do32,
+            lse_ref[0, 0][None, :], delta, bias_col,
             q_off, k_off, scale=scale, causal=causal, kb=kb, qb=qb,
             block_q=block_q, block_k=block_k, t_q=t_q, t_k=t_k)
         # dq[q,d] = scale * sum_k ds[k,q] * k[k,d]
@@ -400,105 +499,111 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
-               block_q, block_k):
+def _flash_bwd(q, k, v, bias, offsets, o, lse8, do, dlse8, scale, causal,
+               block_q, block_k, layout, n_head):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    nh, t_q, d = q.shape
-    t_k = k.shape[1]
+    nh, t_q, t_k, d = _fwd_dims(q, k, layout, n_head)
+    h = n_head
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
     nq = pl.cdiv(t_q, block_q)
     nk = pl.cdiv(t_k, block_k)
 
-    # delta = rowsum(do * o) - dlse: tiny (nh, t_q) XLA reduction.  The
-    # dlse term carries the cotangent of a returned lse (ring attention's
-    # online-softmax merge differentiates through lse).
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)
-    if dlse is not None:
-        delta = delta - dlse.astype(jnp.float32)
-    # lse/delta enter the kernels replicated over 8 sublanes —
-    # (nh, 8, t_q) with (1, 8, block_q) blocks — because Mosaic rejects
-    # a (1, block_q) block on a (nh, t_q) array (sublane dim must be
-    # 8-divisible or full; the fwd's lse OUTPUT uses the same layout)
-    lse8 = jnp.broadcast_to(lse.astype(jnp.float32)[:, None, :],
-                            (nh, 8, t_q))
-    delta8 = jnp.broadcast_to(delta[:, None, :], (nh, 8, t_q))
-    # bias arrives (nh, 1, 1, t_k); kernels want it as a (block_k, 1)
+    # bias arrives (N, 1, 1, t_k); kernels want it as a (block_k, 1)
     # column so it broadcasts over the lane (q) dimension
-    bias_t = None if bias is None else bias.reshape(nh, t_k, 1)
+    bias_t = None if bias is None else \
+        bias.reshape(bias.shape[0], t_k, 1)
     has_bias = bias_t is not None
+    has_dlse = dlse8 is not None
     has_offs = offsets is not None
 
     def specs(order):
-        """order: 'kq' → grid (h, kb, qb); 'qk' → grid (h, qb, kb)."""
+        """order: 'kq' → grid (g, kb, qb); 'qk' → grid (g, qb, kb)."""
         if order == "kq":
-            qi = lambda h, a, b: (h, b, 0)     # noqa: E731
-            ki = lambda h, a, b: (h, a, 0)     # noqa: E731
-            vi = lambda h, a, b: (h, 0, b)     # noqa: E731  (lse/delta by q)
-            bi = lambda h, a, b: (h, a, 0)     # noqa: E731  (bias by k)
+            q_t = lambda a, b: b     # noqa: E731
+            k_t = lambda a, b: a     # noqa: E731
         else:
-            qi = lambda h, a, b: (h, a, 0)     # noqa: E731
-            ki = lambda h, a, b: (h, b, 0)     # noqa: E731
-            vi = lambda h, a, b: (h, 0, a)     # noqa: E731
-            bi = lambda h, a, b: (h, b, 0)     # noqa: E731
+            q_t = lambda a, b: a     # noqa: E731
+            k_t = lambda a, b: b     # noqa: E731
         sp = [
-            pl.BlockSpec((1, block_q, d), qi),
-            pl.BlockSpec((1, block_k, d), ki),
-            pl.BlockSpec((1, block_k, d), ki),
-            pl.BlockSpec((1, block_q, d), qi),
-            pl.BlockSpec((1, 8, block_q), vi),
-            pl.BlockSpec((1, 8, block_q), vi),
+            _tile_spec(block_q, d, layout, h, q_t),
+            _tile_spec(block_k, d, layout, h, k_t),
+            _tile_spec(block_k, d, layout, h, k_t),
+            _tile_spec(block_q, d, layout, h, q_t),   # do
+            _tile_spec(block_q, d, layout, h, q_t),   # o
+            _stat_spec(block_q, q_t),                 # lse8
         ]
+        if has_dlse:
+            sp.append(_stat_spec(block_q, q_t))
         if has_bias:
-            sp.append(pl.BlockSpec((1, block_k, 1), bi))
+            sp.append(pl.BlockSpec((1, block_k, 1),
+                                   lambda g, a, b: (g // h, k_t(a, b), 0)))
         if has_offs:
             sp.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return sp
 
-    args = [q, k, v, do, lse8, delta8]
+    args = [q, k, v, do, o, lse8]
+    if has_dlse:
+        args.append(dlse8)
     if has_bias:
         args.append(bias_t)
     if has_offs:
         args.append(offsets)
-    n_in = 6 + has_bias + has_offs
+    n_in = 6 + has_dlse + has_bias + has_offs
 
     def unpack(refs):
         ins = refs[:n_in]
-        b_r = ins[6] if has_bias else None
-        of_r = ins[6 + has_bias] if has_offs else None
-        return ins[:6], b_r, of_r, refs[n_in:]
+        i = 6
+        dl_r = b_r = of_r = None
+        if has_dlse:
+            dl_r = ins[i]
+            i += 1
+        if has_bias:
+            b_r = ins[i]
+            i += 1
+        if has_offs:
+            of_r = ins[i]
+        return ins[:6], dl_r, b_r, of_r, refs[n_in:]
 
-    # dk/dv (+db): grid (h, kb, qb), accumulate over q-blocks
+    def grad_spec(block, tsel):
+        return _tile_spec(block, d, layout, h, tsel)
+
+    if layout == "nthd":
+        dk_shape = jax.ShapeDtypeStruct(k.shape, q.dtype)
+        dq_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    else:
+        dk_shape = jax.ShapeDtypeStruct((nh, t_k, d), q.dtype)
+        dq_shape = jax.ShapeDtypeStruct((nh, t_q, d), q.dtype)
+
+    # dk/dv (+db): grid (g, kb, qb), accumulate over q-blocks
     def dkv_kern(*refs):
-        (q_r, k_r, v_r, do_r, lse_r, dl_r), b_r, of_r, rest = unpack(refs)
+        (q_r, k_r, v_r, do_r, o_r, lse_r), dl_r, b_r, of_r, rest = \
+            unpack(refs)
         if has_bias:
             dk_r, dv_r, db_r, dk_s, dv_s, db_s = rest
         else:
             dk_r, dv_r, dk_s, dv_s = rest
             db_r = db_s = None
-        _bwd_dkv_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, b_r, of_r,
+        _bwd_dkv_kernel(q_r, k_r, v_r, do_r, o_r, lse_r, dl_r, b_r, of_r,
                         dk_r, dv_r, db_r, dk_s, dv_s, db_s, scale=scale,
                         causal=causal, block_q=block_q, block_k=block_k,
                         t_q=t_q, t_k=t_k)
 
-    kq_out_specs = [
-        pl.BlockSpec((1, block_k, d), lambda h, a, b: (h, a, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, a, b: (h, a, 0)),
-    ]
-    kq_out_shape = [
-        jax.ShapeDtypeStruct((nh, t_k, d), q.dtype),
-        jax.ShapeDtypeStruct((nh, t_k, d), q.dtype),
-    ]
+    kq_out_specs = [grad_spec(block_k, lambda a, b: a),
+                    grad_spec(block_k, lambda a, b: a)]
+    kq_out_shape = [dk_shape, dk_shape]
     kq_scratch = [
         pltpu.VMEM((block_k, d), jnp.float32),
         pltpu.VMEM((block_k, d), jnp.float32),
     ]
     if has_bias:
+        # db stays PER-HEAD (NH, t_k, 1) — grid dim 0 revisits of a
+        # shared (N, ...) block would not be consecutive, so the
+        # head-sum happens outside (a tiny reduce, not a layout op)
         kq_out_specs.append(
-            pl.BlockSpec((1, block_k, 1), lambda h, a, b: (h, a, 0)))
+            pl.BlockSpec((1, block_k, 1), lambda g, a, b: (g, a, 0)))
         kq_out_shape.append(
             jax.ShapeDtypeStruct((nh, t_k, 1), jnp.float32))
         kq_scratch.append(pltpu.VMEM((block_k, 1), jnp.float32))
@@ -514,26 +619,30 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
     )(*args)
     if has_bias:
         dk, dv, db = dkv_out
-        dbias = db.reshape(nh, 1, 1, t_k).astype(bias.dtype)
+        n_b = bias.shape[0]
+        dbias = db.reshape(n_b, nh // n_b, t_k).sum(axis=1) \
+            .reshape(n_b, 1, 1, t_k).astype(bias.dtype)
     else:
         dk, dv = dkv_out
         dbias = None
 
-    # dq: grid (h, qb, kb), accumulate over k-blocks
+    # dq: grid (g, qb, kb), accumulate over k-blocks
     def dq_kern(*refs):
-        (q_r, k_r, v_r, do_r, lse_r, dl_r), b_r, of_r, rest = unpack(refs)
+        (q_r, k_r, v_r, do_r, o_r, lse_r), dl_r, b_r, of_r, rest = \
+            unpack(refs)
         dq_r, dq_s = rest
-        _bwd_dq_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, b_r, of_r, dq_r,
-                       dq_s, scale=scale, causal=causal, block_q=block_q,
-                       block_k=block_k, t_q=t_q, t_k=t_k)
+        _bwd_dq_kernel(q_r, k_r, v_r, do_r, o_r, lse_r, dl_r, b_r, of_r,
+                       dq_r, dq_s, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k, t_q=t_q,
+                       t_k=t_k)
 
     dq = _pallas_call(
         dq_kern,
         name="flash_dq",
         grid=(nh, nq, nk),
         in_specs=specs("qk"),
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, 0)),
-        out_shape=jax.ShapeDtypeStruct((nh, t_q, d), q.dtype),
+        out_specs=grad_spec(block_q, lambda a, b: a),
+        out_shape=dq_shape,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )(*args)
 
@@ -542,24 +651,32 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, dlse, scale, causal,
 
 # -- custom VJP -------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, bias, offsets, scale, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q,
-                      block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, offsets, scale, causal, block_q, block_k,
+           layout, n_head, with_lse):
+    o, lse8 = _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q,
+                         block_k, layout, n_head)
+    return (o, lse8) if with_lse else o
 
 
 def _flash_vjp_fwd(q, k, v, bias, offsets, scale, causal, block_q,
-                   block_k):
-    o, lse = _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q,
-                        block_k)
-    return (o, lse), (q, k, v, bias, offsets, o, lse)
+                   block_k, layout, n_head, with_lse):
+    o, lse8 = _flash_fwd(q, k, v, bias, offsets, scale, causal, block_q,
+                         block_k, layout, n_head)
+    out = (o, lse8) if with_lse else o
+    return out, (q, k, v, bias, offsets, o, lse8)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, res, cts):
-    q, k, v, bias, offsets, o, lse = res
-    do, dlse = cts
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, offsets, o, lse, do,
-                                   dlse, scale, causal, block_q, block_k)
+def _flash_vjp_bwd(scale, causal, block_q, block_k, layout, n_head,
+                   with_lse, res, cts):
+    q, k, v, bias, offsets, o, lse8 = res
+    if with_lse:
+        do, dlse8 = cts
+    else:
+        do, dlse8 = cts, None
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, offsets, o, lse8, do,
+                                   dlse8, scale, causal, block_q,
+                                   block_k, layout, n_head)
     doffs = None if offsets is None else \
         np.zeros(offsets.shape, dtype=jax.dtypes.float0)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
@@ -573,21 +690,44 @@ def pallas_flash_attention(q, k, v, bias=None, scale=None, causal=False,
                            block_q=DEFAULT_BLOCK_Q,
                            block_k=DEFAULT_BLOCK_K,
                            q_offset=None, k_offset=None,
-                           return_lse=False):
-    """q/k/v: (N, H, T, D); bias: None or broadcastable (N, 1, 1, Tk).
+                           return_lse=False, layout="nhtd",
+                           n_head=None):
+    """layout="nhtd" (default): q/k/v (N, H, T, D), output (N, H, T, D).
+    layout="nthd": q/k/v (N, T, H*D) head-grouped — the head-major
+    end-to-end contract; `n_head` is required and the batch*head fold
+    happens in the kernel grid, so NO transpose/copy exists at the
+    kernel boundary.  bias: None or broadcastable (N, 1, 1, Tk) in
+    either layout.
 
     q_offset/k_offset: optional GLOBAL position offsets (python ints or
     traced scalars) applied in causal masking — ring attention passes the
     rotated chunk's origin so the causal structure survives sharding.
-    With return_lse=True also returns the per-row logsumexp (N, H, T),
-    differentiable (the dlse cotangent folds into the backward)."""
-    n, h, t_q, d = q.shape
-    t_k = k.shape[2]
+    With return_lse=True also returns the per-row logsumexp —
+    (N, H, T) for nhtd, (N, T, H) for nthd — differentiable (the dlse
+    cotangent folds into the backward)."""
+    if layout == "nthd":
+        if n_head is None:
+            raise ValueError("layout='nthd' needs n_head (operands are "
+                             "(N, T, H*D) head-grouped)")
+        n, t_q, hd = q.shape
+        if hd % n_head != 0:
+            raise ValueError(f"nthd minor dim {hd} not divisible by "
+                             f"n_head {n_head}")
+        h, d = n_head, hd // n_head
+        t_k = k.shape[1]
+        qf, kf, vf = q, k, v
+    elif layout == "nhtd":
+        n, h, t_q, d = q.shape
+        t_k = k.shape[2]
+        qf = q.reshape(n * h, t_q, d)
+        kf = k.reshape(n * h, t_k, d)
+        vf = v.reshape(n * h, t_k, d)
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
     if scale is None:
         scale = d ** -0.5
     if bias is not None:
         bias = jnp.broadcast_to(bias, (n, 1, 1, t_k))
-        bias = jnp.repeat(bias, h, axis=1).reshape(n * h, 1, 1, t_k)
     offsets = None
     if q_offset is not None or k_offset is not None:
         offsets = jnp.stack([
@@ -597,12 +737,17 @@ def pallas_flash_attention(q, k, v, bias=None, scale=None, causal=False,
                         jnp.int32),
         ]).reshape(1, 2)
 
-    qf = q.reshape(n * h, t_q, d)
-    kf = k.reshape(n * h, t_k, d)
-    vf = v.reshape(n * h, t_k, d)
-    o, lse = _flash(qf, kf, vf, bias, offsets, float(scale), bool(causal),
-                    int(block_q), int(block_k))
-    o = o.reshape(n, h, t_q, d)
+    out = _flash(qf, kf, vf, bias, offsets, float(scale), bool(causal),
+                 int(block_q), int(block_k), layout, int(h),
+                 bool(return_lse))
     if return_lse:
-        return o, lse.reshape(n, h, t_q)
-    return o
+        o, lse8 = out
+        lse = lse8[:, 0, :].reshape(n, h, t_q)
+        if layout == "nthd":
+            # per-chunk statistic for ring merging rides (N, T, H) so
+            # it broadcasts against the head-grouped output
+            return o, jnp.moveaxis(lse, 1, 2)
+        return o.reshape(n, h, t_q, d), lse
+    if layout == "nthd":
+        return out
+    return out.reshape(n, h, t_q, d)

@@ -15,6 +15,12 @@ batching).  These are the TPU-native fills:
 
 Both are differentiable (pure jax + collectives) and tested against
 single-device full attention on the virtual CPU mesh.
+
+Operand layouts (matching ops/attention.py): layout="nhtd" takes
+(N, H, T, D); layout="nthd" + n_head takes the head-major head-grouped
+(N, T, H*D) contract — T is then dim 1, the shard axis moves with it,
+and the per-chunk logsumexp statistic rides (N, T_local, H) so merging
+broadcasts against the grouped output without a transpose.
 """
 
 from __future__ import annotations
@@ -26,43 +32,69 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def _local_attention_with_lse(q, k, v, q_off, k_off, scale, causal):
+def _local_attention_with_lse(q, k, v, q_off, k_off, scale, causal,
+                              layout="nhtd", n_head=None):
     """Chunk attention returning (o, lse); positions are global offsets
     so causal masking works across rotated chunks.
-    q: (N, H, Tq, D), k/v: (N, H, Tk, D)."""
-    s = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) * scale
+    nhtd: q (N, H, Tq, D), k/v (N, H, Tk, D), lse (N, H, Tq).
+    nthd: q (N, Tq, H*D), k/v (N, Tk, H*D), lse (N, Tq, H)."""
+    if layout == "nthd":
+        n, t_q, hd = q.shape
+        d = hd // n_head
+        q4 = q.reshape(n, t_q, n_head, d)
+        k4 = k.reshape(n, k.shape[1], n_head, d)
+        v4 = v.reshape(n, v.shape[1], n_head, d)
+        s = jnp.einsum("nqhd,nkhd->nhqk", q4, k4).astype(jnp.float32) \
+            * scale
+    else:
+        s = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) \
+            * scale
     if causal:
-        t_q, t_k = q.shape[2], k.shape[2]
-        q_pos = q_off + jnp.arange(t_q)[:, None]
-        k_pos = k_off + jnp.arange(t_k)[None, :]
+        t_q_, t_k_ = s.shape[-2], s.shape[-1]
+        q_pos = q_off + jnp.arange(t_q_)[:, None]
+        k_pos = k_off + jnp.arange(t_k_)[None, :]
         s = jnp.where(q_pos >= k_pos, s, -1e30)
     m = jnp.max(s, axis=-1, keepdims=True)
     # guard fully-masked rows
     m_safe = jnp.maximum(m, -1e29)
     p = jnp.exp(s - m_safe)
     l = jnp.sum(p, axis=-1, keepdims=True)
+    lse = (m_safe + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (N,H,Tq)
+    if layout == "nthd":
+        o4 = jnp.einsum("nhqk,nkhd->nqhd",
+                        (p / jnp.maximum(l, 1e-30)).astype(q.dtype), v4)
+        return o4.reshape(q.shape), jnp.moveaxis(lse, 1, 2)  # (N,Tq,H)
     o = jnp.einsum("nhqk,nhkd->nhqd", p.astype(q.dtype), v)
-    lse = m_safe + jnp.log(jnp.maximum(l, 1e-30))
     o = o / jnp.maximum(l, 1e-30).astype(o.dtype)
-    return o, lse[..., 0]  # (N,H,Tq,D), (N,H,Tq)
+    return o, lse  # (N,H,Tq,D), (N,H,Tq)
 
 
-def _merge(o_a, lse_a, o_b, lse_b):
-    """Combine two normalized partial attentions via their logsumexps."""
+def _merge(o_a, lse_a, o_b, lse_b, head_shape=None):
+    """Combine two normalized partial attentions via their logsumexps.
+    head_shape: for the nthd layout the grouped (..., H*D) outputs view
+    as (..., H, D) so the per-(N,T,H) weights broadcast; None keeps the
+    nhtd elementwise form."""
     m = jnp.maximum(lse_a, lse_b)
     wa = jnp.exp(lse_a - m)[..., None]
     wb = jnp.exp(lse_b - m)[..., None]
-    o = (o_a.astype(jnp.float32) * wa + o_b.astype(jnp.float32) * wb) / \
+    oa, ob = o_a, o_b
+    if head_shape is not None:
+        oa = o_a.reshape(o_a.shape[:-1] + head_shape)
+        ob = o_b.reshape(o_b.shape[:-1] + head_shape)
+    o = (oa.astype(jnp.float32) * wa + ob.astype(jnp.float32) * wb) / \
         (wa + wb)
+    if head_shape is not None:
+        o = o.reshape(o_a.shape)
     lse = m + jnp.log(wa[..., 0] + wb[..., 0])
     return o.astype(o_a.dtype), lse
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
                    causal: bool = False, use_pallas=None,
-                   batch_axis=None):
-    """q/k/v: GLOBAL (N, H, T, D) logically sharded over T on `axis`.
-    Returns the full attention output with the same sharding.
+                   batch_axis=None, layout: str = "nhtd", n_head=None):
+    """q/k/v: GLOBAL (N, H, T, D) — or (N, T, H*D) with layout="nthd"
+    + n_head — logically sharded over T on `axis`.  Returns the full
+    attention output with the same sharding.
 
     use_pallas: route each rotated chunk through the tiled Pallas flash
     kernel (forward AND backward O(t_local) memory, causal masking via
@@ -75,9 +107,18 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     n_dev = mesh.shape[axis]
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    t_total = q.shape[2]
+    t_axis = 1 if layout == "nthd" else 2
+    if layout == "nthd":
+        if not n_head:
+            raise ValueError("ring_attention layout='nthd' needs n_head")
+        head_shape = (n_head, q.shape[-1] // n_head)
+        if scale is None:
+            scale = head_shape[1] ** -0.5
+    else:
+        head_shape = None
+        if scale is None:
+            scale = q.shape[-1] ** -0.5
+    t_total = q.shape[t_axis]
     t_local = t_total // n_dev
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
@@ -87,9 +128,11 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
 
             return pallas_flash_attention(
                 q_l, k_cur, v_cur, scale=scale, causal=causal,
-                q_offset=q_off, k_offset=k_off, return_lse=True)
+                q_offset=q_off, k_offset=k_off, return_lse=True,
+                layout=layout, n_head=n_head)
         return _local_attention_with_lse(q_l, k_cur, v_cur, q_off, k_off,
-                                         scale, causal)
+                                         scale, causal, layout=layout,
+                                         n_head=n_head)
 
     def local_fn(q_l, k_l, v_l):
         idx = jax.lax.axis_index(axis)
@@ -101,38 +144,57 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", scale=None,
             src = (idx - j) % n_dev
             k_off = src * t_local
             o_j, lse_j = chunk_attn(q_l, k_cur, v_cur, q_off, k_off)
-            o, lse = _merge(o, lse, o_j, lse_j)
+            o, lse = _merge(o, lse, o_j, lse_j, head_shape=head_shape)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return o, lse, k_nxt, v_nxt
 
         o0 = jnp.zeros_like(q_l)
-        lse0 = jnp.full(q_l.shape[:-1], -1e30, jnp.float32)
+        if layout == "nthd":
+            lse0 = jnp.full(q_l.shape[:-1] + (head_shape[0],), -1e30,
+                            jnp.float32)
+        else:
+            lse0 = jnp.full(q_l.shape[:-1], -1e30, jnp.float32)
         o, lse, _, _ = jax.lax.fori_loop(
             0, n_dev, body, (o0, lse0, k_l, v_l))
         return o
 
     b_ax = (batch_axis if batch_axis
             and mesh.shape.get(batch_axis, 1) > 1 else None)
-    spec = P(b_ax, None, axis, None)
+    if layout == "nthd":
+        spec = P(b_ax, axis, None)
+    else:
+        spec = P(b_ax, None, axis, None)
     fn = compat_shard_map(local_fn, mesh, (spec, spec, spec), spec)
     return fn(q, k, v)
 
 
 def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
                       causal: bool = False, use_pallas=None,
-                      batch_axis=None):
+                      batch_axis=None, layout: str = "nhtd",
+                      n_head=None):
     """Ulysses sequence parallelism: a2a seq→heads, dense local
-    attention, a2a heads→seq.  q/k/v: GLOBAL (N, H, T, D) sharded over T
-    on `axis`; H must be divisible by the axis size.  use_pallas None =
-    auto (Pallas kernel on TPU), same convention as ring_attention;
-    batch_axis keeps dp-sharded batches sharded inside the shard_map."""
+    attention, a2a heads→seq.  q/k/v: GLOBAL (N, H, T, D) — or
+    (N, T, H*D) head-grouped with layout="nthd" + n_head — sharded over
+    T on `axis`; H must be divisible by the axis size (the grouped
+    minor dim splits into whole heads, so the a2a chunks are
+    head-aligned).  use_pallas None = auto (Pallas kernel on TPU), same
+    convention as ring_attention; batch_axis keeps dp-sharded batches
+    sharded inside the shard_map."""
     from .collectives import compat_shard_map
 
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     n_dev = mesh.shape[axis]
-    n, h, t, d = q.shape
+    if layout == "nthd":
+        if not n_head:
+            raise ValueError("ulysses_attention layout='nthd' needs "
+                             "n_head")
+        h, d = n_head, q.shape[-1] // n_head
+        seq_axis, head_axis = 1, 2
+    else:
+        n, h, t, d = q.shape
+        seq_axis, head_axis = 2, 1
     if h % n_dev != 0:
         raise ValueError(f"Ulysses needs heads ({h}) divisible by "
                          f"mesh axis {axis!r} size ({n_dev})")
@@ -141,27 +203,37 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp", scale=None,
 
     def local_fn(q_l, k_l, v_l):
         def seq_to_heads(x):
-            # (N, H, T/P, D) -> (N, H/P, T, D)
-            return jax.lax.all_to_all(x, axis, split_axis=1,
-                                      concat_axis=2, tiled=True)
+            # nhtd: (N, H, T/P, D) -> (N, H/P, T, D)
+            # nthd: (N, T/P, H*D) -> (N, T, (H/P)*D) — the grouped
+            # minor dim splits on whole-head boundaries (H % P == 0)
+            return jax.lax.all_to_all(x, axis, split_axis=head_axis,
+                                      concat_axis=seq_axis, tiled=True)
 
         def heads_to_seq(x):
-            return jax.lax.all_to_all(x, axis, split_axis=2,
-                                      concat_axis=1, tiled=True)
+            return jax.lax.all_to_all(x, axis, split_axis=seq_axis,
+                                      concat_axis=head_axis, tiled=True)
 
         qh, kh, vh = seq_to_heads(q_l), seq_to_heads(k_l), seq_to_heads(v_l)
+        local_heads = h // n_dev
         if use_pallas:
             from ..ops.pallas.flash_attention import pallas_flash_attention
 
             oh = pallas_flash_attention(qh, kh, vh, scale=scale,
-                                        causal=causal)
+                                        causal=causal, layout=layout,
+                                        n_head=(local_heads
+                                                if layout == "nthd"
+                                                else None))
         else:
-            oh, _ = _local_attention_with_lse(qh, kh, vh, 0, 0, scale,
-                                              causal)
+            oh, _ = _local_attention_with_lse(
+                qh, kh, vh, 0, 0, scale, causal, layout=layout,
+                n_head=local_heads if layout == "nthd" else None)
         return heads_to_seq(oh)
 
     b_ax = (batch_axis if batch_axis
             and mesh.shape.get(batch_axis, 1) > 1 else None)
-    spec = P(b_ax, None, axis, None)
+    if layout == "nthd":
+        spec = P(b_ax, axis, None)
+    else:
+        spec = P(b_ax, None, axis, None)
     fn = compat_shard_map(local_fn, mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

@@ -162,7 +162,7 @@ def check_schema(candidate):
 
 
 def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
-                   regressions, report, tol_mem=0.10):
+                   regressions, report, tol_mem=0.10, tol_ls=0.02):
     if "error" in cand and "error" not in base:
         regressions.append(f"{name}: candidate errored: "
                            f"{cand['error']}")
@@ -213,10 +213,24 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
         report.append(line)
         if rise > tol_mem:
             regressions.append(line + f" exceeds tol {tol_mem:.0%}")
+    # layout traffic: the layout-bucket byte share of the step
+    # (transpose/copy — the r05 longctx finding).  ABSOLUTE share-point
+    # increase gates: after the head-major layout (ISSUE 8) deleted the
+    # boundary transposes, a change that quietly reintroduces them is a
+    # regression even when throughput noise hides it at small steps.
+    bls, cls = base.get("layout_share"), cand.get("layout_share")
+    if isinstance(bls, (int, float)) and isinstance(cls, (int, float)):
+        rise = cls - bls
+        line = (f"{name}.layout_share: {bls:.4f} -> {cls:.4f} "
+                f"({rise:+.4f})")
+        report.append(line)
+        if rise > tol_ls:
+            regressions.append(
+                line + f" exceeds tol +{tol_ls:.2f} share points")
 
 
 def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
-         tol_mem=0.10, allow_missing=False):
+         tol_mem=0.10, tol_ls=0.02, allow_missing=False):
     """(regressions, report_lines, compared_count).  Only entries whose
     device kind matches are compared — a CPU smoke candidate never
     false-fails against chip numbers."""
@@ -242,7 +256,8 @@ def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
             continue
         compared += 1
         _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
-                       regressions, report, tol_mem=tol_mem)
+                       regressions, report, tol_mem=tol_mem,
+                       tol_ls=tol_ls)
         if "int8" in base and isinstance(cand.get("int8"), dict) \
                 and "error" not in base["int8"]:
             if "error" in cand["int8"]:
@@ -277,6 +292,13 @@ def main() -> int:
                         "entry (mem_breakdown.peak_bytes; a step "
                         "quietly growing toward OOM is a regression "
                         "even when throughput holds)")
+    p.add_argument("--tol-layout-share", type=float, default=0.02,
+                   help="tolerated ABSOLUTE increase in an entry's "
+                        "layout_share (the layout-bucket byte "
+                        "fraction, observe.cost) — transpose traffic "
+                        "creeping back after the head-major layout "
+                        "(ISSUE 8) is a regression even when "
+                        "throughput noise hides it")
     p.add_argument("--allow-missing", action="store_true",
                    help="baseline entries absent from the candidate "
                         "are not regressions (partial --model runs)")
@@ -326,7 +348,8 @@ def main() -> int:
     regressions, report, compared = gate(
         baseline, candidate, tol_mfu=args.tol_mfu,
         tol_tp=args.tol_throughput, tol_lat=args.tol_latency,
-        tol_mem=args.tol_peak_mem, allow_missing=args.allow_missing)
+        tol_mem=args.tol_peak_mem, tol_ls=args.tol_layout_share,
+        allow_missing=args.allow_missing)
     for line in report:
         print("  " + line)
     if compared == 0:

@@ -3,13 +3,21 @@ perf-feature configuration on the real chip and write a combined
 AB artifact with the winners, so every bench default reflects a
 measured win.
 
-Usage: python tools/run_ab.py [--steps N] [--out AB_r06.json]
+Usage: python tools/run_ab.py [--steps N] [--out AB_r07.json]
 Each variant is a separate bench.py subprocess (fresh backend, no cache
 cross-talk); the probe inside bench.py keeps a dead backend from
 burning the timeout.
 
-r06 adds the scan-bound lstm variants (unroll sweep + the Pallas fused
-recurrence kernel vs the scan base).  Entries recorded off-chip carry
+r06 added the scan-bound lstm variants (unroll sweep + the Pallas fused
+recurrence kernel vs the scan base).  r07 adds the head-major layout
+variants (ISSUE 8): transformer_headmajor / transformer_pallas_headmajor
+record the layout at the short-seq headline shape — the latter is the
+r05 pallas-attn crossover question (136.7k vs 157.1k tok/s at len256:
+does deleting the boundary transposes flip it?) — and
+longctx_8k_headmajor is the headline lever (the r05 profile's ~15.9 s
+of copy/transpose).  Every transformer/longctx entry now carries
+`layout_share` so the summary's throughput verdicts come with the
+layout-traffic delta attached.  Entries recorded off-chip carry
 their producing backend in each entry's `device` field — a
 CPU-recorded win ("cpu (assumed v5e peak)") documents the harness but
 does NOT flip a TPU bench default.
@@ -42,6 +50,20 @@ VARIANTS = [
                                 "--fused-qkv"]),
     ("transformer_pallas_attn", ["--model", "transformer",
                                  "--pallas-attn", "--no-fused-ce"]),
+    # head-major layouts (ISSUE 8): activations stay in the flash
+    # kernels' head-grouped convention end-to-end — zero transposes at
+    # kernel boundaries.  NOTE head-major also routes decoder CROSS
+    # attention through the flash op (the composed path would
+    # reintroduce the transposes), recorded in each entry's
+    # head_major/flash fields.
+    ("transformer_headmajor", ["--model", "transformer",
+                               "--head-major", "--no-fused-ce"]),
+    # the r05 short-seq crossover question: pallas-attn lost 136.7k vs
+    # 157.1k tok/s at len256 with the transpose round-trip; this is the
+    # same kernel with the round-trip deleted
+    ("transformer_pallas_headmajor", ["--model", "transformer",
+                                      "--pallas-attn", "--head-major",
+                                      "--no-fused-ce"]),
     # long-context (VERDICT r4 item 7): Pallas flash (self+cross) +
     # fused-CE + recompute is the default longctx stack; the xla twin
     # runs the same shape through the XLA flash composition to check
@@ -58,6 +80,10 @@ VARIANTS = [
     # measured 0.3035 vs 0.2405 (bs2/8k fits without remat); the
     # recompute variant stays recorded for the memory-constrained case
     ("longctx_8k_recompute", ["--model", "longctx", "--recompute"]),
+    # head-major longctx: THE identified r05 lever — the recorded
+    # device profile showed ~15.9 s copy/transpose in-flight against
+    # ~5.0 s flash-kernel time; head-major deletes that traffic class
+    ("longctx_8k_headmajor", ["--model", "longctx", "--head-major"]),
     # shape probes (r05 chip session): both LOSE to the defaults
     # (bs4 longctx 0.2322 vs 0.2405; bs128 transformer 0.3046 vs
     # 0.3254 — bs64/len256 confirmed as the sweet spot)
@@ -206,6 +232,24 @@ def mem_measure(results, k):
     return d.get("peak_mem_bytes") or None
 
 
+def layout_measure(results, k):
+    """The variant's layout_share (layout-bucket byte fraction of the
+    measured step, bench.py/_layout_fields), or None for NO DATA —
+    context for the head-major pairs; throughput still decides."""
+    d = results.get(k, {})
+    if "error" in d or "failed" in d or \
+            d.get("metric") == "bench_failed":
+        return None
+    detail = d.get("detail") or {}
+    model = _VARIANT_MODEL.get(k)
+    subs = (_model_entries(detail, model) if model is not None
+            else [sub for sub in detail.values() if isinstance(sub, dict)])
+    for sub in subs:
+        if isinstance(sub.get("layout_share"), (int, float)):
+            return sub["layout_share"]
+    return None
+
+
 def wins(results, a, b):
     # a missing side must yield "no data", never a vacuous win —
     # AB wins gate bench defaults (CLAUDE.md measured-wins-only).
@@ -228,6 +272,13 @@ _PAIRS = {
     "pallas_attn": ("transformer_pallas_attn", "transformer_base"),
     "longctx_pallas": ("longctx_8k_pallas", "longctx_8k_xla"),
     "longctx_recompute": ("longctx_8k_recompute", "longctx_8k_pallas"),
+    # head-major layout verdicts (ISSUE 8): throughput decides as
+    # everywhere; the layout_share delta rides compute_summary so the
+    # traffic deletion is visible next to the wall-clock verdict
+    "headmajor": ("transformer_headmajor", "transformer_base"),
+    "pallas_attn_headmajor": ("transformer_pallas_headmajor",
+                              "transformer_base"),
+    "longctx_headmajor": ("longctx_8k_headmajor", "longctx_8k_pallas"),
     "lstm_unroll2": ("lstm_unroll2", "lstm_base"),
     "lstm_unroll4": ("lstm_unroll4", "lstm_base"),
     "lstm_unroll8": ("lstm_unroll8", "lstm_base"),
@@ -247,6 +298,12 @@ def compute_summary(results):
             # paid for in HBM is now visible in the same artifact
             out[f"{name}_mem_delta_bytes"] = pa - pb
             out[f"{name}_mem_peaks"] = {a: pa, b: pb}
+        la, lb = layout_measure(results, a), layout_measure(results, b)
+        if la is not None and lb is not None:
+            # negative = variant a moves FEWER layout bytes than b —
+            # the head-major traffic-deletion claim, recorded next to
+            # the throughput verdict that decides the default
+            out[f"{name}_layout_share"] = {a: la, b: lb}
     return out
 
 
@@ -254,7 +311,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r06.json")
+    p.add_argument("--out", default="AB_r07.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     p.add_argument("--bench-args", default=None,
