@@ -112,8 +112,14 @@ class Trainer:
         window (never per-step — CLAUDE.md tunnel-backend rule).
 
         step_deadline_s: wall-clock watchdog around each training step
-        (resilience.Deadline) — a hung compile/dispatch raises a
-        structured WatchdogTimeout instead of stalling forever.
+        (resilience.DispatchWatchdog) — a hung dispatch raises a
+        structured StepHangError instead of stalling forever, after
+        emitting a `step_hang` event and poisoning the gang when the
+        health plane is active.  The FIRST step (no completed dispatch
+        yet — XLA legitimately compiles for minutes) gets the longer
+        compile-grace budget; steady-state steps get step_deadline_s
+        (a previously-working step that stops returning is the
+        hung-collective signature).
 
         preempt_drain: install the SIGTERM/SIGINT drain handler at
         train() start (resilience.preempt; main-thread-only, degrades
@@ -155,6 +161,8 @@ class Trainer:
             self._uname_ids = dict(unique_name.generator.ids)
         self._ckpt_writer = None       # lazy SnapshotWriter (async_save)
         self._pending_save = None      # in-flight resilience.PendingSave
+        self._step_watchdog = None     # DispatchWatchdog (step_deadline_s)
+        self._gang_steps = 0           # heartbeat step counter (beat())
         self._active_reader = None
         self._resume_reader_state = None
         self.ckpt_stats = {"saves": 0, "blocking_ms": 0.0,
@@ -477,11 +485,38 @@ class Trainer:
         data.decorator.shuffle(seed=...)); a reader exposing
         state_dict()/load_state_dict() gets its state checkpointed and
         restored too."""
+        from ..resilience import health as gang_health
         from ..resilience import preempt
 
         handler = event_handler or (lambda e: None)
         if self.preempt_drain:
             preempt.install_preempt_handler()
+        # gang fault tolerance: when init_distributed registered the
+        # health plane, every rank bumps its heartbeat step counter and
+        # consults the LOCAL alarm/poison cache between steps (the
+        # monitor thread does the KV RPCs — nothing here touches the
+        # jitted step or adds per-step host round-trips)
+        plane = gang_health.get_health_plane()
+        if plane is not None:
+            if self._event_log:
+                plane.attach_event_log(self._event_log)
+            plane.check()  # a poisoned gang must not start stepping
+        if self.step_deadline_s and self._step_watchdog is None:
+            from ..resilience.watchdog import DispatchWatchdog
+
+            def _on_hang(fields):
+                # a hang detected HERE is gang-fatal: poison so peers
+                # abort their barriers/steps instead of waiting out
+                # their own timeouts on this wedged rank
+                if plane is not None:
+                    plane.poison(
+                        f"step hang on rank {plane.rank}: "
+                        f"{fields.get('what')}", kind="step_hang",
+                        hang=fields)
+
+            self._step_watchdog = DispatchWatchdog(
+                self.step_deadline_s, event_log=self._event_log,
+                on_hang=_on_hang)
         self._active_reader = reader
         if (self._resume_reader_state is not None and reader is not None
                 and hasattr(reader, "load_state_dict")):
@@ -521,17 +556,24 @@ class Trainer:
                     batch = dict(zip(feed_order, batch))
                 begin = BeginStepEvent(epoch, step)
                 handler(begin)
-                from ..resilience.watchdog import Deadline
+                if self._step_watchdog is not None:
+                    guard = self._step_watchdog.guard(
+                        what=f"train step {epoch}/{step}")
+                else:
+                    import contextlib
 
-                with scope_guard(self.scope), \
-                        Deadline(self.step_deadline_s or 0,
-                                 what=f"train step {epoch}/{step}"):
+                    guard = contextlib.nullcontext()
+                with scope_guard(self.scope), guard:
                     metrics = self.exe.run(
                         self.train_program, feed=batch,
                         fetch_list=fetch if begin.fetch_metrics else [])
                 handler(EndStepEvent(epoch, step, metrics))
                 step += 1
                 done += 1
+                if plane is not None:
+                    self._gang_steps += 1
+                    plane.beat(self._gang_steps)
+                    plane.check()  # raises PeerLost/Stalled/Poisoned
                 if (self.telemetry_cfg is not None and
                         done % self.telemetry_cfg.interval == 0):
                     tel_snap = self._publish_telemetry(epoch, step,

@@ -110,6 +110,36 @@ FLAGS.define("paddle_num_threads", 2,
 FLAGS.define("rpc_deadline", 180000,
              "multi-host bootstrap timeout in ms "
              "(jax.distributed initialization)")
+# Resilience timeouts (docs/RESILIENCE.md has the one table; every knob
+# below also answers to the usual FLAGS_<name> env override).  These
+# unify the previously scattered knobs: the checkpoint-barrier timeout
+# (legacy env PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S still wins, see
+# io.barrier_timeout_s), the health-plane heartbeat cadence, and the
+# gang supervisor's grace/backoff schedule.
+FLAGS.define("ckpt_barrier_timeout_s", 600.0,
+             "cross-process checkpoint barrier timeout; legacy env "
+             "PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S overrides when set")
+FLAGS.define("heartbeat_interval_s", 1.0,
+             "health plane: seconds between a rank's KV-store "
+             "heartbeats (resilience/health.py)")
+FLAGS.define("heartbeat_miss_budget", 5,
+             "health plane: a peer whose heartbeat has not changed for "
+             "interval*budget seconds is declared lost (PeerLostError)")
+FLAGS.define("gang_stall_timeout_s", 0.0,
+             "health plane: a peer heartbeating but with a frozen step "
+             "counter for this long is declared stalled "
+             "(PeerStalledError); 0 disables — the dispatch watchdog "
+             "is the primary hung-step detector")
+FLAGS.define("supervisor_grace_s", 10.0,
+             "gang supervisor: seconds a broken gang's survivors get "
+             "between SIGTERM and SIGKILL")
+FLAGS.define("supervisor_max_restarts", 3,
+             "gang supervisor: total relaunches before GangFailedError")
+FLAGS.define("supervisor_backoff_base_s", 1.0,
+             "gang supervisor: failure-restart backoff base "
+             "(base * 2**failures, deterministic retry_call schedule)")
+FLAGS.define("supervisor_backoff_max_s", 30.0,
+             "gang supervisor: failure-restart backoff cap")
 # Determinism aliases (reference FLAGS_cudnn_deterministic pinned conv
 # algos; XLA/TPU kernels are deterministic by construction)
 FLAGS.define("cudnn_deterministic", True,

@@ -28,7 +28,8 @@ from .core.desc import (PROGRAM_FORMAT_VERSION, dump_program_dict,
                         load_program_dict)
 from .core.executor import Executor, Scope, global_scope
 from .core.program import Parameter, Program, Variable
-from .resilience.errors import (CheckpointBarrierTimeoutError,
+from .resilience.errors import (CheckpointBarrierPoisonedError,
+                                CheckpointBarrierTimeoutError,
                                 CheckpointCorruptError,
                                 CheckpointFormatError,
                                 CheckpointIncompleteError,
@@ -295,6 +296,17 @@ class ShardedSaveJob:
 
         t0 = _time.perf_counter()
         dirname, proc = self.dirname, self.proc
+        # a save whose manifest references ONLY this process's shard
+        # file is process-LOCAL (per-rank private checkpoints — e.g. a
+        # KV-only gang where each rank trains its own model): no peer
+        # participates in this directory, so the cross-process barriers
+        # must not couple unrelated saves (restarted ranks resume at
+        # different cursors — a gang-wide barrier here would deadlock
+        # their drifted save cadences), and THIS process writes the
+        # manifest (the proc-0 convention is for gang-wide saves)
+        local_only = ({sh["file"] for m in self.meta.values()
+                       for sh in m["shards"]}
+                      <= {f"shards_p{proc}.npz"})
         # chaos hook: tests arm a delay here to prove a slow write
         # phase does not stall the step loop (async acceptance test)
         delaypoint("ckpt:write")
@@ -310,7 +322,8 @@ class ShardedSaveJob:
             with open(os.path.join(dirname, f"shards_p{proc}.crc.json"),
                       "w") as f:
                 json.dump(crcs, f)
-            _barrier("save_sharded:shards")
+            if not local_only:
+                _barrier("save_sharded:shards")
         except CheckpointBarrierTimeoutError:
             self._cleanup_partial()
             raise
@@ -322,7 +335,7 @@ class ShardedSaveJob:
         # shard files exist — its presence marks the checkpoint
         # complete, so a process preempted mid-save can never leave a
         # torn-but-loadable checkpoint behind
-        if proc == 0:
+        if proc == 0 or local_only:
             all_crcs: dict = {}
             for sfile in {sh["file"] for m in self.meta.values()
                           for sh in m["shards"]}:
@@ -343,7 +356,8 @@ class ShardedSaveJob:
                            "vars": self.meta}, f, indent=1)
             os.replace(tmp, os.path.join(dirname, SHARD_MANIFEST))
         try:
-            _barrier("save_sharded:manifest")
+            if not local_only:
+                _barrier("save_sharded:manifest")
         except CheckpointBarrierTimeoutError:
             # proc 0 already renamed the manifest: the checkpoint is
             # complete and loadable; non-zero procs only lose the sync.
@@ -465,13 +479,19 @@ def _dist_client():
 def barrier_timeout_s() -> float:
     """Checkpoint-barrier timeout (seconds).  Generous default — a
     slow peer flushing a big shard is normal; a dead one should fail
-    in minutes, not hang the job forever.  Override via
-    PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S."""
-    try:
-        return float(os.environ.get(
-            "PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S", "600"))
-    except ValueError:
-        return 600.0
+    in minutes, not hang the job forever.  The knob is
+    FLAGS.ckpt_barrier_timeout_s (docs/RESILIENCE.md knob table); the
+    legacy env PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S still wins when set
+    (pre-unification callers keep working)."""
+    legacy = os.environ.get("PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S")
+    if legacy is not None:
+        try:
+            return float(legacy)
+        except ValueError:
+            pass
+    from .flags import FLAGS
+
+    return float(FLAGS.ckpt_barrier_timeout_s)
 
 
 def _barrier(tag: str, timeout_s: Optional[float] = None):
@@ -504,16 +524,8 @@ def _barrier(tag: str, timeout_s: Optional[float] = None):
     prefix = f"ptpu_ckpt_barrier/{tag}/{seq}/"
     proc = jax.process_index()
     client.key_value_set(prefix + str(proc), "ok")
-    deadline = _time.monotonic() + timeout_s
-    missing = []
-    for p in range(jax.process_count()):
-        if p == proc:
-            continue
-        remaining_ms = max(1, int((deadline - _time.monotonic()) * 1000))
-        try:
-            client.blocking_key_value_get(prefix + str(p), remaining_ms)
-        except Exception:  # noqa: BLE001 — jaxlib raises XlaRuntimeError
-            missing.append(p)
+    peers = [p for p in range(jax.process_count()) if p != proc]
+    missing = _wait_barrier_peers(client, prefix, peers, tag, timeout_s)
     if missing:
         raise CheckpointBarrierTimeoutError(
             f"checkpoint barrier {tag!r} timed out after {timeout_s:.0f}s"
@@ -522,6 +534,97 @@ def _barrier(tag: str, timeout_s: Optional[float] = None):
             f"inside a sharded save", tag=tag, timeout_s=timeout_s,
             missing_ranks=missing, dirname=None,
             process_count=jax.process_count())
+
+
+# while a barrier waits, the gang poison key is re-checked this often:
+# the bounded-time bridge between "a peer died" and "this save fails"
+# (well under the 600 s barrier default)
+_BARRIER_POISON_POLL_S = 1.0
+
+
+def _check_barrier_poison(client, tag: str, elapsed_s: float,
+                          timeout_s: float) -> None:
+    """Abort a waiting barrier the moment the gang is known broken —
+    the survivors stop burning the full barrier timeout on a peer that
+    is already known dead.  Two sources, in order: the LOCAL health
+    monitor's latched alarm (still works when the KV store died with
+    the coordinator — the poison key is unreachable exactly then), and
+    the gang poison KEY (a peer's monitor/watchdog declared the break).
+    Poison-read failures are swallowed: a dying KV store is the local
+    alarm's / plain-timeout path's business."""
+    from .resilience import health as _health
+
+    plane = _health.get_health_plane()
+    if plane is not None:
+        alarm = plane.monitor.alarm()
+        if alarm is not None:
+            details = getattr(alarm, "details", {})
+            raise CheckpointBarrierPoisonedError(
+                f"checkpoint barrier {tag!r} aborted after "
+                f"{elapsed_s:.1f}s: local health alarm — {alarm}",
+                tag=tag, timeout_s=timeout_s,
+                poison={"rank": plane.rank, "reason": str(alarm),
+                        "kind": getattr(alarm, "kind", "alarm"),
+                        "missing_ranks":
+                        details.get("missing_ranks",
+                                    details.get("stalled_ranks", []))},
+                elapsed_s=round(elapsed_s, 3),
+                missing_ranks=details.get(
+                    "missing_ranks", details.get("stalled_ranks", [])),
+                dirname=None)
+    try:
+        poison = _health.read_poison(client)
+    except Exception:  # noqa: BLE001
+        return
+    if poison is None:
+        return
+    raise CheckpointBarrierPoisonedError(
+        f"checkpoint barrier {tag!r} aborted after {elapsed_s:.1f}s: "
+        f"gang poisoned by rank {poison.get('rank')} — "
+        f"{poison.get('reason')} (kind={poison.get('kind')})",
+        tag=tag, timeout_s=timeout_s, poison=poison,
+        elapsed_s=round(elapsed_s, 3),
+        missing_ranks=poison.get("missing_ranks", []), dirname=None)
+
+
+def _wait_barrier_peers(client, prefix: str, peers, tag: str,
+                        timeout_s: float,
+                        poison_poll_s: float = None) -> list:
+    """Wait for every peer's arrival key, checking the gang poison key
+    between short blocking-get slices.  Returns the ranks that never
+    arrived (empty = all arrived); raises
+    CheckpointBarrierPoisonedError on poison.  Factored out of
+    _barrier so the poison fast-path is unit-testable with a FakeKv
+    (the real thing is proven by the gang_worker chaos harness)."""
+    import time as _time
+
+    if poison_poll_s is None:
+        poison_poll_s = _BARRIER_POISON_POLL_S
+    start = _time.monotonic()
+    deadline = start + timeout_s
+    _check_barrier_poison(client, tag, 0.0, timeout_s)
+    missing = []
+    for p in peers:
+        arrived = False
+        while True:
+            remaining = deadline - _time.monotonic()
+            # even past the deadline every peer gets one 1 ms look —
+            # a rank that arrived while we waited on another must not
+            # be reported missing (the pre-slicing semantics)
+            slice_ms = max(1, int(min(poison_poll_s,
+                                      max(remaining, 0.001)) * 1000))
+            try:
+                client.blocking_key_value_get(prefix + str(p), slice_ms)
+                arrived = True
+                break
+            except Exception:  # noqa: BLE001 — jaxlib raises XlaRuntimeError
+                _check_barrier_poison(
+                    client, tag, _time.monotonic() - start, timeout_s)
+                if remaining <= 0:
+                    break
+        if not arrived:
+            missing.append(p)
+    return missing
 
 
 def _barrier_fallback(tag: str, timeout_s: float):

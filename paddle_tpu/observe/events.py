@@ -50,6 +50,30 @@ RESILIENCE_EVENTS = (
     "ckpt_emergency",       # the drain path's final checkpoint landed
 )
 
+# gang fault-tolerance event kinds (docs/RESILIENCE.md, distributed
+# failure model): health-plane detections, the dispatch watchdog's
+# pre-abort record, straggler telemetry, and the supervisor lifecycle
+GANG_EVENTS = (
+    "peer_lost",       # LOUD: a peer stopped heartbeating (or the KV
+    #                    store died with the coordinator); missing
+    #                    ranks + staleness age attached
+    "peer_stalled",    # a peer heartbeats but its step counter froze
+    "step_hang",       # dispatch watchdog: a step blew its budget —
+    #                    emitted BEFORE the abort, with the
+    #                    first-compile vs hung-step verdict and the
+    #                    runtime_stats deltas observed in the region
+    "gang_skew",       # periodic per-rank step/step-rate snapshot
+    #                    from heartbeat timestamps (straggler
+    #                    telemetry before real multi-chip exists)
+    "rank_slow",       # LOUD: one rank's step rate lags the gang
+    #                    median by more than the slow factor
+    "gang_start",      # supervisor: one gang attempt spawned
+    "gang_restart",    # supervisor: attempt ended broken; relaunching
+    "gang_end",        # supervisor: attempt ended clean
+    "gang_failed",     # LOUD: restart budget exhausted — per-attempt
+    #                    exit codes attached
+)
+
 
 def new_run_id() -> str:
     """Short unique id for one run/invocation (12 hex chars)."""

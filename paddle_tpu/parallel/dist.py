@@ -27,13 +27,21 @@ import numpy as np
 def init_distributed(trainer_id: Optional[int] = None,
                      num_trainers: Optional[int] = None,
                      coordinator: Optional[str] = None,
-                     local_device_ids=None):
+                     local_device_ids=None, health: bool = True):
     """Bootstrap the multi-host runtime (gen_nccl_id analog).
 
     Arguments default to the reference's cluster env vars:
     PADDLE_TRAINER_ID, PADDLE_TRAINERS, PADDLE_COORDINATOR (or the first
     entry of PADDLE_TRAINER_ENDPOINTS, matching how the reference used
     trainer 0's endpoint as the NCCLID broadcast root).
+
+    When `num_trainers > 1` the distributed HEALTH PLANE
+    (resilience/health.py: heartbeats + peer-loss monitor + the gang
+    poison key) starts automatically on the same KV store — existing
+    multi-trainer callers inherit bounded-time failure detection for
+    free; pass `health=False` to opt out (the reference's pserver
+    heartbeat analog, so a dead rank becomes a structured
+    PeerLostError instead of a hang in the next collective).
 
     Safe to call when num_trainers == 1 (no-op).  Returns
     (trainer_id, num_trainers).
@@ -67,13 +75,38 @@ def init_distributed(trainer_id: Optional[int] = None,
         # the gRPC client the same way; ms → s)
         initialization_timeout=max(1, int(FLAGS.rpc_deadline / 1000)),
     )
+    if health:
+        from ..resilience import health as _health
+
+        _health.start_health_plane(rank=trainer_id,
+                                   num_ranks=num_trainers)
     return trainer_id, num_trainers
 
 
 def shutdown_distributed():
+    """Tear down the multi-host runtime.  Idempotent: safe to call
+    twice, and safe when init_distributed never ran (or no-op'd at
+    num_trainers == 1) — teardown paths (atexit hooks, finally blocks,
+    test fixtures) must never crash on a not-running runtime.  Also
+    stops the health plane first so its threads don't race a dying KV
+    client."""
     import jax
 
-    jax.distributed.shutdown()
+    from ..resilience import health as _health
+
+    _health.stop_health_plane()
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+    except Exception:  # noqa: BLE001 — private API, version-dependent
+        client = None
+    if client is None:
+        return  # never initialized (or already shut down)
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # raced another teardown path: already down
 
 
 def make_multihost_mesh(ici_axes: Dict[str, int],

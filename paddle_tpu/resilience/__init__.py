@@ -21,28 +21,51 @@ failures): this subsystem survives them (docs/RESILIENCE.md):
   the SIGTERM/SIGINT drain controller contrib.Trainer uses to finish
   the in-flight step, write an emergency checkpoint, and exit with
   `PREEMPT_EXIT_CODE`,
+- `health`: the distributed health plane — per-rank KV-store
+  heartbeats, a monitor raising structured `PeerLostError`/
+  `PeerStalledError` within a configured miss budget, the gang
+  **poison key** every rank (and `io._barrier`) checks so one failure
+  becomes a bounded-time gang-wide abort, and per-rank step-rate skew
+  telemetry (`gang_skew`/`rank_slow` events),
+- `supervisor`: the self-healing gang supervisor — spawns N worker
+  processes, translates the exit-code registry (77 preempt-drain /
+  43 peer-lost), kills the remainder of a broken gang within a grace
+  period, and relaunches with a restart budget + deterministic
+  backoff, resuming from the newest valid checkpoint
+  (`tools/launch_gang.py` is the CLI),
 - `chaos`: deterministic fault injectors (failpoints, delaypoints, NaN
   batches, shard corruption, torn checkpoints, executor failure
-  bursts) that the tests and the CI chaos smoke use to prove all of
-  the above.
+  bursts, env-armed per-rank kill/hang for gang workers, `FakeKv`)
+  that the tests and the CI chaos smokes use to prove all of the
+  above.
 """
 
 from . import chaos  # noqa: F401
+from . import health  # noqa: F401
 from . import preempt  # noqa: F401
-from .chaos import (ChaosKilled, FlakyPredictor,  # noqa: F401
-                    corrupt_file, corrupt_shard, nan_reader,
-                    poison_feed, tear_checkpoint)
-from .errors import (CheckpointBarrierTimeoutError,  # noqa: F401
+from . import supervisor  # noqa: F401
+from .chaos import (ChaosKilled, FakeKv, FlakyPredictor,  # noqa: F401
+                    corrupt_file, corrupt_shard, hang_rank, kill_rank,
+                    nan_reader, poison_feed, tear_checkpoint)
+from .errors import (CheckpointBarrierPoisonedError,  # noqa: F401
+                     CheckpointBarrierTimeoutError,
                      CheckpointCorruptError, CheckpointError,
                      CheckpointFormatError, CheckpointIncompleteError,
                      CheckpointNotFoundError, CheckpointStateMismatchError,
-                     CheckpointWriteError, ResilienceError,
-                     RetriesExhaustedError, TrainingPreempted,
-                     WatchdogTimeout)
+                     CheckpointWriteError, GangError, GangFailedError,
+                     GangPoisonedError, PeerLostError, PeerStalledError,
+                     ResilienceError, RetriesExhaustedError,
+                     StepHangError, TrainingPreempted, WatchdogTimeout)
 from .guard import (LossScaleConfig, UpdateGuardConfig,  # noqa: F401
                     enable_update_guard, guard_config)
+from .health import (PEER_LOST_EXIT_CODE, HealthConfig,  # noqa: F401
+                     HealthPlane, get_health_plane, poison_gang,
+                     start_health_plane, stop_health_plane)
 from .preempt import (PREEMPT_EXIT_CODE, PendingSave,  # noqa: F401
                       SnapshotWriter, clear_drain, drain_requested,
                       install_preempt_handler, request_drain,
                       uninstall_preempt_handler)
-from .watchdog import Deadline, probe_backend, retry_call  # noqa: F401
+from .supervisor import (GangResult, Supervisor,  # noqa: F401
+                         classify_exit)
+from .watchdog import (Deadline, DispatchWatchdog,  # noqa: F401
+                       backoff_schedule, probe_backend, retry_call)

@@ -248,7 +248,155 @@ class FlakyPredictor:
 
 def hang(seconds: float) -> None:
     """An injected hang the watchdog must interrupt (sleep re-enters
-    the interpreter, so SIGALRM can fire)."""
+    the interpreter, so SIGALRM / the timer-thread async-exc can
+    fire)."""
     end = time.monotonic() + seconds
     while time.monotonic() < end:
         time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# Gang chaos: env-armed per-rank failpoints for worker SUBPROCESSES
+# (docs/RESILIENCE.md chaos registry).  The in-process arm()/failpoint()
+# pair cannot reach a worker the supervisor spawned; these are armed
+# through the environment the supervisor already propagates, and each
+# carries an optional once-file so a relaunched gang (same env!) does
+# not re-fire after the restart resumes past the arm step.
+# ---------------------------------------------------------------------------
+
+KILL_RANK_ENV = "PTPU_CHAOS_KILL_RANK"
+KILL_STEP_ENV = "PTPU_CHAOS_KILL_STEP"
+KILL_ONCE_ENV = "PTPU_CHAOS_KILL_ONCE_FILE"
+HANG_RANK_ENV = "PTPU_CHAOS_HANG_RANK"
+HANG_STEP_ENV = "PTPU_CHAOS_HANG_STEP"
+HANG_S_ENV = "PTPU_CHAOS_HANG_S"
+HANG_ONCE_ENV = "PTPU_CHAOS_HANG_ONCE_FILE"
+
+
+def _env_armed(rank: int, step: int, rank_env: str, step_env: str,
+               once_env: str) -> bool:
+    import os
+
+    target = os.environ.get(rank_env)
+    if target is None or int(target) != int(rank):
+        return False
+    if int(step) < int(os.environ.get(step_env, "0")):
+        return False
+    once = os.environ.get(once_env)
+    if once:
+        if os.path.exists(once):
+            return False  # already fired in a previous life
+        with open(once, "w") as f:
+            f.write(f"fired rank={rank} step={step}\n")
+    return True
+
+
+def kill_rank(rank: int, step: int) -> None:
+    """SIGKILL-abrupt self-death when the environment arms this
+    (rank, >=step): KILL_RANK_ENV / KILL_STEP_ENV, optional
+    KILL_ONCE_ENV sentinel file for fire-exactly-once-across-restarts.
+    Call from the worker's step loop — the real preemption the health
+    plane must detect (no flush, no cleanup, like the preempt_worker
+    SIGKILL timing but armed from env instead of a watching parent)."""
+    import os
+    import signal
+
+    if _env_armed(rank, step, KILL_RANK_ENV, KILL_STEP_ENV,
+                  KILL_ONCE_ENV):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def hang_rank(rank: int, step: int) -> None:
+    """Hang this rank for HANG_S_ENV seconds (default 3600 — "forever"
+    at test scale) when env-armed for (rank, >=step): the
+    alive-but-stuck peer the stall detector / dispatch watchdog must
+    catch.  Same once-file contract as kill_rank."""
+    import os
+
+    if _env_armed(rank, step, HANG_RANK_ENV, HANG_STEP_ENV,
+                  HANG_ONCE_ENV):
+        hang(float(os.environ.get(HANG_S_ENV, "3600")))
+
+
+def arm_kill_rank_env(env: dict, rank: int, at_step: int,
+                      once_file: Optional[str] = None) -> dict:
+    """Fill `env` (in place, returned) with the kill_rank arming —
+    the supervisor/test-side pairing of kill_rank()."""
+    env[KILL_RANK_ENV] = str(rank)
+    env[KILL_STEP_ENV] = str(at_step)
+    if once_file:
+        env[KILL_ONCE_ENV] = once_file
+    return env
+
+
+def arm_hang_rank_env(env: dict, rank: int, at_step: int,
+                      seconds: float = 3600.0,
+                      once_file: Optional[str] = None) -> dict:
+    """env-side pairing of hang_rank()."""
+    env[HANG_RANK_ENV] = str(rank)
+    env[HANG_STEP_ENV] = str(at_step)
+    env[HANG_S_ENV] = str(seconds)
+    if once_file:
+        env[HANG_ONCE_ENV] = once_file
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Fake KV store (health-plane unit tests)
+# ---------------------------------------------------------------------------
+
+class FakeKv:
+    """In-process stand-in for the jax.distributed coordination KV
+    client, with the exact method surface resilience/health.py and
+    io._barrier use (key_value_set(+allow_overwrite) /
+    key_value_dir_get / blocking_key_value_get / key_value_delete) —
+    detection-window tests inject it with a fake clock instead of
+    killing real processes.  Thread-safe; `fail_with` makes every call
+    raise (the dead-coordinator simulation)."""
+
+    def __init__(self):
+        import threading
+
+        self._data: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self.fail_with: Optional[Exception] = None
+
+    def _maybe_fail(self):
+        if self.fail_with is not None:
+            raise self.fail_with
+
+    def key_value_set(self, key: str, value: str,
+                      allow_overwrite: bool = False) -> None:
+        self._maybe_fail()
+        with self._lock:
+            if key in self._data and not allow_overwrite:
+                raise RuntimeError(f"ALREADY_EXISTS: {key}")
+            self._data[key] = value
+
+    def key_value_dir_get(self, prefix: str):
+        self._maybe_fail()
+        prefix = prefix.rstrip("/") + "/"
+        with self._lock:
+            return sorted((k, v) for k, v in self._data.items()
+                          if k.startswith(prefix))
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int) -> str:
+        self._maybe_fail()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            with self._lock:
+                if key in self._data:
+                    return self._data[key]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"GetKeyValue timed out with key: {key}")
+            time.sleep(0.005)
+
+    def key_value_delete(self, key: str) -> None:
+        self._maybe_fail()
+        with self._lock:
+            if key.endswith("/"):
+                for k in [k for k in self._data if k.startswith(key)]:
+                    del self._data[k]
+            else:
+                self._data.pop(key, None)

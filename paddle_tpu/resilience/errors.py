@@ -91,6 +91,17 @@ class CheckpointBarrierTimeoutError(CheckpointError):
     kind = "checkpoint_barrier_timeout"
 
 
+class CheckpointBarrierPoisonedError(CheckpointBarrierTimeoutError):
+    """A checkpoint barrier aborted EARLY because the gang's poison key
+    was set — some peer (or its health monitor) already declared the
+    gang broken, so waiting out the full barrier timeout would only
+    delay the restart.  `details` carries everything the parent class
+    does plus `poison`: the structured poison payload (origin rank,
+    reason, kind) and `elapsed_s`, the bounded time actually spent."""
+
+    kind = "checkpoint_barrier_poisoned"
+
+
 class CheckpointStateMismatchError(CheckpointError):
     """The checkpoint's recorded build state (generated-name counters,
     train_state schema) does not match the resuming process's build —
@@ -128,9 +139,28 @@ class TrainingPreempted(ResilienceError):
 
 class WatchdogTimeout(ResilienceError):
     """A deadline-guarded region (compile, dispatch, warmup) exceeded
-    its wall-clock budget."""
+    its wall-clock budget.  `message` has a default because the
+    timer-thread Deadline fallback raises this via
+    PyThreadState_SetAsyncExc, which instantiates the CLASS with no
+    arguments (CPython rejects pre-built instances there)."""
 
     kind = "watchdog_timeout"
+
+    def __init__(self, message: str = "watchdog deadline exceeded",
+                 **details: Any):
+        super().__init__(message, **details)
+
+
+class StepHangError(WatchdogTimeout):
+    """The dispatch watchdog's verdict on a timed-out training step:
+    a `step_hang` event was emitted first, then this.  `details.kind`
+    distinguishes `first_compile` (no dispatch had ever completed —
+    the long compile-grace budget applied and STILL ran out) from
+    `hung_step` (a previously-working step stopped returning: the
+    hung-collective signature), plus the runtime_stats deltas observed
+    inside the region (compiles/dispatches/retraces)."""
+
+    kind = "step_hang"
 
 
 class RetriesExhaustedError(ResilienceError):
@@ -138,3 +168,54 @@ class RetriesExhaustedError(ResilienceError):
     the attempt count and the final error."""
 
     kind = "retries_exhausted"
+
+
+# ---------------------------------------------------------------------------
+# Gang fault tolerance (resilience/health.py, resilience/supervisor.py)
+# ---------------------------------------------------------------------------
+
+class GangError(ResilienceError):
+    """Base for distributed-gang failures: a peer died or wedged, the
+    gang was poisoned, or the supervisor exhausted its restart budget.
+    Workers translate any GangError into PEER_LOST_EXIT_CODE so the
+    supervisor can tell a coordinated abort from a plain crash."""
+
+    kind = "gang_error"
+
+
+class PeerLostError(GangError):
+    """A peer rank stopped heartbeating (process death, SIGKILL, host
+    loss) — or the KV store itself became unreachable, which on this
+    runtime means the coordinator process (rank 0) died.  `details`
+    carries `missing_ranks`, the staleness `age_s` at detection, and
+    the configured `budget_s` window."""
+
+    kind = "peer_lost"
+
+
+class PeerStalledError(GangError):
+    """A peer is still heartbeating (process alive) but its step
+    counter has not advanced within the stall timeout — the
+    hung-inside-a-collective signature.  `details` names the
+    `stalled_ranks`, their last `step`, and the `stall_timeout_s`."""
+
+    kind = "peer_stalled"
+
+
+class GangPoisonedError(GangError):
+    """This rank read the gang poison key: some OTHER rank (or its
+    health monitor / dispatch watchdog) declared the gang broken.
+    Every rank checking the key between steps is what turns one
+    failure into a bounded-time gang-wide abort instead of a hang in
+    the next all-reduce.  `details.poison` is the origin's payload
+    (origin rank, reason, kind, missing_ranks)."""
+
+    kind = "gang_poisoned"
+
+
+class GangFailedError(GangError):
+    """The supervisor exhausted its restart budget: every attempt's
+    per-rank exit codes (and their classification) are in
+    `details.attempts` — the post-mortem artifact."""
+
+    kind = "gang_failed"

@@ -77,8 +77,14 @@ def main():
     # CheckpointBarrierTimeoutError naming rank 1, not hang
     mode = sys.argv[5] if len(sys.argv) > 5 else None
 
+    # die_before_save pins the PLAIN barrier-timeout semantics (ISSUE
+    # 7): opt out of the ISSUE-9 health plane there, whose peer-loss
+    # poison would (correctly) abort the barrier EARLIER as a
+    # CheckpointBarrierPoisonedError — that faster path has its own
+    # proof in tests/test_gang.py.
     init_distributed(trainer_id=trainer_id, num_trainers=2,
-                     coordinator=coordinator)
+                     coordinator=coordinator,
+                     health=(mode != "die_before_save"))
     assert jax.process_count() == 2, jax.process_count()
 
     if mode == "die_before_save":
@@ -98,6 +104,24 @@ def main():
             # like a real SIGKILL.
             sys.stdout.flush()
             os._exit(17)
+        # make the save GENUINELY gang-wide: replace one persistable
+        # with a dp-sharded GLOBAL array whose other half lives on the
+        # (dead) peer's device — built locally from this process's
+        # shard only, no cross-process compute.  Since ISSUE 9 a save
+        # whose manifest references only the local process's shard
+        # file is process-local and skips the barrier entirely, so a
+        # barrier-timeout test must present a manifest that names the
+        # peer's shard file.
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2), ("dp",))
+        w1 = np.asarray(fluid.global_scope().find_var("w1"))
+        local = jax.device_put(w1[:w1.shape[0] // 2],
+                               jax.local_devices()[0])
+        garr = jax.make_array_from_single_device_arrays(
+            w1.shape, NamedSharding(mesh, P("dp")), [local])
+        fluid.global_scope().set_var("w1", garr)
         from paddle_tpu.resilience import CheckpointBarrierTimeoutError
         try:
             fluid.io.save_sharded(exe, ckpt_dir,

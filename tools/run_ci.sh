@@ -372,6 +372,19 @@ print("chaos smoke OK:",
        "breaker": engine.health()["breaker"]["state"]})
 EOF
 
+echo "== gang-chaos smoke (cpu) =="
+# ISSUE 9 (docs/RESILIENCE.md, distributed failure model): a REAL
+# 2-worker gang under the self-healing supervisor — SIGKILL a random
+# rank (the coordinator included; the supervisor hosts the
+# coordination service) mid-train: the survivor must detect within
+# the configured heartbeat miss budget (structured PeerLostError
+# naming the dead rank, exit 43, no hang, no orphans), the supervisor
+# relaunches once, and the restarted gang's final params must be
+# BIT-identical to an uninterrupted control gang.  Then the poisoned
+# barrier: a rank already waiting in a checkpoint barrier when a peer
+# poisons the gang must abort in seconds, not the barrier timeout.
+python tests/test_gang.py --ci-smoke
+
 echo "== crash-resume smoke (cpu) =="
 # ISSUE 7 (docs/RESILIENCE.md, preemption): SIGKILL a REAL training
 # subprocess at a random mid step, relaunch, auto-resume — final
