@@ -31,6 +31,14 @@ Run on the real TPU chip: `python bench.py [--model all|resnet50|
 transformer|bert|lstm|deepfm|serving|serving_engine] [--batch N] [--steps N]
 [--no-amp] [--no-flash] [--data synthetic|frozen|host]`.  Default 60
 timed steps: a ~3 s timed window keeps MFU stable run-to-run.
+
+Multi-chip (docs/DIST.md): `--mesh dp=N` benches the training models
+data-parallel over a device mesh — global-batch feeds shard over the
+dp axis, entries key `<model>_dpN` and carry per_device_* throughput
+next to the aggregate, MFU against the aggregate peak, and the
+sharded step's comm-bucket bytes; `--grad-sync int8` swaps the
+gradient all-reduce for the EQuARX blockwise-quantized exchange
+(opt-in, A/B'd in AB_r08.json).
 """
 
 from __future__ import annotations
@@ -244,21 +252,109 @@ def _peak_mem_if_backend_up():
     return monitoring.peak_memory_bytes()
 
 
-def _mfu_result(step_flops, steps, elapsed, extra):
+def _mfu_result(step_flops, steps, elapsed, extra, n_devices=1):
     if step_flops <= 0:
         raise RuntimeError(
             "XLA cost_analysis returned no flops; refusing to report a "
             "fabricated MFU")
     peak, kind = _peak_flops()
-    out = {"mfu": round((step_flops * steps / elapsed) / peak, 4),
+    # step_flops is the GLOBAL-batch program's algorithmic count, so
+    # the dp denominator is the aggregate peak of the whole mesh
+    out = {"mfu": round((step_flops * steps / elapsed)
+                        / (peak * n_devices), 4),
            "step_flops": step_flops, "device": kind, "steps": steps}
     out.update(extra)
     return out
 
 
+def _parse_mesh(spec: str):
+    """--mesh "dp=8" (or "dp=4,mp=2") -> ordered axis dict."""
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        name = name.strip()
+        try:
+            n = int(size)
+        except ValueError:
+            n = 0
+        if not name or n < 1:
+            raise ValueError(
+                f"--mesh wants 'axis=N[,axis=N...]' (e.g. dp=8); got "
+                f"{spec!r}")
+        axes[name] = n
+    return axes
+
+
+def _dp_compile(program, loss, mesh_axes, grad_sync):
+    """Wrap a built training program for the dp-mesh bench: feeds get a
+    batch-dim PartitionSpec over the data axis
+    (ShardingRules.feed_spec_for), params replicate (the
+    ParallelExecutor AllReduce mode) and gradients all-reduce
+    implicitly via GSPMD — or explicitly, blockwise-int8-quantized,
+    with --grad-sync int8 (docs/DIST.md).  Executor.run routes through
+    the wrapper automatically from here on."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import make_mesh
+
+    mesh = make_mesh(mesh_axes)
+    bs = fluid.BuildStrategy()
+    bs.grad_sync = grad_sync
+    fluid.CompiledProgram(program).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs, mesh=mesh)
+    return mesh
+
+
+def _comm_fields(program, feed, loss, scope):
+    """Communication accounting of one dp-mesh entry, from the SHARDED
+    (post-SPMD) compiled step's `comm` bucket in observe.cost —
+    all-reduce / all-gather / reduce-scatter / all-to-all /
+    collective-permute instructions.  `comm_bytes` is the modeled
+    PER-DEVICE bytes touched by collectives in one step (the same
+    materialized-buffer accounting every other bucket uses),
+    `comm_share` its fraction of the step's total modeled bytes.
+    Time attribution joins through observe.op_cost_table when a
+    profile trace is captured (--profile); the bytes are the standing
+    artifact field.  Failures record in-band, never killing the
+    entry."""
+    try:
+        from paddle_tpu.observe import cost as obs_cost
+
+        wrapper = getattr(program, "_compiled_wrapper", None)
+        compiled = wrapper.compiled_step(feed, [loss.name], scope)
+        rows = obs_cost.instruction_costs(
+            obs_cost.compiled_hlo_proto(compiled))
+        comm = sum(r["bytes"] for r in rows if r["bucket"] == "comm")
+        total = sum(r["bytes"] for r in rows if r["bucket"] != "noop")
+        return {"comm_bytes": comm,
+                "comm_share": round(comm / total, 4) if total else 0.0,
+                "comm_instructions": sum(
+                    1 for r in rows if r["bucket"] == "comm")}
+    except Exception as e:  # noqa: BLE001 — observability must not
+        #                     take down the measurement it describes
+        return {"comm_bytes": None,
+                "comm_error": f"{type(e).__name__}: {e}"}
+
+
+def _dp_fields(program, feed, loss, scope, mesh_axes, grad_sync,
+               agg_throughput: dict):
+    """The per-entry dp contract (perf_gate --schema enforces it on
+    mesh entries): the mesh, device count, grad-sync mode, PER-DEVICE
+    throughput next to the aggregate, and the comm-bucket bytes."""
+    n_dev = 1
+    for s in mesh_axes.values():
+        n_dev *= s
+    out = {"mesh": dict(mesh_axes), "n_devices": n_dev,
+           "grad_sync": grad_sync}
+    for key, val in agg_throughput.items():
+        out[f"per_device_{key}"] = round(val / n_dev, 2)
+    out.update(_comm_fields(program, feed, loss, scope))
+    return out
+
+
 def bench_resnet50(batch_size: int, steps: int, warmup: int,
                    use_amp: bool = True, data_mode: str = "synthetic",
-                   data_format: str = "NCHW"):
+                   data_format: str = "NCHW", mesh_axes=None,
+                   grad_sync=None):
     """data_mode:
     - "synthetic" (default): FRESH random batch generated on device
       every step (random ops prepended to the program)
@@ -275,6 +371,22 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
 
     if data_mode not in ("frozen", "synthetic", "host"):
         raise ValueError(f"unknown data_mode {data_mode!r}")
+    if mesh_axes and data_mode == "host":
+        raise ValueError(
+            "--mesh with --data host is not wired: the prefetch "
+            "pipeline feeds per-batch host arrays; dp entries use "
+            "synthetic (recorded as frozen) or frozen")
+    dp_note = None
+    if mesh_axes and data_mode == "synthetic":
+        # on-device synthetic generation carries no sharding
+        # annotation, so GSPMD would replicate the generated batch (and
+        # with it most of the step) over dp — the dp entry would bench
+        # redundant compute and call it scaling.  The dp resnet entry
+        # therefore uses the frozen device feed (the batch-dim
+        # PartitionSpec comes from the feed) and SAYS so.
+        data_mode = "frozen"
+        dp_note = ("synthetic generation has no sharding annotation; "
+                   "dp entry measured with the frozen device feed")
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
@@ -285,6 +397,8 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                                    data_format=data_format)
         _enable_observability(main)
         exe = fluid.Executor()
+        if mesh_axes:
+            _dp_compile(main, model["loss"], mesh_axes, grad_sync)
 
         if data_mode == "synthetic":
             # per-step RNG advance makes every iteration's batch
@@ -351,15 +465,25 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                 scope=scope)
             mem = _mem_fields(exe, main, feed, model["loss"])
         ck = _ckpt_fields(exe, main, scope)
-    imgs_per_sec = batch_size * steps / elapsed
+        imgs_per_sec = batch_size * steps / elapsed
+        dp = {}
+        n_dev = 1
+        if mesh_axes:
+            dp = _dp_fields(main, feed, model["loss"], scope,
+                            mesh_axes, grad_sync,
+                            {"imgs_per_sec": round(imgs_per_sec, 2)})
+            n_dev = dp["n_devices"]
+            if dp_note:
+                dp["dp_data_note"] = dp_note
     return _mfu_result(
         float(cost.get("flops", 0.0)), steps, elapsed,
         {"imgs_per_sec": round(imgs_per_sec, 2),
          "batch_size": batch_size, "amp": use_amp,
          "data_mode": data_mode, "data_format": data_format,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem, **ck,
-         "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)})
+         **_tel_fields(tel), **mem, **ck, **dp,
+         "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)},
+        n_devices=n_dev)
 
 
 def _layout_fields(exe, program, feed, loss):
@@ -455,7 +579,8 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
                       fused_qkv: bool = False, moe_experts: int = 0,
                       flash_pallas: bool = False,
                       recompute: bool = False,
-                      head_major: bool = False):
+                      head_major: bool = False,
+                      mesh_axes=None, grad_sync=None):
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
@@ -481,6 +606,8 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         model = build(use_flash)
         _enable_observability(main)
         exe = fluid.Executor()
+        if mesh_axes:
+            _dp_compile(main, model["loss"], mesh_axes, grad_sync)
         exe.run(startup)
         feed = {k: jnp.asarray(v) for k, v in
                 transformer.make_fake_batch(batch_size, max_length,
@@ -513,10 +640,18 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
         mem = _mem_fields(exe, main, feed, model["loss"])
         layout = _layout_fields(exe, main, feed, model["loss"])
         ck = _ckpt_fields(exe, main, scope)
+        tokens_per_sec = round(batch_size * max_length * steps
+                               / elapsed, 1)
+        dp = {}
+        n_dev = 1
+        if mesh_axes:
+            dp = _dp_fields(main, feed, model["loss"], scope,
+                            mesh_axes, grad_sync,
+                            {"tokens_per_sec": tokens_per_sec})
+            n_dev = dp["n_devices"]
     return _mfu_result(
         step_flops, steps, elapsed,
-        {"tokens_per_sec": round(batch_size * max_length * steps
-                                 / elapsed, 1),
+        {"tokens_per_sec": tokens_per_sec,
          "batch_size": batch_size, "max_length": max_length,
          "amp": use_amp, "flash": use_flash,
          "flash_pallas": flash_pallas, "fused_ce": use_fused_ce,
@@ -524,12 +659,13 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "recompute": recompute, "head_major": head_major,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem, **layout, **ck})
+         **_tel_fields(tel), **mem, **layout, **ck, **dp},
+        n_devices=n_dev)
 
 
 def bench_bert(batch_size: int, steps: int, warmup: int,
                max_len: int = 128, use_amp: bool = True,
-               use_flash: bool = True):
+               use_flash: bool = True, mesh_axes=None, grad_sync=None):
     """BERT-base pretraining (BASELINE.json tracked config #3): MLM+NSP
     step, tokens/sec + MFU."""
     import jax.numpy as jnp
@@ -547,6 +683,8 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
         model = build(use_flash)
         _enable_observability(main)
         exe = fluid.Executor()
+        if mesh_axes:
+            _dp_compile(main, model["loss"], mesh_axes, grad_sync)
         exe.run(startup)
         feed = {k: jnp.asarray(v) for k, v in
                 bert.make_fake_batch(batch_size, max_len).items()}
@@ -562,15 +700,23 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main, feed, model["loss"])
         ck = _ckpt_fields(exe, main, scope)
+        tokens_per_sec = round(batch_size * max_len * steps / elapsed, 1)
+        dp = {}
+        n_dev = 1
+        if mesh_axes:
+            dp = _dp_fields(main, feed, model["loss"], scope,
+                            mesh_axes, grad_sync,
+                            {"tokens_per_sec": tokens_per_sec})
+            n_dev = dp["n_devices"]
     return _mfu_result(
         step_flops, steps, elapsed,
-        {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
-                                 1),
+        {"tokens_per_sec": tokens_per_sec,
          "batch_size": batch_size, "max_len": max_len, "amp": use_amp,
          "flash": use_flash,
          "flop_count": "dense-equivalent" if use_flash else "xla",
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem, **ck})
+         **_tel_fields(tel), **mem, **ck, **dp},
+        n_devices=n_dev)
 
 
 def bench_lstm(batch_size: int, steps: int, warmup: int,
@@ -633,7 +779,8 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
          **_tel_fields(tel), **mem, **ck})
 
 
-def bench_deepfm(batch_size: int, steps: int, warmup: int):
+def bench_deepfm(batch_size: int, steps: int, warmup: int,
+                 mesh_axes=None, grad_sync=None):
     """DeepFM CTR (tracked config #5): examples/sec on the sparse path
     (is_sparse lookups → SelectedRows-style grads, lazy Adam row
     updates) + a bytes/flops roofline context from XLA cost analysis —
@@ -650,6 +797,8 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
         model = deepfm.build_model()
         _enable_observability(main_p)
         exe = fluid.Executor()
+        if mesh_axes:
+            _dp_compile(main_p, model["loss"], mesh_axes, grad_sync)
         exe.run(startup)
         feed = {k: jnp.asarray(v)
                 for k, v in deepfm.make_fake_batch(batch_size).items()}
@@ -660,13 +809,19 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
                                               warmup, scope=scope)
         mem = _mem_fields(exe, main_p, feed, model["loss"])
         ck = _ckpt_fields(exe, main_p, scope)
+        examples_per_sec = round(batch_size * steps / elapsed, 1)
+        dp = {}
+        if mesh_axes:
+            dp = _dp_fields(main_p, feed, model["loss"], scope,
+                            mesh_axes, grad_sync,
+                            {"examples_per_sec": examples_per_sec})
     _, kind = _peak_flops()
     bytes_acc = float(cost.get("bytes accessed", 0.0))
     # v5e HBM ~819 GB/s: what fraction of the bandwidth roofline the
     # sparse step achieves (the CTR analog of MFU)
     hbm_frac = (bytes_acc * steps / elapsed) / 819e9 if bytes_acc else 0.0
     return {
-        "examples_per_sec": round(batch_size * steps / elapsed, 1),
+        "examples_per_sec": examples_per_sec,
         "device": kind,
         "batch_size": batch_size,
         "steps": steps,
@@ -674,7 +829,7 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int):
         "step_bytes_accessed": bytes_acc,
         "hbm_roofline_frac": round(hbm_frac, 4),
         "last_loss": last_loss,
-        **_tel_fields(tel), **mem, **ck,
+        **_tel_fields(tel), **mem, **ck, **dp,
     }
 
 
@@ -947,6 +1102,29 @@ def main():
                             "lstm", "deepfm", "serving",
                             "serving_engine", "longctx"])
     p.add_argument("--batch", type=int, default=0)
+    p.add_argument("--mesh", default=None, metavar="dp=N",
+                   help="bench the training models (resnet50/"
+                        "transformer/bert/deepfm) data-parallel over a "
+                        "device mesh, e.g. --mesh dp=8: the --batch is "
+                        "the GLOBAL batch, feeds shard over the dp "
+                        "axis via GSPMD and grads all-reduce "
+                        "implicitly.  Entries gain per_device_* "
+                        "throughput + comm_bytes and key as "
+                        "<model>_dp<N>.  With BENCH_PLATFORM=cpu the "
+                        "virtual host-device count is raised to fit "
+                        "(the CI smoke mesh); on a real slice the "
+                        "devices must exist (docs/DIST.md)")
+    p.add_argument("--grad-sync", default="none",
+                   choices=["none", "bf16", "int8"],
+                   help="dp gradient-exchange mode (needs --mesh): "
+                        "none = implicit GSPMD all-reduce (default); "
+                        "bf16 = explicit shard_map exchange, exact "
+                        "psum (the A/B control arm); int8 = EQuARX "
+                        "blockwise-int8 two-phase quantized "
+                        "all-reduce (collectives.quantized_all_reduce,"
+                        " docs/DIST.md).  A/B candidate: default "
+                        "stays none pending a chip throughput win in "
+                        "AB_r08.json")
     p.add_argument("--seq", type=int, default=0,
                    help="longctx: sequence length (default 8192)")
     p.add_argument("--steps", type=int, default=60)
@@ -1051,6 +1229,37 @@ def main():
     global _TELEMETRY, _GUARD
     _TELEMETRY = args.telemetry
     _GUARD = args.guard
+
+    mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
+    grad_sync = None if args.grad_sync == "none" else args.grad_sync
+    if grad_sync and not mesh_axes:
+        p.error("--grad-sync needs --mesh (it is the dp gradient-"
+                "exchange mode)")
+    if mesh_axes and os.environ.get("BENCH_PLATFORM") == "cpu":
+        # virtual mesh for the CPU smoke: the host-device count must be
+        # raised BEFORE any jax backend init (same move as
+        # __graft_entry__._force_cpu_if_needed)
+        need = 1
+        for s in mesh_axes.values():
+            need *= s
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                f"={need}").strip()
+
+    if mesh_axes and os.environ.get("BENCH_PLATFORM") == "cpu" \
+            and args.model_deadline == 900:
+        # virtual-mesh compiles+dispatches serialize onto the host
+        # cores (8 "devices" can share ONE core in CI): the default
+        # chip-sized per-model deadline would kill a healthy dp smoke
+        # mid-compile.  An explicit --model-deadline/-S env still wins.
+        import sys
+
+        args.model_deadline = 3600
+        print("note: --mesh on the CPU virtual mesh raises the default "
+              "per-model deadline to 3600s (serialized device threads)",
+              file=sys.stderr)
 
     if os.environ.get("BENCH_PLATFORM"):
         # testing escape hatch: JAX_PLATFORMS env is stomped by the
@@ -1208,35 +1417,44 @@ def main():
         detail[name]["peak_mem_bytes"] = _obs.peak_memory_bytes()
         _snapshot()
 
+    # dp-mesh entries key as <model>_<mesh> (e.g. transformer_dp8): a
+    # dp number must never collide with (or gate against) the
+    # single-device entry of the same model in an artifact
+    mesh_sfx = ("_" + "_".join(f"{a}{s}" for a, s in mesh_axes.items())
+                if mesh_axes else "")
+    dp_kw = {"mesh_axes": mesh_axes, "grad_sync": grad_sync}
+
     if args.model in ("all", "resnet50"):
-        _run("resnet50", bench_resnet50, args.batch or 128, args.steps,
-             args.warmup, use_amp=amp, data_mode=args.data,
-             data_format=args.layout)
-        if args.model == "all" and args.data == "synthetic":
+        _run("resnet50" + mesh_sfx, bench_resnet50, args.batch or 128,
+             args.steps, args.warmup, use_amp=amp, data_mode=args.data,
+             data_format=args.layout, **dp_kw)
+        if args.model == "all" and args.data == "synthetic" \
+                and not mesh_axes:
             # record the frozen-feed ceiling alongside the honest
             # number — same layout, or the "ceiling" is a different
-            # program
+            # program (dp entries already measure the frozen feed)
             _run("resnet50_frozen", bench_resnet50, args.batch or 128,
                  args.steps, args.warmup, use_amp=amp,
                  data_mode="frozen", data_format=args.layout)
     if args.model in ("all", "transformer"):
-        _run("transformer", bench_transformer, args.batch or 64,
-             args.steps, args.warmup, use_amp=amp,
+        _run("transformer" + mesh_sfx, bench_transformer,
+             args.batch or 64, args.steps, args.warmup, use_amp=amp,
              use_flash=not args.no_flash,
              use_fused_ce=bool(args.fused_ce),
              fused_qkv=args.fused_qkv, moe_experts=args.moe_experts,
              flash_pallas=args.pallas_attn, recompute=args.recompute,
-             head_major=args.head_major)
+             head_major=args.head_major, **dp_kw)
     if args.model in ("all", "bert"):
-        _run("bert", bench_bert, args.batch or 32, args.steps,
-             args.warmup, use_amp=amp, use_flash=not args.no_flash)
+        _run("bert" + mesh_sfx, bench_bert, args.batch or 32,
+             args.steps, args.warmup, use_amp=amp,
+             use_flash=not args.no_flash, **dp_kw)
     if args.model in ("all", "lstm"):
         _run("lstm", bench_lstm, args.batch or 128, args.steps,
              args.warmup, pallas_rnn=args.pallas_rnn,
              rnn_unroll=args.rnn_unroll)
     if args.model in ("all", "deepfm"):
-        _run("deepfm", bench_deepfm, args.batch or 4096, args.steps,
-             args.warmup)
+        _run("deepfm" + mesh_sfx, bench_deepfm, args.batch or 4096,
+             args.steps, args.warmup, **dp_kw)
     if args.model in ("all", "serving"):
         # the driver's default `--model all` invocation must capture the
         # serving + int8 lines too (VERDICT r3 weak #4)
@@ -1286,11 +1504,13 @@ def main():
     # report in detail.  A failed headline model must be visible at the
     # TOP level, not just buried in detail.
     failed = sorted(k for k, v in detail.items() if "error" in v)
-    headline = [detail[k]["mfu"] for k in ("resnet50", "transformer")
-                if "mfu" in detail.get(k, {})]
+    headline = [detail[k + mesh_sfx]["mfu"]
+                for k in ("resnet50", "transformer")
+                if "mfu" in detail.get(k + mesh_sfx, {})]
     if headline:
-        metric = ("min_train_mfu_resnet50_transformer"
-                  if len(headline) > 1 else f"{args.model}_train_mfu")
+        metric = (f"min_train_mfu_resnet50_transformer{mesh_sfx}"
+                  if len(headline) > 1
+                  else f"{args.model}{mesh_sfx}_train_mfu")
         if failed:
             metric += "_PARTIAL_FAILURE"
         result = {

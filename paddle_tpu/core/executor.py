@@ -430,7 +430,48 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
             return loss * scale, e
 
     sparse_lookups = _find_sparse_lookups(fwd_ops, trainable, env)
-    if accum_steps <= 1:
+    # explicit dp gradient synchronization (ISSUE 10, docs/DIST.md):
+    # with a GradSyncConfig on the program AND an executing mesh whose
+    # batch axis is >1, the fwd+bwd runs inside a shard_map over that
+    # axis and the gradient exchange becomes OURS — exact psum ("bf16")
+    # or the EQuARX blockwise-int8 two-phase exchange ("int8") — instead
+    # of the GSPMD-inserted all-reduce.  Everything stays inside the ONE
+    # jitted step.
+    gs_cfg = getattr(program, "_grad_sync", None)
+    gs_ectx = None
+    if gs_cfg is not None:
+        from ..parallel.mesh import get_exec_context
+
+        _ectx = get_exec_context()
+        if (_ectx is not None
+                and _ectx.mesh.shape.get(_ectx.batch_axis, 1) > 1):
+            gs_ectx = _ectx
+    if gs_ectx is not None:
+        # a FINAL PARTIAL batch that no longer divides the dp axis
+        # falls back to the ordinary (replicated-feed) path — exact
+        # grads, no dp speedup for that one step — mirroring
+        # ShardingRules.feed_spec_for's replicate-on-indivisible rule
+        # instead of crashing the epoch tail (found by driving the
+        # surface; pinned in tests/test_grad_sync.py)
+        _n_dp = gs_ectx.mesh.shape[gs_ectx.batch_axis]
+        if not any(
+                hasattr(env.get(f), "ndim")
+                and getattr(env.get(f), "ndim", 0) >= 1
+                and env[f].shape[0] > 0 and env[f].shape[0] % _n_dp == 0
+                for f in feed_names):
+            gs_ectx = None
+    if gs_ectx is not None:
+        if accum_steps > 1:
+            raise ValueError(
+                "grad_sync cannot compose with gradient accumulation "
+                "yet: the explicit exchange would run per micro-batch "
+                "(K quantized all-reduces instead of one).  Use "
+                "accumulation with the default GSPMD sync, or "
+                "grad_sync without accumulation.")
+        loss_val, grads, env = _dp_sync_value_and_grad(
+            grad_fwd, fwd_ops, sparse_lookups, trainable, env, rng_key,
+            gs_ectx, gs_cfg, feed_names, fwd_keep)
+    elif accum_steps <= 1:
         if sparse_lookups:
             loss_val, grads, env = _sparse_value_and_grad(
                 grad_fwd, fwd_ops, sparse_lookups, trainable, env,
@@ -566,6 +607,164 @@ def _sparse_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
                   else jnp.concatenate([p[1] for p in pairs]))
         grads[tbl] = SparseGrad(ids_c, rows_c, trainable[tbl].shape)
     return loss_val, grads, env_after
+
+
+def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
+                            rng_key, ectx, cfg, feed_names, keep_names):
+    """Data-parallel fwd+bwd with an EXPLICIT gradient exchange
+    (docs/DIST.md).  The forward/backward runs inside a shard_map over
+    the mesh's batch axis: every rank differentiates its local batch
+    shard's mean loss, then
+
+      - dense grads sync through `cfg.mode`: exact lax.pmean ("bf16")
+        or collectives.quantized_all_reduce_local ("int8" — blockwise
+        int8 payloads + f32 scale sidecars, two-phase, EQuARX);
+        tensors below cfg.min_quant_numel ride the exact psum either
+        way (the bf16-fallback floor);
+      - SparseGrad STAYS SPARSE: ids+rows all_gather over dp (the
+        concatenation densifies to the same scatter-add sum a global
+        batch would produce) — O(touched rows) on the wire, and hot
+        embedding rows never eat quantization error;
+      - the loss pmeans; forward-written values someone reads
+        downstream (fetches, persistable BN stats, lr-schedule vars)
+        leave the shard_map classified per name: batch-dim outputs
+        reassemble to the global batch, replicated floats pmean
+        (cross-replica-mean BN semantics), replicated ints pmax.
+
+    Both sync modes produce BITWISE-identical results on every rank
+    (fixed-order accumulation + gathered bytes are shared), so the
+    replicated parameters can never drift apart across dp ranks.
+
+    RNG: each rank folds its axis index into the step key — dropout
+    draws differ per rank like separate workers' would; exact-parity
+    tests against single-device runs therefore pin dropout=0.
+
+    Restriction (loud): pure-dp meshes only — on a mesh with another
+    sharded axis the replicated param entry would all-gather the model.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.collectives import (compat_shard_map,
+                                        quantized_all_reduce_local)
+    from .selected_rows import SparseGrad
+
+    mesh, axis = ectx.mesh, ectx.batch_axis
+    n = mesh.shape[axis]
+    other = sorted(a for a, s in mesh.shape.items()
+                   if a != axis and s > 1)
+    if other:
+        raise ValueError(
+            f"grad_sync={cfg.mode!r} supports pure data-parallel "
+            f"meshes; this mesh also has sharded axes {other}.  The "
+            f"explicit exchange enters a shard_map over {axis!r} with "
+            f"params replicated, which would silently all-gather "
+            f"{other}-sharded params.  Use the default GSPMD grad "
+            f"sync on composed meshes (docs/DIST.md).")
+
+    feeds = {}
+    for name in feed_names:
+        v = env.get(name)
+        if (v is not None and hasattr(v, "ndim") and v.ndim >= 1
+                and v.shape[0] > 0 and v.shape[0] % n == 0):
+            feeds[name] = v
+    if not feeds:
+        raise ValueError(
+            f"grad_sync needs at least one feed with a batch dim "
+            f"divisible by {axis}={n}; got "
+            f"{[(k, getattr(env.get(k), 'shape', None)) for k in feed_names]}")
+    base_env = {k: v for k, v in env.items() if k not in feeds}
+
+    def local_grads(params, feed_shards, key):
+        e_in = dict(base_env)
+        e_in.update(feed_shards)
+        if sparse_lookups:
+            return _sparse_value_and_grad(fwd, fwd_ops, sparse_lookups,
+                                          params, e_in, key)
+        (loss, e_after), grads = jax.value_and_grad(
+            fwd, has_aux=True)(params, e_in, key)
+        return loss, grads, e_after
+
+    # names the rest of the program reads out of the forward section
+    written = set()
+    for op in fwd_ops:
+        written.update(op.desc.output_names())
+    out_names = sorted(written & set(keep_names))
+
+    # classify each out name batch-sharded vs replicated by comparing
+    # abstract shapes of a local-shard trace vs a global-batch trace —
+    # a leading dim that scales with the feed batch reassembles over
+    # the axis, everything else leaves replicated (no shape heuristics
+    # that a (C,)-stat-with-C==local_batch coincidence could fool)
+    def _shapes(feed_structs):
+        out = jax.eval_shape(
+            lambda p, f: local_grads(p, f, rng_key)[2],
+            trainable, feed_structs)
+        return {k: out[k] for k in out_names}
+
+    local_structs = {
+        k: jax.ShapeDtypeStruct((v.shape[0] // n,) + v.shape[1:],
+                                v.dtype) for k, v in feeds.items()}
+    global_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for k, v in feeds.items()}
+    shp_local, shp_global = _shapes(local_structs), _shapes(global_structs)
+    batchish = {}
+    for name in out_names:
+        sl, sg = shp_local[name].shape, shp_global[name].shape
+        if sl == sg:
+            batchish[name] = False
+        elif (len(sl) == len(sg) and sl[1:] == sg[1:]
+              and sg[0] == n * sl[0]):
+            batchish[name] = True
+        else:
+            raise ValueError(
+                f"grad_sync cannot classify forward output {name!r}: "
+                f"local-shard shape {sl} vs global shape {sg} differ "
+                f"beyond the leading batch dim")
+
+    def sync_grad(g):
+        if isinstance(g, SparseGrad):
+            ids = jax.lax.all_gather(g.ids, axis, axis=0, tiled=True)
+            rows = jax.lax.all_gather(
+                g.rows * jnp.asarray(1.0 / n, g.rows.dtype), axis,
+                axis=0, tiled=True)
+            return SparseGrad(ids, rows, g.dense_shape)
+        if cfg.mode == "int8":
+            return quantized_all_reduce_local(
+                g, axis, n, block_size=cfg.block_size,
+                min_quant_numel=cfg.min_quant_numel, op="mean")
+        return jax.lax.pmean(g, axis)
+
+    def body(params, feed_shards):
+        key = jax.random.fold_in(rng_key, jax.lax.axis_index(axis))
+        loss, grads, e_after = local_grads(params, feed_shards, key)
+        loss = jax.lax.pmean(loss, axis)
+        grads = {k: sync_grad(g) for k, g in grads.items()}
+        outs = []
+        for name in out_names:
+            v = e_after[name]
+            if batchish[name]:
+                outs.append(v)
+            elif jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+                outs.append(jax.lax.pmean(v, axis))
+            elif jnp.asarray(v).dtype == jnp.bool_:
+                outs.append(jax.lax.pmax(
+                    jnp.asarray(v).astype(jnp.int32), axis) > 0)
+            else:
+                outs.append(jax.lax.pmax(v, axis))
+        return loss, grads, tuple(outs)
+
+    out_specs = (P(), P(), tuple(
+        P(axis) if batchish[name] else P() for name in out_names))
+    sm = compat_shard_map(
+        body, mesh,
+        in_specs=(P(), {k: P(axis) for k in feeds}),
+        out_specs=out_specs)
+    loss_val, grads, outs = sm(trainable, feeds)
+    for name, val in zip(out_names, outs):
+        env[name] = val
+    return loss_val, grads, env
 
 
 def _accumulate_gradients(program, fwd, fwd_ops, trainable, env, rng_key,

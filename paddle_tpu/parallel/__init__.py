@@ -10,7 +10,8 @@ tables.
 """
 
 from .collectives import (all_gather, all_reduce, all_to_all,  # noqa: F401
-                          barrier, ppermute, psum, reduce_scatter)
+                          barrier, ppermute, psum,
+                          quantized_all_reduce, reduce_scatter)
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from .dist import (global_batch, init_distributed,  # noqa: F401
@@ -19,4 +20,4 @@ from .mesh import get_default_mesh, make_mesh, set_default_mesh  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .pipeline import gpipe, gpipe_loss_and_grad  # noqa: F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
-from .strategies import ShardingRules  # noqa: F401
+from .strategies import GradSyncConfig, ShardingRules  # noqa: F401

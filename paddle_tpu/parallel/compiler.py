@@ -51,6 +51,13 @@ class BuildStrategy:
         # divides, else the pp degree).  Ignored when the program has no
         # pipeline tags or the mesh has no pp axis.
         self.pipeline_microbatches = 0
+        # Opt-in explicit gradient synchronization for dp
+        # (strategies.GradSyncConfig or a mode string): None keeps the
+        # implicit GSPMD all-reduce; "int8" routes dense grads through
+        # the blockwise-quantized two-phase exchange
+        # (collectives.quantized_all_reduce, EQuARX), "bf16" the same
+        # explicit path without quantization (the A/B control arm).
+        self.grad_sync = None
 
 
 class ExecutionStrategy:
@@ -73,6 +80,7 @@ class CompiledProgram:
         self._loss_name = None
         self._accum_steps = 1
         self._pp_microbatches = 0
+        self._aot_cache: Dict[Any, Any] = {}
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -94,6 +102,13 @@ class CompiledProgram:
                                         fsdp_axis=batch_axis)
         else:
             self._rules = ShardingRules()
+        from .strategies import GradSyncConfig
+
+        # explicit grad-sync mode rides the PROGRAM (the executor's
+        # interpret_program hook reads it at trace time; the mesh/axis
+        # come from the executing_mesh context this wrapper sets)
+        self._program._grad_sync = GradSyncConfig.normalize(
+            getattr(bs, "grad_sync", None))
         self._program._compiled_wrapper = self
         return self
 
@@ -102,23 +117,25 @@ class CompiledProgram:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if name == RNG_STATE_VAR:
+        from ..observe import metrics as _obs_metrics
+
+        if name == RNG_STATE_VAR or name == _obs_metrics.TELEMETRY_VAR:
+            # the telemetry accumulator is a dict pytree of scalars: a
+            # single replicated sharding acts as a pytree prefix
             return NamedSharding(self._mesh, P())
         spec = self._rules.spec_for(name, np.shape(value), self._mesh)
         return NamedSharding(self._mesh, P(*spec))
 
-    def _feed_sharding(self, value):
+    def _feed_sharding(self, name, value):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        shape = np.shape(value)
-        dp = self._mesh.shape.get(self._batch_axis, 1)
-        # a mesh WITHOUT the batch axis (e.g. pure {"sp": N}) must not
-        # reference it in a spec; feeds replicate
-        if (dp > 1 and len(shape) >= 1 and shape[0] % dp == 0
-                and shape[0] > 0):
-            return NamedSharding(
-                self._mesh, P(self._batch_axis, *([None] * (len(shape) - 1))))
-        return NamedSharding(self._mesh, P())
+        # the data-axis rule lives on ShardingRules (feed_spec_for):
+        # dim 0 over the batch axis when divisible, explicit rules win,
+        # meshes without the batch axis (pure {"sp": N}) replicate
+        spec = self._rules.feed_spec_for(name, np.shape(value),
+                                         self._mesh,
+                                         batch_axis=self._batch_axis)
+        return NamedSharding(self._mesh, P(*spec))
 
     # -- execution -------------------------------------------------------
     def run(self, executor, feed: Dict[str, Any], fetch_names, scope,
@@ -146,10 +163,35 @@ class CompiledProgram:
         to all-to-all, tests/test_moe.py) and for roofline tooling.
         One extra XLA compile; the traced fn comes from the same
         cache as run()."""
+        return self.compiled_step(feed, fetch_names, scope,
+                                  iterations=iterations).as_text()
+
+    def compiled_step(self, feed: Dict[str, Any], fetch_names=(),
+                      scope=None, iterations: int = 1):
+        """AOT-compile the SHARDED step and return the jax Compiled
+        object — the multi-device analog of Executor.compiled_step.
+        This is what the dp bench's comm accounting reads: the
+        post-SPMD module's collective instructions land in
+        observe.cost's `comm` bucket (all-reduce/all-gather/
+        reduce-scatter/all-to-all/collective-permute), so
+        `comm_bytes` comes from the SAME analytic accounting as every
+        other bucket.  Memoized per (feed signature, fetches,
+        iterations) — bench's comm fields reuse one compile."""
+        from ..core.executor import global_scope
+
         fn, state, feed_arrays, _, _ = self._prepare_step(
-            feed, fetch_names, scope, iterations, 1)
-        compiled = fn.lower(state, feed_arrays).compile()
-        return compiled.as_text()
+            feed, list(fetch_names), scope or global_scope(),
+            iterations, 1)
+        key = (self._program._uid, self._program._version,
+               tuple(sorted(feed)), tuple(fetch_names), iterations,
+               tuple((n, tuple(getattr(v, "shape", ()) or ()),
+                      str(getattr(v, "dtype", type(v).__name__)))
+                     for n, v in sorted(feed_arrays.items())))
+        compiled = self._aot_cache.get(key)
+        if compiled is None:
+            compiled = fn.lower(state, feed_arrays).compile()
+            self._aot_cache[key] = compiled
+        return compiled
 
     def _prepare_step(self, feed, fetch_names, scope, iterations,
                       accumulation_steps):
@@ -176,7 +218,23 @@ class CompiledProgram:
         state_names = tuple(sorted(
             v.name for v in block.vars.values()
             if v.persistable and scope.has_var(v.name)))
-        feed_shardings = {n: self._feed_sharding(v)
+        from ..observe import metrics as _obs_metrics
+
+        telemetry = getattr(program, "_telemetry_enabled", False)
+        if telemetry:
+            # mirror Executor._prepare: the device-side accumulator
+            # rides the (donated) state pytree so enable_telemetry()
+            # works identically under a mesh — bench dp entries carry
+            # the same honesty counters as single-device ones
+            if scope.find_var(_obs_metrics.TELEMETRY_VAR) is None:
+                guard_cfg = getattr(program, "_update_guard", None)
+                scope.set_var(
+                    _obs_metrics.TELEMETRY_VAR,
+                    _obs_metrics.init_telemetry(
+                        loss_scale=guard_cfg.init_loss_scale
+                        if guard_cfg is not None else 1.0))
+            state_names = state_names + (_obs_metrics.TELEMETRY_VAR,)
+        feed_shardings = {n: self._feed_sharding(n, v)
                           for n, v in feed.items()}
         # the chosen feed shardings are part of the key: a final partial
         # batch that is no longer dp-divisible must recompile with a
@@ -214,6 +272,13 @@ class CompiledProgram:
                                             feed_names=feed_names)
                 new_state = {n: env[n] for n in persistable_names
                              if n in env}
+                from ..observe.metrics import TELEMETRY_VAR
+
+                if TELEMETRY_VAR in env:
+                    # executor-private state (not a block var): threads
+                    # the step + chain_iterations carry, same as the
+                    # single-device step fn
+                    new_state[TELEMETRY_VAR] = env[TELEMETRY_VAR]
                 new_state[RNG_STATE_VAR] = jax.random.split(rng_key, 1)[0]
                 fetches = [env[n] for n in fetch_names]
                 return new_state, fetches

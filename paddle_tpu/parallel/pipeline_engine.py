@@ -261,6 +261,29 @@ def run_pipelined_group(group_ops, env: Dict[str, Any], rng_key,
     from .pipeline import gpipe
 
     block = program.global_block()
+    # pp×mp composition is a DESIGNED loud error on this jax/XLA
+    # (ISSUE 10; docs/DIST.md "pp×mp status").  The GPipe schedule runs
+    # the whole mesh manually (shard_map over every axis): an mp axis
+    # could only shard in-stage math via partial-auto shard_map
+    # (auto={'mp'}), which this XLA rejects at compile time
+    # ("PartitionId instruction is not supported for SPMD
+    # partitioning"); without it, stage params/activations silently
+    # REPLICATE over mp — mp-degree× redundant compute and memory that
+    # would masquerade as working tensor parallelism.  dp×pp composes
+    # (batch_axis) and stays supported; pinned by
+    # tests/test_pipeline_engine.py::test_pp_x_mp_is_a_designed_error
+    # and the dryrun_multichip pp×mp case.
+    composed = sorted(a for a, size in mesh.shape.items()
+                      if a not in ("pp", batch_axis) and size > 1)
+    if composed:
+        raise PipelineStructureError(
+            f"pipeline parallelism cannot compose with in-stage "
+            f"sharded axes {composed} on this backend: the pp "
+            f"shard_map would replicate {composed}-sharded params "
+            f"inside every stage (silent {'x'.join(str(mesh.shape[a]) for a in composed)}-fold "
+            f"redundant compute), and partial-auto shard_map is "
+            f"rejected by this XLA.  Use a dp×pp mesh, or mp without "
+            f"pp (docs/DIST.md, pp×mp status).")
     info = analyze_group(group_ops, block)
     segs, infos = info["segs"], info["infos"]
     L = len(segs)

@@ -13,6 +13,57 @@ import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+class GradSyncConfig:
+    """Opt-in gradient-synchronization mode for data parallelism
+    (ISSUE 10 / EQuARX, arxiv 2506.17615; full scheme + error model in
+    docs/DIST.md).
+
+    mode:
+      - "bf16": explicit shard_map gradient exchange (exact psum) —
+        the control arm: same code path, communication and RNG layout
+        as "int8" with quantization off, so A/Bs isolate quantization
+        error from everything else.
+      - "int8": EQuARX-style blockwise-int8 two-phase exchange
+        (collectives.quantized_all_reduce_local) — ~2x fewer gradient
+        bytes per phase.  Dense grads only; SparseGrad stays sparse
+        (ids+rows all_gather, O(touched) — quantizing a scatter-add
+        payload would compound error on hot rows); tensors below
+        `min_quant_numel` ride the exact psum.
+    The default (no GradSyncConfig) keeps the implicit GSPMD all-reduce
+    inserted from sharding annotations alone.
+
+    Restriction (designed, loud): explicit grad sync supports pure-dp
+    meshes — on dp×mp/dp×pp meshes params entering the exchange
+    shard_map would be all-gathered, silently un-sharding the model;
+    the executor raises instead (core/executor.py)."""
+
+    MODES = ("bf16", "int8")
+
+    def __init__(self, mode: str = "int8", block_size: int = 256,
+                 min_quant_numel: int = 4096):
+        if mode not in self.MODES:
+            raise ValueError(
+                f"grad_sync mode {mode!r} not in {self.MODES}")
+        self.mode = mode
+        self.block_size = int(block_size)
+        self.min_quant_numel = int(min_quant_numel)
+
+    @classmethod
+    def normalize(cls, value) -> Optional["GradSyncConfig"]:
+        """None | mode-string | GradSyncConfig -> GradSyncConfig|None
+        (the BuildStrategy.grad_sync coercion)."""
+        if value is None:
+            return None
+        if isinstance(value, cls):
+            return value
+        return cls(mode=str(value))
+
+    def __repr__(self):
+        return (f"GradSyncConfig(mode={self.mode!r}, "
+                f"block_size={self.block_size}, "
+                f"min_quant_numel={self.min_quant_numel})")
+
+
 class ShardingRules:
     """Ordered (regex, spec) rules; first match wins.
 
@@ -40,6 +91,26 @@ class ShardingRules:
                     spec = [None] * len(shape)
                     spec[dim] = self.fsdp_axis
                     return tuple(spec)
+        return (None,) * len(shape)
+
+    def feed_spec_for(self, name: str, shape, mesh,
+                      batch_axis: str = "dp") -> tuple:
+        """PartitionSpec dims for a FEED (the data axis of the mesh):
+        dim 0 shards over `batch_axis` when the mesh has it and the
+        batch divides — GSPMD then partitions the whole forward by
+        batch and inserts the gradient all-reduce implicitly (the
+        ParallelExecutor AllReduce mode).  An explicit rule matching
+        the feed name wins, so ragged companions or non-batch-major
+        feeds can override the data-axis default.  Non-divisible (or
+        scalar) feeds replicate — a final partial batch stays correct,
+        it just loses the dp speedup for that one step."""
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return self._validate(spec, shape, mesh)
+        dp = mesh.shape.get(batch_axis, 1)
+        if (dp > 1 and len(shape) >= 1 and shape[0] > 0
+                and shape[0] % dp == 0):
+            return (batch_axis,) + (None,) * (len(shape) - 1)
         return (None,) * len(shape)
 
     @staticmethod

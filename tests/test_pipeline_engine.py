@@ -183,3 +183,21 @@ def test_pipeline_plus_recompute():
     got = _run_steps(main, scope, model, exe, BATCH,
                      mesh=make_mesh({"pp": 4}))
     np.testing.assert_allclose(got, _ref(), rtol=1e-4, atol=1e-4)
+
+
+def test_pp_x_mp_is_a_designed_error():
+    """pp×mp composition (ISSUE 10): on this jax/XLA the manual pp
+    region would silently REPLICATE mp-sharded params inside every
+    stage (partial-auto shard_map dies in SPMD partitioning with
+    'PartitionId instruction is not supported'), so the engine raises
+    a designed PipelineStructureError naming the composed axes instead
+    of benching mp-degree-fold redundant compute as tensor
+    parallelism.  dp×pp (the batch axis) stays supported — see
+    test_pipelined_transformer_dp_x_pp.  Mirrored by the
+    dryrun_multichip pp×mp case (docs/DIST.md, pp×mp status)."""
+    main, scope, model, exe = _build_transformer(True, n_layer=2)
+    with pytest.raises(PipelineStructureError,
+                       match="cannot compose with in-stage sharded "
+                             "axes \\['mp'\\]"):
+        _run_steps(main, scope, model, exe, BATCH,
+                   mesh=make_mesh({"pp": 2, "mp": 2}), steps=1)

@@ -1,0 +1,160 @@
+"""collectives.quantized_all_reduce: the EQuARX-style blockwise-int8
+gradient exchange (ISSUE 10, docs/DIST.md).
+
+What these tests pin:
+- the ERROR MODEL: elementwise |quantized - exact| is bounded by the
+  analytic two-phase bound (0.5·Σ_r s_r phase-1 rounding + 0.5·s₂
+  phase-2 rounding, s = per-block max/127) — the bound documented in
+  docs/DIST.md, asserted, not vibes;
+- BITWISE determinism: two invocations agree exactly (the property dp
+  grad sync relies on so replicated params cannot drift apart);
+- the bf16-fallback floor: tensors below min_quant_numel (or below one
+  block per rank) ride the exact psum, bit-identical to all_reduce;
+- padding correctness for sizes that do not divide ranks·block;
+- non-float inputs fall back to the exact reduction.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.collectives import (all_reduce,
+                                             dequantize_blockwise,
+                                             quantize_blockwise,
+                                             quantized_all_reduce)
+
+N_DEV = 8
+BLOCK = 256
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    return make_mesh({"dp": N_DEV})
+
+
+def _phase_bound(x, block=BLOCK):
+    """Analytic elementwise error bound of the two-phase exchange on
+    stacked per-rank partials x (n, size), for op="sum": per block,
+    0.5·Σ_r s_r (phase-1 rounding of every rank's contribution) plus
+    0.5·s₂ where s₂ ≤ (max|exact block sum| + Σ_r 0.5·s_r)/127 (the
+    phase-2 scale is computed from the phase-1-rounded sum)."""
+    n, size = x.shape
+    pad = (-size) % (n * BLOCK)
+    xp = np.pad(x, ((0, 0), (0, pad)))
+    blocks = xp.reshape(n, -1, block)                  # (n, B, block)
+    s1 = np.maximum(np.abs(blocks).max(-1), 0.0) / 127.0
+    s1 = np.where(s1 > 0, s1, 0.0)                     # zero blocks: exact
+    phase1 = 0.5 * s1.sum(0)                           # (B,)
+    exact = blocks.sum(0)                              # (B, block)
+    s2 = (np.abs(exact).max(-1) + phase1) / 127.0
+    bound = phase1 + 0.5 * s2 + 1e-7                   # (B,)
+    return np.repeat(bound, block)[:size]
+
+
+def test_parity_within_error_model(mesh):
+    rng = np.random.RandomState(0)
+    # nonuniform block magnitudes so per-block scales actually differ
+    x = (rng.randn(N_DEV, 70000)
+         * np.exp(rng.uniform(-3, 3, (1, 70000)))).astype(np.float32)
+    out = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp",
+                                          op="sum"))
+    err = np.abs(out - x.sum(0, dtype=np.float64))
+    bound = _phase_bound(x)
+    assert (err <= bound).all(), \
+        f"error {err.max()} exceeds analytic bound at " \
+        f"{np.argmax(err - bound)}"
+
+
+def test_mean_matches_sum_over_n(mesh):
+    rng = np.random.RandomState(1)
+    x = rng.randn(N_DEV, 30000).astype(np.float32)
+    s = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp",
+                                        op="sum"))
+    m = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp",
+                                        op="mean"))
+    np.testing.assert_allclose(m, s / N_DEV, rtol=1e-6, atol=1e-7)
+
+
+def test_bitwise_deterministic(mesh):
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(N_DEV, 50000).astype(np.float32))
+    a = np.asarray(quantized_all_reduce(x, mesh, "dp"))
+    b = np.asarray(quantized_all_reduce(x, mesh, "dp"))
+    assert (a == b).all()
+
+
+def test_small_tensor_exact_fallback(mesh):
+    """Below the floor the exchange IS the exact psum — bit-identical
+    to all_reduce (the bf16-fallback contract for biases/LN scales)."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(N_DEV, 300).astype(np.float32))
+    q = np.asarray(quantized_all_reduce(x, mesh, "dp", op="sum"))
+    exact = np.asarray(all_reduce(x, mesh, "dp", op="sum"))
+    assert (q == exact).all()
+
+
+def test_floor_is_configurable(mesh):
+    """Dropping the floor below the tensor size turns quantization ON
+    (the result must now differ from the exact sum — proof the floor
+    actually routes, not merely tolerated error)."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray((100 * rng.randn(N_DEV, N_DEV * BLOCK))
+                    .astype(np.float32))
+    q = np.asarray(quantized_all_reduce(x, mesh, "dp", op="sum",
+                                        min_quant_numel=1))
+    exact = np.asarray(x).sum(0)
+    assert not np.array_equal(q, exact)
+    assert np.abs(q - exact).max() <= _phase_bound(np.asarray(x)).max()
+
+
+def test_padding_non_divisible_size(mesh):
+    rng = np.random.RandomState(5)
+    # 12345 is divisible by neither 8 nor 256
+    x = rng.randn(N_DEV, 12345).astype(np.float32)
+    out = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp",
+                                          op="sum", min_quant_numel=1))
+    err = np.abs(out - x.sum(0))
+    assert (err <= _phase_bound(x)).all()
+
+
+def test_nd_shapes_and_shape_preserved(mesh):
+    rng = np.random.RandomState(6)
+    x = rng.randn(N_DEV, 24, 96, 32).astype(np.float32)
+    out = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp",
+                                          op="mean", min_quant_numel=1))
+    assert out.shape == (24, 96, 32)
+    err = np.abs(out - x.mean(0))
+    bound = _phase_bound(x.reshape(N_DEV, -1)).reshape(24, 96, 32)
+    assert (err <= bound / N_DEV).all()
+
+
+def test_int_dtype_falls_back_exact(mesh):
+    x = jnp.asarray(np.arange(N_DEV * 100000)
+                    .reshape(N_DEV, -1).astype(np.int32))
+    out = np.asarray(quantized_all_reduce(x, mesh, "dp", op="sum"))
+    assert (out == np.asarray(x).sum(0)).all()
+
+
+def test_zero_blocks_roundtrip_exact(mesh):
+    """All-zero blocks must come back exactly zero (scale-0 blocks get
+    scale 1, so 0/1 rounds to int8 0 and dequantizes to 0.0) — a bias
+    toward tiny nonzeros here would inject phantom gradient."""
+    x = jnp.zeros((N_DEV, N_DEV * BLOCK * 4), jnp.float32)
+    out = np.asarray(quantized_all_reduce(x, mesh, "dp",
+                                          min_quant_numel=1))
+    assert (out == 0.0).all()
+
+
+def test_quantize_roundtrip_bound():
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(64, BLOCK).astype(np.float32) * 5)
+    q, s = quantize_blockwise(x, BLOCK)
+    assert q.dtype == jnp.int8 and s.shape == (64,)
+    back = np.asarray(dequantize_blockwise(q, s))
+    err = np.abs(back - np.asarray(x))
+    assert (err <= 0.5 * np.asarray(s)[:, None] + 1e-7).all()

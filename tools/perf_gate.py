@@ -43,9 +43,14 @@ import sys
 # (requests_per_sec = the serving_engine offered-load line;
 # tokens_per_sec + examples_per_sec both gate the scan-bound lstm
 # entry — throughput, not MFU, is the tracked axis there because the
-# scan path's MFU numerator counts loop bodies once, see bench_lstm)
+# scan path's MFU numerator counts loop bodies once, see bench_lstm;
+# the per_device_* trio gates dp-mesh entries — aggregate throughput
+# can mask a per-device regression when the mesh grew, so both gate)
 _THROUGHPUT_KEYS = ("tokens_per_sec", "imgs_per_sec",
-                    "examples_per_sec", "requests_per_sec")
+                    "examples_per_sec", "requests_per_sec",
+                    "per_device_tokens_per_sec",
+                    "per_device_imgs_per_sec",
+                    "per_device_examples_per_sec")
 # serving latency: lower is better
 _LATENCY_KEYS = ("compute_ms",)
 
@@ -158,14 +163,37 @@ def check_schema(candidate):
             errors.append(f"detail.{name}: training entry missing "
                           f"ckpt_blocking_ms (async-checkpoint cost "
                           f"observability)")
+        if "mesh" in entry:
+            # dp-mesh contract (ISSUE 10, docs/DIST.md): a multi-chip
+            # entry must carry per-device AND aggregate throughput plus
+            # the comm-bucket bytes — a dp number without its comm cost
+            # is not interpretable
+            for field in ("n_devices", "comm_bytes", "grad_sync"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: dp entry missing "
+                                  f"{field!r}")
+            if not any(k.startswith("per_device_") for k in entry):
+                errors.append(f"detail.{name}: dp entry missing "
+                              f"per_device_* throughput")
     return errors
 
 
 def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
-                   regressions, report, tol_mem=0.10, tol_ls=0.02):
+                   regressions, report, tol_mem=0.10, tol_ls=0.02,
+                   tol_comm=0.10):
     if "error" in cand and "error" not in base:
         regressions.append(f"{name}: candidate errored: "
                            f"{cand['error']}")
+        return
+    if base.get("mesh") != cand.get("mesh") or \
+            base.get("grad_sync") != cand.get("grad_sync"):
+        # a dp entry gates only against the SAME mesh + sync mode —
+        # comparing dp8 throughput to a single-chip baseline (or int8
+        # to bf16) would be apples-to-oranges in both directions
+        report.append(f"{name}: mesh/grad_sync mismatch "
+                      f"({base.get('mesh')}/{base.get('grad_sync')} vs "
+                      f"{cand.get('mesh')}/{cand.get('grad_sync')}) — "
+                      f"not compared")
         return
     if cand.get("skipped_update_steps"):
         # bench honesty: a throughput number that "improved" by
@@ -174,7 +202,9 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
             f"{name}: {cand['skipped_update_steps']} optimizer "
             f"update(s) SKIPPED inside the measured window (non-finite "
             f"taint) — throughput/MFU not comparable")
-    if "mfu" in base and "mfu" in cand:
+    # base mfu can legitimately round to 0.0 (CPU-smoke dp entries);
+    # only a nonzero baseline can gate a relative drop
+    if base.get("mfu") and "mfu" in cand:
         drop = (base["mfu"] - cand["mfu"]) / base["mfu"]
         line = (f"{name}.mfu: {base['mfu']:.4f} -> {cand['mfu']:.4f} "
                 f"({-drop:+.2%})")
@@ -227,10 +257,25 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
         if rise > tol_ls:
             regressions.append(
                 line + f" exceeds tol +{tol_ls:.2f} share points")
+    # dp comm traffic: modeled per-device collective bytes per step
+    # (same mesh + grad_sync guaranteed above).  Growth beyond
+    # tolerance is a regression even when throughput noise hides it —
+    # gradient-exchange bytes creeping back is exactly what the
+    # quantized path exists to prevent.
+    bcb, ccb = base.get("comm_bytes"), cand.get("comm_bytes")
+    if isinstance(bcb, (int, float)) and isinstance(ccb, (int, float)) \
+            and bcb:
+        rise = (ccb - bcb) / bcb
+        line = (f"{name}.comm_bytes: {bcb / 1e6:.1f}MB -> "
+                f"{ccb / 1e6:.1f}MB ({rise:+.2%})")
+        report.append(line)
+        if rise > tol_comm:
+            regressions.append(line + f" exceeds tol {tol_comm:.0%}")
 
 
 def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
-         tol_mem=0.10, tol_ls=0.02, allow_missing=False):
+         tol_mem=0.10, tol_ls=0.02, tol_comm=0.10,
+         allow_missing=False):
     """(regressions, report_lines, compared_count).  Only entries whose
     device kind matches are compared — a CPU smoke candidate never
     false-fails against chip numbers."""
@@ -257,7 +302,7 @@ def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
         compared += 1
         _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
                        regressions, report, tol_mem=tol_mem,
-                       tol_ls=tol_ls)
+                       tol_ls=tol_ls, tol_comm=tol_comm)
         if "int8" in base and isinstance(cand.get("int8"), dict) \
                 and "error" not in base["int8"]:
             if "error" in cand["int8"]:
@@ -299,6 +344,14 @@ def main() -> int:
                         "creeping back after the head-major layout "
                         "(ISSUE 8) is a regression even when "
                         "throughput noise hides it")
+    p.add_argument("--tol-comm-bytes", type=float, default=0.10,
+                   help="tolerated relative increase in a dp entry's "
+                        "comm_bytes (modeled per-device collective "
+                        "bytes per step, observe.cost comm bucket) — "
+                        "gradient-exchange traffic creeping back is a "
+                        "regression even when throughput noise hides "
+                        "it.  Compared only between entries with the "
+                        "same mesh AND grad_sync mode")
     p.add_argument("--allow-missing", action="store_true",
                    help="baseline entries absent from the candidate "
                         "are not regressions (partial --model runs)")
@@ -349,6 +402,7 @@ def main() -> int:
         baseline, candidate, tol_mfu=args.tol_mfu,
         tol_tp=args.tol_throughput, tol_lat=args.tol_latency,
         tol_mem=args.tol_peak_mem, tol_ls=args.tol_layout_share,
+        tol_comm=args.tol_comm_bytes,
         allow_missing=args.allow_missing)
     for line in report:
         print("  " + line)

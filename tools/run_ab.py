@@ -9,7 +9,13 @@ cross-talk); the probe inside bench.py keeps a dead backend from
 burning the timeout.
 
 r06 added the scan-bound lstm variants (unroll sweep + the Pallas fused
-recurrence kernel vs the scan base).  r07 adds the head-major layout
+recurrence kernel vs the scan base).  r08 adds the dp-mesh pair
+(ISSUE 10): dp8_bf16 (implicit GSPMD gradient all-reduce) vs
+dp8_int8ar (EQuARX blockwise-int8 quantized exchange, --grad-sync
+int8), with per-pair comm_bytes context in the summary — on the 8-CPU
+virtual mesh the pair records correctness + comm-byte deltas; the
+grad-sync default only flips on a chip throughput win.  r07 added the
+head-major layout
 variants (ISSUE 8): transformer_headmajor / transformer_pallas_headmajor
 record the layout at the short-seq headline shape — the latter is the
 r05 pallas-attn crossover question (136.7k vs 157.1k tok/s at len256:
@@ -104,6 +110,16 @@ VARIANTS = [
     ("lstm_unroll4", ["--model", "lstm", "--rnn-unroll", "4"]),
     ("lstm_unroll8", ["--model", "lstm", "--rnn-unroll", "8"]),
     ("lstm_pallas_rnn", ["--model", "lstm", "--pallas-rnn"]),
+    # dp-mesh gradient exchange (ISSUE 10, docs/DIST.md): the bf16 side
+    # is the default implicit GSPMD all-reduce, the int8 side the
+    # EQuARX blockwise-quantized two-phase exchange.  On the 8-CPU
+    # virtual mesh this pair records CORRECTNESS + the comm-bytes delta
+    # (each entry carries comm_bytes from the sharded step's comm
+    # bucket); the wall-clock verdict that could flip the --grad-sync
+    # default needs a real multi-chip slice, per the device-tag rule.
+    ("dp8_bf16", ["--model", "transformer", "--mesh", "dp=8"]),
+    ("dp8_int8ar", ["--model", "transformer", "--mesh", "dp=8",
+                    "--grad-sync", "int8"]),
 ]
 
 
@@ -250,6 +266,27 @@ def layout_measure(results, k):
     return None
 
 
+def comm_measure(results, k):
+    """The variant's comm_bytes (modeled per-device collective bytes
+    per step from the sharded compiled module's comm bucket,
+    bench.py/_comm_fields), or None for NO DATA — the context every dp
+    pair carries: an int8 "win" that didn't actually shrink the
+    gradient exchange would be noise, and a loss that did shrink it is
+    still the lever to retune.  Throughput decides, as everywhere."""
+    d = results.get(k, {})
+    if "error" in d or "failed" in d or \
+            d.get("metric") == "bench_failed":
+        return None
+    detail = d.get("detail") or {}
+    model = _VARIANT_MODEL.get(k)
+    subs = (_model_entries(detail, model) if model is not None
+            else [sub for sub in detail.values() if isinstance(sub, dict)])
+    for sub in subs:
+        if isinstance(sub.get("comm_bytes"), (int, float)):
+            return sub["comm_bytes"]
+    return None
+
+
 def wins(results, a, b):
     # a missing side must yield "no data", never a vacuous win —
     # AB wins gate bench defaults (CLAUDE.md measured-wins-only).
@@ -283,6 +320,10 @@ _PAIRS = {
     "lstm_unroll4": ("lstm_unroll4", "lstm_base"),
     "lstm_unroll8": ("lstm_unroll8", "lstm_base"),
     "lstm_pallas_rnn": ("lstm_pallas_rnn", "lstm_base"),
+    # the quantized gradient exchange vs the implicit bf16 all-reduce
+    # at the same dp degree; per-pair comm-bytes context rides the
+    # summary (<name>_comm_bytes)
+    "dp8_int8ar": ("dp8_int8ar", "dp8_bf16"),
 }
 
 
@@ -304,6 +345,12 @@ def compute_summary(results):
             # the head-major traffic-deletion claim, recorded next to
             # the throughput verdict that decides the default
             out[f"{name}_layout_share"] = {a: la, b: lb}
+        ca, cb = comm_measure(results, a), comm_measure(results, b)
+        if ca is not None and cb is not None:
+            # the dp pairs' point: how many collective bytes each side
+            # actually moves per step (int8's claim is ~half); recorded
+            # next to the throughput verdict that decides the default
+            out[f"{name}_comm_bytes"] = {a: ca, b: cb}
     return out
 
 
@@ -311,7 +358,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r07.json")
+    p.add_argument("--out", default="AB_r08.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     p.add_argument("--bench-args", default=None,

@@ -397,6 +397,112 @@ echo "== crash-resume smoke (cpu) =="
 # (JAX_PLATFORMS env is too late here — sitecustomize imports jax).
 python tests/test_preempt.py --ci-smoke
 
+echo "== dp-mesh bench smoke (8 virtual devices, cpu) =="
+# ISSUE 10 tentpole: `bench.py --mesh dp=N` must emit one JSON line
+# whose dp entry carries per-device AND aggregate throughput plus the
+# comm-bucket bytes of the sharded step (docs/DIST.md).  Tiny global
+# batch: the 8 virtual devices share one host core, so every
+# collective rendezvous is serialized.
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "transformer", "--mesh",
+     "dp=8", "--batch", "8", "--steps", "2", "--warmup", "1",
+     "--probe-timeout", "0", "--model-deadline", "2400"],
+    capture_output=True, text=True, timeout=3000)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "dp bench printed no JSON line:\n" + \
+    (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["transformer_dp8"]
+assert "error" not in d, d
+assert d["mesh"] == {"dp": 8} and d["n_devices"] == 8, d
+assert d["tokens_per_sec"] > 0
+assert abs(d["per_device_tokens_per_sec"] - d["tokens_per_sec"] / 8) \
+    < 0.5
+assert isinstance(d["comm_bytes"], (int, float)) and \
+    d["comm_bytes"] > 0, d.get("comm_error", d.get("comm_bytes"))
+# the dp schema contract must hold for perf_gate --schema
+with open("/tmp/bench_dp_line.json", "w") as f:
+    f.write(lines[-1])
+print("dp bench smoke OK:",
+      {k: d[k] for k in ("tokens_per_sec", "per_device_tokens_per_sec",
+                         "comm_bytes", "comm_share", "n_devices",
+                         "grad_sync")})
+EOF
+python tools/perf_gate.py --schema --candidate /tmp/bench_dp_line.json
+
+echo "== quantized all-reduce parity smoke (8 virtual devices, cpu) =="
+# ISSUE 10: the EQuARX blockwise-int8 exchange must stay (1) within
+# its analytic error bound of the exact sum, (2) bitwise
+# deterministic, (3) bit-exact below the quantization floor; and a
+# 3-step int8-synced dp training run must track the explicit-bf16
+# control arm (full suite: tests/test_quantized_allreduce.py +
+# tests/test_grad_sync.py).
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+python - <<'EOF'
+import numpy as np
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.collectives import (all_reduce,
+                                             quantized_all_reduce)
+
+mesh = make_mesh({"dp": 8})
+rng = np.random.RandomState(0)
+x = rng.randn(8, 70000).astype(np.float32)
+q = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp"))
+exact = x.mean(0)
+rel = np.abs(q - exact).max() / np.abs(exact).max()
+assert rel < 0.05, f"quantized mean off by {rel:.3f}"
+q2 = np.asarray(quantized_all_reduce(jnp.asarray(x), mesh, "dp"))
+assert (q == q2).all(), "quantized all-reduce not deterministic"
+small = jnp.asarray(rng.randn(8, 200).astype(np.float32))
+assert (np.asarray(quantized_all_reduce(small, mesh, "dp", op="sum"))
+        == np.asarray(all_reduce(small, mesh, "dp", op="sum"))).all(), \
+    "below-floor tensor did not ride the exact psum"
+
+def run(mode):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        xv = layers.data("x", shape=[32], dtype="float32")
+        yv = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(layers.fc(xv, size=128, act="relu"), size=1)
+        loss = layers.mean(layers.square_error_cost(pred, yv))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        bs.grad_sync = mode
+        fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs,
+            mesh=make_mesh({"dp": 8}))
+        r2 = np.random.RandomState(1)
+        out = []
+        for _ in range(3):
+            (lv,) = exe.run(main, feed={
+                "x": r2.randn(64, 32).astype(np.float32),
+                "y": r2.randn(64, 1).astype(np.float32)},
+                fetch_list=[loss])
+            out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return np.asarray(out)
+
+bf16, int8 = run("bf16"), run("int8")
+drel = np.abs(int8 - bf16).max() / np.abs(bf16).max()
+assert drel < 1e-2, f"int8 trajectory off bf16 by {drel:.2e}"
+assert np.isfinite(int8).all()
+print("quantized all-reduce smoke OK:",
+      {"mean_rel_err": round(float(rel), 5),
+       "deterministic": True, "floor_exact": True,
+       "traj_rel_dev": round(float(drel), 6)})
+EOF
+
 echo "== perf gate (schema + synthetic-regression smoke, cpu) =="
 # 1. the fresh bench line must satisfy the observability schema
 python tools/perf_gate.py --schema --candidate /tmp/bench_ci_line.json
