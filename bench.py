@@ -94,31 +94,50 @@ def _enable_observability(program):
         from paddle_tpu import resilience
 
         resilience.enable_update_guard(program)  # implies telemetry
-    elif _TELEMETRY:
+    if _TELEMETRY or _GUARD:
         from paddle_tpu import observe
 
-        observe.enable_telemetry(program)
+        # observe pillar 6 rides the same accumulator: per-group
+        # dynamics + first-nonfinite provenance, so every training
+        # entry can attribute a tainted window to a fluid op/layer
+        # (implies enable_telemetry)
+        observe.enable_numerics(program)
 
 
 def _fetch_tel(program, scope):
     """One host sync: the measured window's telemetry (None when
-    telemetry is off)."""
+    telemetry is off).  The program join lets a latched nonfinite
+    bitmap name its fluid op in the entry."""
     if not getattr(program, "_telemetry_enabled", False):
         return None
     from paddle_tpu import observe
 
-    return observe.fetch_telemetry(scope, reset=True)
+    return observe.fetch_telemetry(scope, reset=True, program=program)
 
 
 def _tel_fields(tel):
     """The honesty fields every training entry carries.  None = this
     run measured without telemetry (--no-telemetry) — explicitly
-    unknown, not clean."""
+    unknown, not clean.  grad_norm_last + the worst-group update ratio
+    (observe pillar 6) make divergence visible next to the throughput
+    number; first_nonfinite_op appears only when a window tripped."""
     if tel is None:
-        return {"nonfinite_steps": None, "skipped_update_steps": None}
-    return {"nonfinite_steps": max(tel.nonfinite_grad_steps,
-                                   tel.nonfinite_loss_steps),
-            "skipped_update_steps": tel.skipped_update_steps}
+        return {"nonfinite_steps": None, "skipped_update_steps": None,
+                "grad_norm_last": None, "update_ratio_worst": None}
+    from paddle_tpu import observe
+
+    wg, wr = observe.worst_update_ratio(tel.groups)
+    out = {"nonfinite_steps": max(tel.nonfinite_grad_steps,
+                                  tel.nonfinite_loss_steps),
+           "skipped_update_steps": tel.skipped_update_steps,
+           "grad_norm_last": round(tel.grad_norm_last, 6),
+           "update_ratio_worst": (round(wr, 8) if wr is not None
+                                  else None)}
+    if wg is not None:
+        out["update_ratio_worst_group"] = wg
+    if tel.first_nonfinite_op is not None:
+        out["first_nonfinite_op"] = tel.first_nonfinite_op
+    return out
 
 
 def _timed_loop(exe, program, feed_dev, loss, steps, warmup, scope=None):

@@ -193,11 +193,19 @@ class Trainer:
             from .. import observe
 
             observe.enable_telemetry(self.train_program)
+            if getattr(self.telemetry_cfg, "numerics", False):
+                # observe pillar 6: per-group dynamics + first-
+                # nonfinite provenance ride the same accumulator; a
+                # poisoned window additionally emits a
+                # `nonfinite_provenance` event below
+                observe.enable_numerics(self.train_program)
             self._event_log = self.telemetry_cfg.event_log
             if self._event_log is None and self.telemetry_cfg.log_path:
                 self._event_log = observe.RunEventLog(
                     self.telemetry_cfg.log_path,
-                    meta={"source": "contrib.Trainer"})
+                    meta={"source": "contrib.Trainer"},
+                    max_bytes=getattr(self.telemetry_cfg,
+                                      "max_log_bytes", None))
         self.exe = Executor(place)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
@@ -260,8 +268,12 @@ class Trainer:
                              "data": arr.tolist()}
         tel = self.scope.find_var(TELEMETRY_VAR)
         if tel is not None:
-            st["telemetry"] = {k: np.asarray(v).item()
-                               for k, v in tel.items()}
+            # numerics vector fields (per-group norms, the latched
+            # bitmap) serialize as lists; scalars stay scalars
+            st["telemetry"] = {
+                k: (np.asarray(v).item() if np.asarray(v).ndim == 0
+                    else np.asarray(v).tolist())
+                for k, v in tel.items()}
         reader = self._active_reader
         if reader is not None and hasattr(reader, "state_dict"):
             st["reader_state"] = reader.state_dict()
@@ -298,7 +310,7 @@ class Trainer:
         import jax.numpy as jnp
 
         from ..core.executor import RNG_STATE_VAR
-        from ..observe.metrics import TELEMETRY_VAR, init_telemetry
+        from ..observe.metrics import TELEMETRY_VAR, init_telemetry_for
 
         rng = st.get("rng_key")
         if rng is not None:
@@ -308,10 +320,21 @@ class Trainer:
                                      dtype=np.dtype(rng["dtype"]))))
         tel = st.get("telemetry")
         if tel is not None:
-            fresh = init_telemetry()
+            # dtype/shape template per field (init_telemetry_for sizes
+            # the numerics vectors for THIS build's program); fields
+            # the checkpoint lacks — or whose shape drifted with the
+            # program — stay zeroed
+            fresh = init_telemetry_for(self.train_program)
             for k, v in tel.items():
-                if k in fresh:  # dtype template: i32 vs f32 per field
-                    fresh[k] = np.asarray(fresh[k]).dtype.type(v)
+                if k not in fresh:
+                    continue
+                tmpl = np.asarray(fresh[k])
+                if tmpl.ndim == 0:
+                    fresh[k] = tmpl.dtype.type(v)
+                else:
+                    arr = np.asarray(v, dtype=tmpl.dtype)
+                    if arr.shape == tmpl.shape:
+                        fresh[k] = arr
             self.scope.set_var(TELEMETRY_VAR, fresh)
         self._resume_reader_state = st.get("reader_state")
 
@@ -679,10 +702,15 @@ class Trainer:
 
     def _publish_telemetry(self, epoch: int, step: int, since):
         """Fetch the device accumulator (ONE host sync), attach the
-        window's host runtime stats, and emit a `telemetry` event."""
+        window's host runtime stats, and emit a `telemetry` event.
+        With numerics enabled (observe pillar 6) the fetch joins the
+        latched bitmap to the fluid op desc, and a window that latched
+        a poisoned step emits a LOUD `nonfinite_provenance` event —
+        the enriched form of a bare guard-trip counter."""
         from .. import observe
 
-        tel = observe.fetch_telemetry(self.scope, reset=True)
+        tel = observe.fetch_telemetry(self.scope, reset=True,
+                                      program=self.train_program)
         now = observe.runtime_stats.snapshot()
         if tel is None or tel.steps == 0:
             return now
@@ -697,6 +725,17 @@ class Trainer:
                 dispatches=delta["dispatches"],
                 dispatch_time_s=round(delta["dispatch_time_s"], 4),
                 peak_mem_bytes=observe.peak_memory_bytes())
+            if tel.first_nonfinite_op is not None:
+                wg, wr = observe.worst_update_ratio(tel.groups)
+                self._event_log.event(
+                    "nonfinite_provenance", epoch=epoch, step=step,
+                    first_nonfinite_op=tel.first_nonfinite_op,
+                    nonfinite_grad_steps=tel.nonfinite_grad_steps,
+                    nonfinite_loss_steps=tel.nonfinite_loss_steps,
+                    skipped_update_steps=tel.skipped_update_steps,
+                    loss_scale=tel.loss_scale,
+                    worst_update_ratio_group=wg,
+                    worst_update_ratio=wr)
         return now
 
     def save_params(self, dirname: str):

@@ -173,12 +173,27 @@ def run_ops(ops, env: Dict[str, Any], rng_key, start_index: int = 0,
                 j += 1
             from ..parallel.pipeline_engine import run_pipelined_group
 
+            # the numerics bitmap must not enter the gpipe shard_map
+            # (stage-local envs would OR bits under a ppermute carry);
+            # attribute the group's ops from their top-level outputs
+            # after the schedule instead
+            saved_bits = env.pop("__numerics_bits__", None)
             run_pipelined_group(
                 ops[i:j], env, rng_key, start_index + i, program,
                 pp_ctx.mesh, batch_axis=pp_ctx.batch_axis,
                 n_micro_req=pp_ctx.pipeline_microbatches,
                 amp_lists=amp_lists,
                 downstream_reads=suffix_reads.get(j))
+            if saved_bits is not None:
+                from ..observe import numerics as _obs_num
+
+                bits = saved_bits
+                for off, gop in enumerate(ops[i:j]):
+                    bits = _obs_num.update_bits(
+                        bits, start_index + i + off,
+                        [env[n] for n in gop.desc.output_names()
+                         if n in env])
+                env["__numerics_bits__"] = bits
             i = j
             continue
         tag = ops[i].desc.attrs.get("__recompute__")
@@ -229,6 +244,13 @@ def _run_checkpointed_segment(seg_ops, env, rng_key, start_index,
                 read_set.add(n)
         written.update(op.desc.output_names())
     out_names = sorted(written if keep is None else written & keep)
+    if "__numerics_bits__" in env:
+        # the per-op finite bitmap (observe pillar 6) must enter and
+        # leave the checkpoint explicitly: bits set by remat-internal
+        # ops would otherwise die inside the segment
+        if "__numerics_bits__" not in read_set:
+            read.append("__numerics_bits__")
+        out_names.append("__numerics_bits__")
 
     # non-array env entries (host constants) can't cross the
     # checkpoint boundary as traced args; keep them closed-over
@@ -275,34 +297,46 @@ def _run_one_op(op, env, rng_key, op_index, amp_lists=None,
                 ctx = OpContext(rng_key, op_index=op_index,
                                 program=program, amp_lists=amp_lists)
                 get_macro_op_impl(desc.type)(ctx, env, desc)
-                return env
-            impl = get_op_impl(desc.type)
-            ins = {
-                slot: [env[n] for n in names]
-                for slot, names in desc.inputs.items()
-            }
-            if desc.type not in SPARSE_AWARE_OPS:
-                ins = {slot: [densify(v) for v in vals]
-                       for slot, vals in ins.items()}
-            if amp_lists is not None:
-                from ..amp import cast_ins_for_op
+                outs = None  # macro impls write env themselves
+            else:
+                impl = get_op_impl(desc.type)
+                ins = {
+                    slot: [env[n] for n in names]
+                    for slot, names in desc.inputs.items()
+                }
+                if desc.type not in SPARSE_AWARE_OPS:
+                    ins = {slot: [densify(v) for v in vals]
+                           for slot, vals in ins.items()}
+                if amp_lists is not None:
+                    from ..amp import cast_ins_for_op
 
-                ins = cast_ins_for_op(desc.type, ins, amp_lists)
-            ctx = OpContext(rng_key, op_index=op_index,
-                            program=program, amp_lists=amp_lists,
-                            sparse_rows=sparse_rows)
-            outs = impl(ctx, ins, desc.attrs)
+                    ins = cast_ins_for_op(desc.type, ins, amp_lists)
+                ctx = OpContext(rng_key, op_index=op_index,
+                                program=program, amp_lists=amp_lists,
+                                sparse_rows=sparse_rows)
+                outs = impl(ctx, ins, desc.attrs)
     except Exception as exc:
         _reraise_with_op_context(exc, desc, op_index)
-    for slot, names in desc.outputs.items():
-        values = outs.get(slot, [])
-        if len(values) != len(names):
-            raise RuntimeError(
-                f"op {desc.type}: output slot {slot!r} produced "
-                f"{len(values)} values for {len(names)} names"
-            )
-        for name, val in zip(names, values):
-            env[name] = val
+    if outs is not None:
+        for slot, names in desc.outputs.items():
+            values = outs.get(slot, [])
+            if len(values) != len(names):
+                raise RuntimeError(
+                    f"op {desc.type}: output slot {slot!r} produced "
+                    f"{len(values)} values for {len(names)} names"
+                )
+            for name, val in zip(names, values):
+                env[name] = val
+    if "__numerics_bits__" in env:
+        # first-nonfinite op provenance (observe pillar 6): OR this
+        # op's finite flag into the step bitmap — trace-time only, and
+        # only when the program opted in (the bits var is absent
+        # otherwise, so the disabled step is byte-identical)
+        from ..observe import numerics as _obs_num
+
+        env[_obs_num.NUMERICS_BITS_VAR] = _obs_num.update_bits(
+            env[_obs_num.NUMERICS_BITS_VAR], op_index,
+            [env[n] for n in desc.output_names() if n in env])
     return env
 
 
@@ -394,6 +428,23 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     fwd_keep = set(fetch_names) | persist | {loss_name}
     for op in rest_ops:
         fwd_keep.update(op.desc.input_names())
+
+    # numerics observability (observe pillar 6): seed the per-step
+    # finite bitmap BEFORE the forward closure captures env — every
+    # _run_one_op below then ORs its op's finite flag into it, and the
+    # end of this function latches it into the telemetry accumulator.
+    # Nothing here runs when the program did not opt in.
+    from ..observe import metrics as _obs_metrics
+
+    num_on = False
+    if (getattr(program, "_numerics_enabled", False)
+            and _obs_metrics.TELEMETRY_VAR in env):
+        from ..observe import numerics as _obs_num
+
+        if _obs_num.NONFINITE_WORDS in env[_obs_metrics.TELEMETRY_VAR]:
+            env[_obs_num.NUMERICS_BITS_VAR] = _obs_num.init_step_bits(
+                len(ops))
+            num_on = True
 
     def fwd(params, base_env, key, sparse_rows=None):
         e = dict(base_env)
@@ -535,6 +586,19 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
                     _guard.guard_telemetry_update(
                         env[_obs_metrics.TELEMETRY_VAR], finite,
                         guard_cfg)
+            if num_on:
+                # observe pillar 6: per-group dynamics + the
+                # first-nonfinite latch.  Still the same trace; the
+                # bitmap is consumed here and never leaves the step.
+                from ..observe import numerics as _obs_num
+
+                bits = env.pop(_obs_num.NUMERICS_BITS_VAR)
+                tel = _obs_num.device_group_update(
+                    env[_obs_metrics.TELEMETRY_VAR], grads, trainable,
+                    env, _obs_num.param_groups(trainable))
+                env[_obs_metrics.TELEMETRY_VAR] = _obs_num.latch_step_bits(
+                    tel, bits,
+                    poisoned_extra=None if finite is None else ~finite)
     return env
 
 
@@ -736,6 +800,12 @@ def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
                 min_quant_numel=cfg.min_quant_numel, op="mean")
         return jax.lax.pmean(g, axis)
 
+    # numerics bitmap (observe pillar 6): per-rank bitmaps differ (each
+    # rank sees its own batch shard), so the step bitmap is the exact
+    # bitwise OR across the dp axis — provenance names the earliest
+    # poisoned op on ANY rank
+    track_bits = "__numerics_bits__" in base_env
+
     def body(params, feed_shards):
         key = jax.random.fold_in(rng_key, jax.lax.axis_index(axis))
         loss, grads, e_after = local_grads(params, feed_shards, key)
@@ -753,15 +823,24 @@ def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
                     jnp.asarray(v).astype(jnp.int32), axis) > 0)
             else:
                 outs.append(jax.lax.pmax(v, axis))
+        if track_bits:
+            from ..observe import numerics as _obs_num
+
+            outs.append(_obs_num.or_across_axis(
+                e_after["__numerics_bits__"], axis))
         return loss, grads, tuple(outs)
 
     out_specs = (P(), P(), tuple(
-        P(axis) if batchish[name] else P() for name in out_names))
+        P(axis) if batchish[name] else P() for name in out_names)
+        + ((P(),) if track_bits else ()))
     sm = compat_shard_map(
         body, mesh,
         in_specs=(P(), {k: P(axis) for k in feeds}),
         out_specs=out_specs)
     loss_val, grads, outs = sm(trainable, feeds)
+    if track_bits:
+        env["__numerics_bits__"] = outs[-1]
+        outs = outs[:-1]
     for name, val in zip(out_names, outs):
         env[name] = val
     return loss_val, grads, env
@@ -828,6 +907,11 @@ def _accumulate_gradients(program, fwd, fwd_ops, trainable, env, rng_key,
     carried = sorted(n for n in persist_written if n in env)
     computed = sorted(n for n in persist_written if n not in env)
 
+    # numerics bitmap (observe pillar 6): each micro-batch starts from
+    # the step's zeroed bitmap in base_env; the per-micro-batch results
+    # are OR-merged below so the step-level bitmap covers all K
+    track_bits = "__numerics_bits__" in base_env
+
     def body(carry, inp):
         gacc, persist = carry
         idx, mslice = inp
@@ -840,13 +924,20 @@ def _accumulate_gradients(program, fwd, fwd_ops, trainable, env, rng_key,
         new_persist = {n: e_after[n] for n in carried}
         ys = (loss, tuple(e_after[n] for n in fetch_fwd),
               tuple(e_after[n] for n in computed))
+        if track_bits:
+            ys = ys + (e_after["__numerics_bits__"],)
         return (gacc, new_persist), ys
 
     gzero = jax.tree_util.tree_map(jnp.zeros_like, trainable)
     idxs = jnp.arange(accum_steps)
     init_persist = {n: env[n] for n in carried}
-    (gsum, final_persist), (losses, fetch_stacks, computed_stacks) = \
+    (gsum, final_persist), ys_out = \
         jax.lax.scan(body, (gzero, init_persist), (idxs, feeds))
+    bits_stack = None
+    if track_bits:
+        losses, fetch_stacks, computed_stacks, bits_stack = ys_out
+    else:
+        losses, fetch_stacks, computed_stacks = ys_out
     inv = 1.0 / accum_steps
     grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
     loss_val = jnp.mean(losses)
@@ -869,6 +960,11 @@ def _accumulate_gradients(program, fwd, fwd_ops, trainable, env, rng_key,
     env.update(final_persist)
     for n, v in zip(computed, computed_stacks):
         env[n] = v[-1]
+    if bits_stack is not None:
+        merged = bits_stack[0]
+        for t in range(1, accum_steps):
+            merged = merged | bits_stack[t]
+        env["__numerics_bits__"] = merged
     # keep full-batch feeds visible for any fetch of a feed var
     for n in feeds:
         env[n] = feeds[n].reshape((-1,) + feeds[n].shape[2:])
@@ -1080,14 +1176,18 @@ class Executor:
         if telemetry:
             # the accumulator rides in the state pytree (donated,
             # carried through chain_iterations); creating it here keeps
-            # enable_telemetry() a pure program-level flag flip
-            if scope.find_var(_obs_metrics.TELEMETRY_VAR) is None:
-                guard_cfg = getattr(program, "_update_guard", None)
-                scope.set_var(
-                    _obs_metrics.TELEMETRY_VAR,
-                    _obs_metrics.init_telemetry(
-                        loss_scale=guard_cfg.init_loss_scale
-                        if guard_cfg is not None else 1.0))
+            # enable_telemetry() a pure program-level flag flip.
+            # init_telemetry_for sizes the numerics fields (per-group
+            # vectors + per-op bitmap) when the program opted in
+            tel_cur = scope.find_var(_obs_metrics.TELEMETRY_VAR)
+            if tel_cur is None:
+                scope.set_var(_obs_metrics.TELEMETRY_VAR,
+                              _obs_metrics.init_telemetry_for(program))
+            else:
+                patched = _obs_metrics.ensure_numerics_fields(
+                    program, tel_cur)
+                if patched is not tel_cur:
+                    scope.set_var(_obs_metrics.TELEMETRY_VAR, patched)
             state_names = state_names + (_obs_metrics.TELEMETRY_VAR,)
         key = (program._uid, program._version, tuple(sorted(feed)),
                tuple(fetch_names), state_names, iterations,

@@ -120,6 +120,10 @@ class OpContext:
         if self.program is None:
             raise RuntimeError("OpContext has no program; sub-block "
                                "execution requires a program trace")
+        # numerics provenance (observe pillar 6) attributes sub-block
+        # ops to the OWNING macro op: sub-block op indices are
+        # block-local and would corrupt the global per-op bitmap
+        env.pop("__numerics_bits__", None)
         block = self.program.blocks[block_idx]
         sub_key = (None if self._rng_key is None
                    else jax.random.fold_in(self._rng_key, 7919 + block_idx))

@@ -36,6 +36,17 @@ Three pillars (docs/OBSERVE.md):
    dtype, remat) config from two small probe compiles, without ever
    compiling the candidate.  serving.ServingEngine validates its
    bucket ladder with it; bench.py entries carry `mem_breakdown`.
+
+6. NUMERICS — `numerics.py` (the production replacement for the
+   reference's host-side per-op NaN scan, operator.cc:943): per-layer
+   training dynamics (grad/param norms + update ratio per NAMED
+   parameter group, the sharding-layer names) as vector fields riding
+   the `__telemetry__` accumulator, and first-nonfinite op provenance
+   — a per-op finite bitmap computed in-step and latched on the first
+   poisoned step, joined host-side to the fluid op desc
+   (`numerics_report`/`format_numerics_table`;
+   `StepTelemetry.groups`/`.first_nonfinite_op`).  All device-side,
+   zero extra dispatches, byte-identical step when disabled.
 """
 
 from . import cost  # noqa: F401
@@ -43,9 +54,9 @@ from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    device_peaks, flash_boundary_layout,
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
-from .events import (GANG_EVENTS, RESILIENCE_EVENTS,  # noqa: F401
-                     SERVING_EVENTS, RunEventLog, git_sha, new_run_id,
-                     read_events)
+from .events import (GANG_EVENTS, NUMERICS_EVENTS,  # noqa: F401
+                     RESILIENCE_EVENTS, SERVING_EVENTS, RunEventLog,
+                     git_sha, new_run_id, read_events)
 from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401
                      device_memory_budget, export_chrome_trace,
                      format_memory_table, memory_report, memory_table,
@@ -56,6 +67,11 @@ from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
 from .monitoring import (LatencyHistogram, RuntimeStats,  # noqa: F401
                          device_memory_stats, peak_memory_bytes,
                          runtime_stats)
+from .numerics import (GROUP_NAMES, enable_numerics,  # noqa: F401
+                       format_numerics_table, group_of,
+                       join_first_nonfinite, numerics_enabled,
+                       numerics_report, param_groups,
+                       worst_update_ratio)
 from .trace import fluid_op_of, format_op_table, op_time_table  # noqa: F401
 
 
@@ -67,11 +83,21 @@ class TelemetryConfig:
     log_path: write telemetry windows to this JSONL file (a
         RunEventLog is created per training run).
     event_log: alternatively, an existing RunEventLog to emit into.
+    numerics: also enable observe pillar 6 on the train program —
+        per-layer (named parameter group) training dynamics riding the
+        same accumulator, and first-nonfinite op provenance; a window
+        that latched a poisoned step additionally emits a
+        `nonfinite_provenance` event through the RunEventLog.
+    max_log_bytes: size-bound the JSONL log created from `log_path`
+        (RunEventLog max_bytes rotation); None = unbounded.
     """
 
-    def __init__(self, interval: int = 10, log_path=None, event_log=None):
+    def __init__(self, interval: int = 10, log_path=None, event_log=None,
+                 numerics: bool = False, max_log_bytes=None):
         if interval < 1:
             raise ValueError("telemetry interval must be >= 1")
         self.interval = int(interval)
         self.log_path = log_path
         self.event_log = event_log
+        self.numerics = bool(numerics)
+        self.max_log_bytes = max_log_bytes

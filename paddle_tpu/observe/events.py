@@ -75,6 +75,18 @@ GANG_EVENTS = (
 )
 
 
+# numerics observability event kinds (docs/OBSERVE.md pillar 6):
+# emitted by contrib.Trainer next to its telemetry windows
+NUMERICS_EVENTS = (
+    "nonfinite_provenance",  # LOUD: a telemetry window latched a
+    #                          poisoned step — carries the joined
+    #                          first_nonfinite_op (fluid op type/index/
+    #                          group), the guard's skip counter and the
+    #                          loss scale, so a skipped update is
+    #                          attributable without re-running anything
+)
+
+
 def new_run_id() -> str:
     """Short unique id for one run/invocation (12 hex chars)."""
     return uuid.uuid4().hex[:12]
@@ -121,16 +133,32 @@ class RunEventLog:
     Records carry {ts (unix seconds), run_id, event, ...fields}.  The
     first record is `run_begin` with run provenance (git sha, backend,
     mesh); `close()` appends `run_end`.
+
+    `max_bytes`: size-bound the log for long gang/serving runs (they
+    append JSONL unbounded otherwise).  When the file would exceed the
+    bound it rolls to `<path>.1` (one generation kept, the classic
+    rotate) and the fresh file starts with a `run_rotate` record so a
+    tailer knows records continue from a rolled file.  Rotation happens
+    under the same write lock as every record (the PR 7 thread-locked
+    path), so concurrent background-writer events never interleave or
+    land in a half-rotated file.
     """
 
     def __init__(self, path: str, run_id: Optional[str] = None,
                  mesh_shape: Optional[Dict[str, int]] = None,
-                 meta: Optional[Dict[str, Any]] = None):
+                 meta: Optional[Dict[str, Any]] = None,
+                 max_bytes: Optional[int] = None):
+        if max_bytes is not None and int(max_bytes) < 1024:
+            raise ValueError("max_bytes < 1024 would rotate on nearly "
+                             "every record")
         self.path = path
         self.run_id = run_id or new_run_id()
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        self.rotations = 0
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        self._bytes = os.path.getsize(path)
         # async checkpoint writers emit ckpt_save from their background
         # thread; serialize record writes so lines never interleave
         import threading
@@ -145,14 +173,35 @@ class RunEventLog:
             begin.update(meta)
         self.event("run_begin", **begin)
 
+    def _write_locked(self, rec: Dict[str, Any]) -> None:
+        """Write one record; caller holds the lock."""
+        line = json.dumps(rec, default=_jsonable) + "\n"
+        if (self.max_bytes is not None
+                and self._bytes + len(line) > self.max_bytes
+                and self._bytes > 0):
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._bytes = 0
+            self.rotations += 1
+            marker = json.dumps(
+                {"ts": round(time.time(), 3), "run_id": self.run_id,
+                 "event": "run_rotate", "rotations": self.rotations,
+                 "rolled_to": self.path + ".1"},
+                default=_jsonable) + "\n"
+            self._f.write(marker)
+            self._bytes += len(marker)
+        self._f.write(line)
+        self._f.flush()
+        self._bytes += len(line)
+
     def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
         """Append one event record (flushed immediately)."""
         rec = {"ts": round(time.time(), 3), "run_id": self.run_id,
                "event": kind}
         rec.update(fields)
         with self._wlock:
-            self._f.write(json.dumps(rec, default=_jsonable) + "\n")
-            self._f.flush()
+            self._write_locked(rec)
         return rec
 
     def telemetry_window(self, telemetry, **extra: Any) -> Dict[str, Any]:

@@ -63,6 +63,42 @@ def init_telemetry(loss_scale: float = 1.0) -> Dict[str, Any]:
     return out
 
 
+def init_telemetry_for(program) -> Dict[str, Any]:
+    """Accumulator sized for one program: guard loss-scale seed plus,
+    when the program opted into numerics observability
+    (observe.numerics), the per-group vectors and the latched
+    first-nonfinite bitmap (one bit per fluid op)."""
+    guard_cfg = getattr(program, "_update_guard", None)
+    out = init_telemetry(loss_scale=guard_cfg.init_loss_scale
+                         if guard_cfg is not None else 1.0)
+    if getattr(program, "_numerics_enabled", False):
+        from . import numerics as _numerics
+
+        out.update(_numerics.init_numerics_fields(
+            len(program.global_block().ops)))
+    return out
+
+
+def ensure_numerics_fields(program, tel: Dict[str, Any]) -> Dict[str, Any]:
+    """Patch an EXISTING scope accumulator when numerics was enabled
+    after telemetry already ran (or the program grew ops): merge in
+    correctly-sized zeroed numerics fields, preserving every window
+    counter and the guard's loss-scale schedule.  Returns `tel`
+    unchanged when nothing is missing."""
+    if not getattr(program, "_numerics_enabled", False):
+        return tel
+    from . import numerics as _numerics
+
+    n_ops = len(program.global_block().ops)
+    words = tel.get(_numerics.NONFINITE_WORDS)
+    if words is not None and \
+            np.asarray(words).shape[0] == _numerics.n_bit_words(n_ops):
+        return tel
+    out = dict(tel)
+    out.update(_numerics.init_numerics_fields(n_ops))
+    return out
+
+
 def device_update(tel: Dict[str, Any], loss, grads: Dict[str, Any],
                   params_before: Dict[str, Any],
                   env: Dict[str, Any]) -> Dict[str, Any]:
@@ -126,9 +162,13 @@ class StepTelemetry:
     # resilience update guard (0 / 1.0 when the guard is not enabled)
     skipped_update_steps: int = 0
     loss_scale: float = 1.0
+    # numerics observability (observe.numerics; None when the program
+    # did not opt in): per-group dynamics + first-nonfinite provenance
+    groups: Optional[Dict[str, Dict[str, float]]] = None
+    first_nonfinite_op: Optional[Dict[str, Any]] = None
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "steps": self.steps,
             "loss_last": self.loss_last,
             "loss_mean": self.loss_mean,
@@ -141,6 +181,11 @@ class StepTelemetry:
             "skipped_update_steps": self.skipped_update_steps,
             "loss_scale": self.loss_scale,
         }
+        if self.groups is not None:
+            out["groups"] = self.groups
+        if self.first_nonfinite_op is not None:
+            out["first_nonfinite_op"] = self.first_nonfinite_op
+        return out
 
     @property
     def healthy(self) -> bool:
@@ -148,21 +193,44 @@ class StepTelemetry:
                 and self.nonfinite_loss_steps == 0)
 
 
-def fetch_telemetry(scope, reset: bool = True) -> Optional[StepTelemetry]:
+def fetch_telemetry(scope, reset: bool = True,
+                    program=None) -> Optional[StepTelemetry]:
     """ONE host sync: pull the device accumulator out of `scope`,
     convert to a window summary, and (by default) re-zero it so the
     next window starts fresh.  Returns None when the scope carries no
-    telemetry (program not enabled, or no step ran yet)."""
+    telemetry (program not enabled, or no step ran yet).
+
+    `program`: when given and the window latched a nonfinite bitmap
+    (observe.numerics), the first set bit is joined back to the fluid
+    op desc — `first_nonfinite_op` then carries op type/index/group,
+    not just the index."""
     raw = scope.find_var(TELEMETRY_VAR)
     if raw is None:
         return None
-    host = {k: np.asarray(v).item() for k, v in raw.items()}
+    host: Dict[str, Any] = {}
+    for k, v in raw.items():
+        a = np.asarray(v)
+        host[k] = a.item() if a.ndim == 0 else a
     if reset:
-        fresh = init_telemetry()
-        for f in _PERSISTENT_FIELDS:  # loss-scale schedule survives
-            if f in raw:
-                fresh[f] = raw[f]
+        # re-zero by SHAPE (scalars and numerics vectors alike) so the
+        # next window starts fresh whatever fields this program carries
+        fresh: Dict[str, Any] = {}
+        for k, v in raw.items():
+            if k in _PERSISTENT_FIELDS:  # loss-scale schedule survives
+                fresh[k] = raw[k]
+            else:
+                a = np.asarray(v)
+                fresh[k] = (np.zeros_like(a) if a.ndim
+                            else a.dtype.type(0))
         scope.set_var(TELEMETRY_VAR, fresh)
+    groups = first = None
+    if "nonfinite_op_words" in host:
+        from . import numerics as _numerics
+
+        groups = _numerics.summarize_groups(host)
+        if int(host.get(_numerics.NONFINITE_LATCH, 0)):
+            first = _numerics.join_first_nonfinite(
+                host[_numerics.NONFINITE_WORDS], program=program)
     n = max(int(host["steps"]), 1)
     return StepTelemetry(
         steps=int(host["steps"]),
@@ -176,4 +244,6 @@ def fetch_telemetry(scope, reset: bool = True) -> Optional[StepTelemetry]:
         nonfinite_loss_steps=int(host["nonfinite_loss_steps"]),
         skipped_update_steps=int(host.get("skipped_update_steps", 0)),
         loss_scale=float(host.get("loss_scale", 1.0)),
+        groups=groups,
+        first_nonfinite_op=first,
     )

@@ -171,6 +171,38 @@ class LatencyHistogram:
             if ms > self.max_ms:
                 self.max_ms = ms
 
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold `other`'s counts into this histogram IN PLACE (and
+        return self) — the "merged windows stay exact" contract:
+        bin-wise count addition loses nothing, so percentiles over the
+        merged histogram equal percentiles over one histogram that had
+        recorded every sample of both.  Bin configs must match
+        (lo/bins-per-decade/bin count); merging histograms with
+        different edges would silently mis-bin, so it is rejected."""
+        if not isinstance(other, LatencyHistogram):
+            raise TypeError(f"cannot merge {type(other).__name__} into "
+                            f"LatencyHistogram")
+        if (self._lo, self._k, self._nbins) != (other._lo, other._k,
+                                                other._nbins):
+            raise ValueError(
+                f"histogram bin configs differ: "
+                f"(lo_ms={self._lo}, bins_per_decade={self._k}, "
+                f"nbins={self._nbins}) vs (lo_ms={other._lo}, "
+                f"bins_per_decade={other._k}, nbins={other._nbins})")
+        # lock ordering: snapshot other first, then fold under our lock
+        # (never hold both — merge(a, b) vs merge(b, a) would deadlock)
+        with other._lock:
+            counts = list(other._counts)
+            o_count, o_sum, o_max = other.count, other.sum_ms, other.max_ms
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self.count += o_count
+            self.sum_ms += o_sum
+            if o_max > self.max_ms:
+                self.max_ms = o_max
+        return self
+
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100] → latency ms (bin upper edge), None if empty."""
         with self._lock:

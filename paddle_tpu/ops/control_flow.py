@@ -285,6 +285,9 @@ def calc_gradient_op(ctx, env, desc):
 
     def f(xs):
         e = dict(env)
+        # the vjp replay re-traces ops already bitmapped by the main
+        # forward — numerics provenance must not double-scan them
+        e.pop("__numerics_bits__", None)
         e.update(zip(input_order, xs))
         from ..core.executor import run_ops
 

@@ -225,14 +225,17 @@ class CompiledProgram:
             # mirror Executor._prepare: the device-side accumulator
             # rides the (donated) state pytree so enable_telemetry()
             # works identically under a mesh — bench dp entries carry
-            # the same honesty counters as single-device ones
-            if scope.find_var(_obs_metrics.TELEMETRY_VAR) is None:
-                guard_cfg = getattr(program, "_update_guard", None)
-                scope.set_var(
-                    _obs_metrics.TELEMETRY_VAR,
-                    _obs_metrics.init_telemetry(
-                        loss_scale=guard_cfg.init_loss_scale
-                        if guard_cfg is not None else 1.0))
+            # the same honesty counters as single-device ones (and the
+            # same numerics fields when the program opted in)
+            tel_cur = scope.find_var(_obs_metrics.TELEMETRY_VAR)
+            if tel_cur is None:
+                scope.set_var(_obs_metrics.TELEMETRY_VAR,
+                              _obs_metrics.init_telemetry_for(program))
+            else:
+                patched = _obs_metrics.ensure_numerics_fields(
+                    program, tel_cur)
+                if patched is not tel_cur:
+                    scope.set_var(_obs_metrics.TELEMETRY_VAR, patched)
             state_names = state_names + (_obs_metrics.TELEMETRY_VAR,)
         feed_shardings = {n: self._feed_sharding(n, v)
                           for n, v in feed.items()}

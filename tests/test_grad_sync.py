@@ -334,7 +334,10 @@ def _dp_entry(**over):
     e = {"mfu": 0.3, "tokens_per_sec": 1000.0,
          "per_device_tokens_per_sec": 125.0, "mesh": {"dp": 8},
          "n_devices": 8, "grad_sync": None, "comm_bytes": 5.0e8,
-         "last_loss": 1.0, "ckpt_blocking_ms": 1.0}
+         "last_loss": 1.0, "ckpt_blocking_ms": 1.0,
+         # numerics observability contract (ISSUE 11): training
+         # entries carry the window's grad norm + worst update ratio
+         "grad_norm_last": 0.5, "update_ratio_worst": 1e-3}
     e.update(over)
     return e
 

@@ -1,0 +1,402 @@
+"""Observe pillar 6 (numerics observability): per-group training
+dynamics + first-nonfinite op provenance, device-side.
+
+Locks in the ISSUE 11 acceptance criteria:
+- per-group squared grad norms COMPOSE: sum_g group gnorm^2 equals the
+  global gnorm^2 (same grads, same trace — only the grouping differs),
+- the first poisoned step's bitmap is LATCHED: clean steps don't clear
+  it and later poisoned steps don't overwrite it,
+- the accumulator (vectors + latch) rides the chain_iterations
+  fori_loop carry with zero extra dispatches,
+- group names are stable under `switch_moe(name=...)` prefix appends,
+- numerics DISABLED is byte-identical / zero-overhead (the guard
+  discipline: same dispatches, same retraces, callback-free lowering),
+- the explicit dp grad-sync path ORs per-rank bitmaps exactly.
+
+Plus the PR's observe satellites: LatencyHistogram.merge (bin-wise
+exact) and RunEventLog size-bounded rotation.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.observe import numerics
+
+
+def _named_program(lr=0.1):
+    """Small net with NAMED layers so params land in real groups."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="relu", name="attn_qkv")
+        h = layers.fc(h, size=16, act="relu", name="ffn_in")
+        pred = layers.fc(h, size=1, name="ffn_out")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    return main, startup, scope, loss
+
+
+def _feed(rng, n=8):
+    return {"x": rng.rand(n, 8).astype(np.float32),
+            "y": rng.rand(n, 1).astype(np.float32)}
+
+
+def _poisoned(feed, name):
+    bad = dict(feed)
+    bad[name] = feed[name].copy()
+    bad[name].reshape(-1)[0] = np.nan
+    return bad
+
+
+def _first_consumer(program, feed_name):
+    ops = program.global_block().ops
+    return next(i for i, op in enumerate(ops)
+                if feed_name in op.desc.input_names())
+
+
+def test_group_norms_compose_to_global():
+    """sum_g (per-group gnorm)^2 == (global gnorm)^2: the vectors are
+    a partition of the same squared-norm mass, not a re-measurement."""
+    main, startup, scope, loss = _named_program()
+    observe.enable_numerics(main)
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=_feed(rng), fetch_list=[loss])
+    tel = observe.fetch_telemetry(scope, program=main)
+    assert tel.steps == 3 and tel.healthy
+    assert set(tel.groups) >= {"attn_qkv", "ffn_in", "ffn_out"}
+    gsq = sum(s["grad_norm_last"] ** 2 for s in tel.groups.values())
+    assert gsq == pytest.approx(tel.grad_norm_last ** 2, rel=1e-5)
+    usq = sum(s["update_norm_last"] ** 2 for s in tel.groups.values())
+    assert usq == pytest.approx(tel.update_norm_last ** 2, rel=1e-5)
+    # SGD with lr: update ratio is positive and sane for every group
+    for name, s in tel.groups.items():
+        assert s["param_norm"] > 0, name
+        assert s["update_ratio"] > 0, name
+    # report surfaces compose too
+    rep = observe.numerics_report(tel)
+    assert rep["dead_groups"] == []
+    assert rep["worst_update_ratio_group"] in tel.groups
+    table = observe.format_numerics_table(tel)
+    assert "attn_qkv" in table and "upd_ratio" in table
+
+
+def test_first_nonfinite_latch_semantics():
+    """First poisoned step wins; clean steps don't clear; later
+    poisoned steps (even at an EARLIER op) don't overwrite; a fetch
+    reset starts a fresh latch window."""
+    main, startup, scope, loss = _named_program()
+    observe.enable_numerics(main)
+    rng = np.random.RandomState(0)
+    op_y = _first_consumer(main, "y")   # late op (loss head)
+    op_x = _first_consumer(main, "x")   # op 0 (first fc mul)
+    assert op_x < op_y
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _feed(rng)
+        exe.run(main, feed=feed, fetch_list=[loss])               # clean
+        exe.run(main, feed=_poisoned(feed, "y"), fetch_list=[loss])
+        exe.run(main, feed=feed, fetch_list=[loss])               # clean
+        exe.run(main, feed=_poisoned(feed, "x"), fetch_list=[loss])
+    tel = observe.fetch_telemetry(scope, program=main)
+    fno = tel.first_nonfinite_op
+    assert fno is not None
+    # the FIRST poisoned step (y-poison -> loss head) is latched even
+    # though a LATER step poisoned an earlier op (x -> op 0)
+    assert fno["op_index"] == op_y, (fno, op_y)
+    assert fno["op_type"] == \
+        main.global_block().ops[op_y].desc.type
+    assert "group" in fno
+    # reset started a fresh window: a new poison latches the new op
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=_poisoned(_feed(rng), "x"),
+                fetch_list=[loss])
+    tel2 = observe.fetch_telemetry(scope, program=main)
+    assert tel2.first_nonfinite_op["op_index"] == op_x
+
+
+def test_numerics_ride_chained_iterations():
+    """K chained iterations accumulate K per-group updates in ONE
+    dispatch (the accumulator rides the fori_loop carry)."""
+    main, startup, scope, loss = _named_program()
+    observe.enable_numerics(main)
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _feed(rng)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        snap = observe.runtime_stats.snapshot()
+        exe.run(main, feed=feed, fetch_list=[loss], iterations=4)
+        assert observe.runtime_stats.delta(snap)["dispatches"] == 1
+    tel = observe.fetch_telemetry(scope, program=main)
+    assert tel.steps == 5
+    assert tel.groups["attn_qkv"]["grad_norm_rms"] > 0
+    assert tel.first_nonfinite_op is None
+    # poisoned chained window: the latch survives the fori_loop carry
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=_poisoned(_feed(rng), "y"),
+                fetch_list=[loss], iterations=3)
+    tel2 = observe.fetch_telemetry(scope, program=main)
+    assert tel2.steps == 3
+    assert tel2.first_nonfinite_op is not None
+    assert tel2.first_nonfinite_op["op_index"] == \
+        _first_consumer(main, "y")
+
+
+def test_group_names_stable_under_switch_moe_prefix():
+    """switch_moe(name=...) APPENDS to the moe_gate/moe_expert
+    prefixes (layers/nn.py) — grouping must match the generated names
+    the same way the ep sharding rules do."""
+    # the documented naming convention, un-anchored match
+    assert numerics.GROUP_NAMES[numerics.group_of(
+        "moe_gate.w_0")] == "moe_gate"
+    assert numerics.GROUP_NAMES[numerics.group_of(
+        "moe_gate_enc3.w_0")] == "moe_gate"
+    assert numerics.GROUP_NAMES[numerics.group_of(
+        "moe_expert_enc3.w_1")] == "moe_expert"
+    assert numerics.GROUP_NAMES[numerics.group_of(
+        "attn_qkv_7.b_0")] == "attn_qkv"
+    assert numerics.GROUP_NAMES[numerics.group_of(
+        "src_word_emb.w_0")] == "embedding"
+    assert numerics.GROUP_NAMES[numerics.group_of(
+        "fc_3.w_0")] == "other"
+    # against REAL generated names: build a switch_moe layer with a
+    # user name and group every created parameter
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = layers.data(name="x", shape=[4, 8], dtype="float32")
+        layers.switch_moe(xv, num_experts=2, d_inner=16, name="blk3")
+    pnames = [v.name for v in main.list_vars()
+              if getattr(v, "is_parameter", False) or v.persistable]
+    moe_names = [n for n in pnames if "moe" in n]
+    assert moe_names, pnames
+    groups = numerics.param_groups(moe_names)
+    for n, gi in groups.items():
+        assert numerics.GROUP_NAMES[gi] in ("moe_gate", "moe_expert"), \
+            (n, numerics.GROUP_NAMES[gi])
+
+
+def test_numerics_disabled_is_zero_overhead():
+    """The ISSUE 4 guard discipline, applied to pillar 6: numerics ON
+    adds zero dispatches/retraces/callbacks on clean steps, and
+    numerics OFF lowers to the byte-identical step a numerics-unaware
+    build would produce (same program build -> same stablehlo)."""
+    rng_feed = _feed(np.random.RandomState(0))
+
+    def run_and_count(numerics_on):
+        main, startup, scope, loss = _named_program()
+        observe.enable_telemetry(main)
+        if numerics_on:
+            observe.enable_numerics(main)
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            snap = observe.runtime_stats.snapshot()
+            for _ in range(3):
+                exe.run(main, feed=rng_feed, fetch_list=[loss])
+            delta = observe.runtime_stats.delta(snap)
+            fn, state, feeds = exe._prepare(
+                main, rng_feed, [loss.name], scope, 1, True)
+            text = fn.lower(state, feeds).as_text()
+        return delta, text
+
+    off, text_off = run_and_count(False)
+    on, text_on = run_and_count(True)
+    assert on["dispatches"] == off["dispatches"]
+    assert on["retraces"] == off["retraces"] == 0
+    assert "callback" not in text_on  # no host round-trips, ever
+    # byte-identical when disabled: two independent identical builds
+    # without numerics produce the same lowering (the enable flag is
+    # the ONLY thing that changes the traced step)
+    off2, text_off2 = run_and_count(False)
+    assert text_off == text_off2
+
+
+def test_dp_grad_sync_ors_bitmaps_across_ranks():
+    """Explicit dp grad sync (shard_map): per-rank bitmaps differ, the
+    step bitmap must be their exact bitwise OR — a poison visible only
+    on the LAST rank's shard still attributes correctly."""
+    from paddle_tpu.parallel import make_mesh
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        xv = layers.data("x", shape=[8], dtype="float32")
+        yv = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(layers.fc(xv, size=16, act="relu",
+                                   name="attn_qkv"), size=1)
+        loss = layers.mean(layers.square_error_cost(pred, yv))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        observe.enable_numerics(main)
+        exe = fluid.Executor()
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        bs.grad_sync = "bf16"
+        fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs,
+            mesh=make_mesh({"dp": 8}))
+        feed = {"x": rng.randn(16, 8).astype(np.float32),
+                "y": rng.randn(16, 1).astype(np.float32)}
+        bad = dict(feed)
+        bad["y"] = feed["y"].copy()
+        bad["y"][15, 0] = np.nan  # last rank's shard only
+        exe.run(main, feed=bad, fetch_list=[loss])
+    tel = observe.fetch_telemetry(scope, program=main)
+    assert tel.first_nonfinite_op is not None
+    assert tel.first_nonfinite_op["op_index"] == \
+        _first_consumer(main, "y")
+
+
+def test_backward_origin_latch_reports_autodiff():
+    """A latch with ZERO bits (every op output finite, grads not) is
+    joined as backward/autodiff, not silently dropped."""
+    info = numerics.join_first_nonfinite(np.zeros(2, np.uint32))
+    assert info["op_index"] is None
+    assert "backward" in info["op_type"]
+
+
+def test_latency_histogram_merge_is_exact():
+    """Bin-wise merge: percentiles over the merged histogram equal
+    percentiles over one histogram that saw every sample."""
+    rng = np.random.RandomState(7)
+    a_ms = (10 ** rng.uniform(-1, 3, 500)).tolist()
+    b_ms = (10 ** rng.uniform(0, 2, 300)).tolist()
+    ha, hb, href = (observe.LatencyHistogram(),
+                    observe.LatencyHistogram(),
+                    observe.LatencyHistogram())
+    for v in a_ms:
+        ha.record(v)
+        href.record(v)
+    for v in b_ms:
+        hb.record(v)
+        href.record(v)
+    merged = ha.merge(hb)
+    assert merged is ha
+    assert ha.count == href.count == 800
+    assert ha.sum_ms == pytest.approx(href.sum_ms)
+    assert ha.max_ms == href.max_ms
+    for p in (50, 90, 95, 99, 100):
+        assert ha.percentile(p) == href.percentile(p), p
+    assert ha.summary() == href.summary()
+    # mismatched bin configs are rejected, not silently mis-binned
+    with pytest.raises(ValueError):
+        ha.merge(observe.LatencyHistogram(bins_per_decade=10))
+    with pytest.raises(TypeError):
+        ha.merge({"count": 1})
+
+
+def test_serving_stats_cross_window_aggregation():
+    """Two ServingStats windows (e.g. two engine generations across a
+    breaker flip) aggregate exactly via LatencyHistogram.merge."""
+    from paddle_tpu.serving import ServingStats
+
+    w1, w2 = ServingStats(), ServingStats()
+    for i in range(40):
+        w1.record_done(1.0 + i)
+    for i in range(60):
+        w2.record_done(100.0 + i)
+    agg = observe.LatencyHistogram()
+    agg.merge(w1.e2e_ms).merge(w2.e2e_ms)
+    ref = observe.LatencyHistogram()
+    for i in range(40):
+        ref.record(1.0 + i)
+    for i in range(60):
+        ref.record(100.0 + i)
+    assert agg.count == 100
+    assert agg.summary() == ref.summary()
+    # the aggregate p50 sits in the second window's range (60 of 100
+    # samples are ~100ms) — a merged window behaves like one stream
+    assert agg.percentile(50) > 50
+
+
+def test_event_log_rotation(tmp_path):
+    """max_bytes rotation: the live file stays bounded, one `.1`
+    generation is kept, records never tear, and the fresh file opens
+    with a run_rotate continuation record."""
+    path = os.path.join(str(tmp_path), "events.jsonl")
+    with observe.RunEventLog(path, max_bytes=4096) as log:
+        for i in range(200):
+            log.event("tick", i=i, pad="x" * 64)
+        assert log.rotations >= 1
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 4096 + 512  # bound + one record
+    live = observe.read_events(path)
+    rolled = observe.read_events(path + ".1")
+    assert live[0]["event"] == "run_rotate"
+    assert live[-1]["event"] == "run_end"
+    # every record in both generations parses and carries the run id
+    rid = live[0]["run_id"]
+    assert all(e["run_id"] == rid for e in live + rolled)
+    # no record lost: tick indices across generations are contiguous
+    ticks = [e["i"] for e in rolled + live if e["event"] == "tick"]
+    assert ticks == sorted(ticks)
+    assert ticks[-1] == 199
+    # too-small bounds are rejected up front
+    with pytest.raises(ValueError):
+        observe.RunEventLog(os.path.join(str(tmp_path), "x.jsonl"),
+                            max_bytes=10)
+
+
+def test_trainer_numerics_provenance_event(tmp_path):
+    """Trainer(telemetry=TelemetryConfig(numerics=True)) + a poisoned
+    batch: the window's telemetry event carries groups, and the LOUD
+    nonfinite_provenance event joins the fluid op."""
+    from paddle_tpu.contrib import Trainer
+    from paddle_tpu.resilience import chaos, enable_update_guard
+
+    log_path = os.path.join(str(tmp_path), "run.jsonl")
+
+    def train_func():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, name="ffn_out")
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    trainer = Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGDOptimizer(
+            learning_rate=0.05),
+        telemetry=observe.TelemetryConfig(interval=100,
+                                          log_path=log_path,
+                                          numerics=True))
+    enable_update_guard(trainer.train_program)
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(4):
+            yield {"x": rng.rand(8, 4).astype(np.float32),
+                   "y": rng.rand(8, 1).astype(np.float32)}
+
+    trainer.train(num_epochs=1,
+                  reader=chaos.nan_reader(reader, at_step=2,
+                                          names=["y"]))
+    trainer.stop()
+    tel = trainer.last_telemetry
+    exp = _first_consumer(trainer.train_program, "y")
+    assert tel.first_nonfinite_op["op_index"] == exp
+    assert tel.skipped_update_steps == 1
+    events = observe.read_events(log_path)
+    prov = [e for e in events if e["event"] == "nonfinite_provenance"]
+    assert len(prov) == 1
+    assert prov[0]["first_nonfinite_op"]["op_index"] == exp
+    assert prov[0]["skipped_update_steps"] == 1
+    windows = [e for e in events if e["event"] == "telemetry"]
+    assert windows and "groups" in windows[-1]
+    assert json.dumps(prov[0])  # events stay JSON-serializable

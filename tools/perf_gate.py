@@ -16,8 +16,10 @@ Two modes, matching what each environment can actually verify:
   metric/value/unit/vs_baseline/detail plus compile_s/retraces/
   peak_mem_bytes/run_id/git_sha (docs/OBSERVE.md), and per training
   entry the checkpoint-cost fields (ckpt_blocking_ms/ckpt_write_ms,
-  docs/RESILIENCE.md) — so a chip-less CI still catches a broken
-  artifact shape before it burns a chip run.
+  docs/RESILIENCE.md) plus the numerics-observability fields
+  (grad_norm_last / update_ratio_worst, docs/OBSERVE.md pillar 6) —
+  so a chip-less CI still catches a broken artifact shape before it
+  burns a chip run.
 
 Baselines load from either a raw bench JSON line/file or a driver
 wrapper ({"tail": ..., "parsed": ...}); a truncated wrapper tail (the
@@ -163,6 +165,17 @@ def check_schema(candidate):
             errors.append(f"detail.{name}: training entry missing "
                           f"ckpt_blocking_ms (async-checkpoint cost "
                           f"observability)")
+        if "last_loss" in entry:
+            # numerics observability (observe pillar 6): a training
+            # entry must carry the window's grad norm and worst-group
+            # update ratio (None only when measured --no-telemetry),
+            # so divergence/dead-layer evidence rides the artifact
+            for field in ("grad_norm_last", "update_ratio_worst"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: training entry "
+                                  f"missing {field!r} (numerics "
+                                  f"observability, docs/OBSERVE.md "
+                                  f"pillar 6)")
         if "mesh" in entry:
             # dp-mesh contract (ISSUE 10, docs/DIST.md): a multi-chip
             # entry must carry per-device AND aggregate throughput plus

@@ -372,6 +372,80 @@ print("chaos smoke OK:",
        "breaker": engine.health()["breaker"]["state"]})
 EOF
 
+echo "== numerics provenance chaos smoke (cpu) =="
+# ISSUE 11 tentpole (docs/OBSERVE.md pillar 6): chaos.poison_feed-inject
+# NaN into one named feed -> the device-side per-op bitmap must
+# attribute the poison to EXACTLY the first fluid op consuming that
+# feed (type + index + group), the update guard must keep the run
+# alive (exactly one skipped update, params finite), and the Trainer
+# must emit a `nonfinite_provenance` event carrying the same join.
+python - <<'EOF'
+import os, tempfile
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.contrib import Trainer
+from paddle_tpu.resilience import chaos, enable_update_guard
+
+d = tempfile.mkdtemp()
+log = os.path.join(d, "numerics.jsonl")
+
+def train_func():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=8, act="relu", name="ffn_in")
+    pred = layers.fc(h, size=1, name="ffn_out")
+    return layers.mean(layers.square_error_cost(pred, y))
+
+def reader():
+    r = np.random.RandomState(0)
+    for _ in range(6):
+        yield {"x": r.rand(8, 4).astype(np.float32),
+               "y": r.rand(8, 1).astype(np.float32)}
+
+t = Trainer(train_func,
+            lambda: fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+            telemetry=observe.TelemetryConfig(interval=100,
+                                              log_path=log,
+                                              numerics=True))
+enable_update_guard(t.train_program)
+# poison feed "y" at step 3: the NaN must be attributed to the FIRST
+# fluid op that consumes y, not to op 0 and not to a bare counter
+t.train(num_epochs=1, reader=chaos.nan_reader(reader, at_step=3,
+                                              names=["y"]))
+tel = t.last_telemetry
+ops = t.train_program.global_block().ops
+exp = next(i for i, op in enumerate(ops)
+           if "y" in op.desc.input_names())
+fno = tel.first_nonfinite_op
+assert fno is not None, tel.as_dict()
+assert fno["op_index"] == exp and fno["op_type"] == ops[exp].desc.type \
+    and "group" in fno, (fno, exp, ops[exp].desc.type)
+# the run stayed ALIVE through the poison: guard skipped exactly that
+# update and no NaN reached the parameters
+assert tel.steps == 6 and tel.skipped_update_steps == 1, tel.as_dict()
+params = {v.name: np.asarray(t.scope.find_var(v.name))
+          for v in t.train_program.list_vars() if v.persistable}
+assert all(np.isfinite(p).all() for p in params.values()), \
+    "NaN leaked into parameters past the guard"
+# per-group dynamics: the named layers report, and group grad norms
+# compose to the global one (consistency contract)
+assert "ffn_in" in tel.groups and "ffn_out" in tel.groups, tel.groups
+events = observe.read_events(log)
+prov = [e for e in events if e["event"] == "nonfinite_provenance"]
+assert prov and prov[-1]["first_nonfinite_op"]["op_index"] == exp \
+    and prov[-1]["skipped_update_steps"] == 1, prov[-1:]
+t.stop()
+print("numerics provenance smoke OK:",
+      {"op": f"{fno['op_index']}:{fno['op_type']}",
+       "group": fno.get("group"),
+       "skipped": tel.skipped_update_steps,
+       "groups": sorted(tel.groups)})
+EOF
+
 echo "== gang-chaos smoke (cpu) =="
 # ISSUE 9 (docs/RESILIENCE.md, distributed failure model): a REAL
 # 2-worker gang under the self-healing supervisor — SIGKILL a random
