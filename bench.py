@@ -1065,6 +1065,126 @@ def bench_serving_engine(batch_size: int, n_requests: int = 0,
     }
 
 
+def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
+                         max_new_tokens: int = 0):
+    """Continuous-batching autoregressive decode under an offered-load
+    ragged request stream (ISSUE 12, docs/SERVING.md §decode).
+
+    A decoder-only LM serves prompts of random ragged lengths through
+    the paged-KV DecodeEngine: more requests than slots, so requests
+    JOIN open slots mid-generation (prefill-on-join), leave as they
+    finish, and may be preempted when the pool — deliberately sized
+    below the worst case — runs dry.  The headline is steady-state
+    generated tokens/s; the entry carries the full decode telemetry
+    (slot occupancy, KV-page pool utilization, preemptions, TTFT vs
+    TPOT per the tunnel-latency convention) and post_warmup_compiles,
+    which MUST be 0: any compile after warmup means a shape leaked
+    across a join/leave/preempt pattern.
+
+    kv_int8=True swaps the KV pools for int8 + per-row scale sidecars
+    (the AB_r09 A/B pair); the default stays bf16 pending a recorded
+    chip wall-clock win, per the device-tag rule."""
+    import jax
+
+    from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        arch = dict(vocab_size=8192, n_layer=4, n_head=8, d_model=512,
+                    d_inner=1024)
+        num_slots, page, max_len, chunk = 16, 16, 512, 16
+        buckets = (32, 64, 128)
+        max_new = max_new_tokens or 96
+        n_requests = n_requests or 64
+        prompt_lo, prompt_hi = 8, 128
+    else:
+        # CPU smoke: the contract (joins, preemption, zero compiles),
+        # not the throughput
+        arch = dict(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                    d_inner=128)
+        num_slots, page, max_len, chunk = 4, 8, 96, 8
+        buckets = (16, 32)
+        max_new = max_new_tokens or 12
+        n_requests = n_requests or 12
+        prompt_lo, prompt_hi = 4, 32
+    kv_dtype = "int8" if kv_int8 else "bfloat16"
+    lm = DecoderLM(use_pallas=on_tpu or None, kv_dtype=kv_dtype,
+                   seed=0, **arch)
+    max_pages = -(-max_len // page)
+    # pool deliberately BELOW slots*worst-case: memory follows the
+    # ragged truth; the preemption counter records where it pinched
+    num_pages = max(max_pages + 1, int(0.75 * num_slots * max_pages))
+    cfg = DecodeConfig(num_slots=num_slots, page_size=page,
+                       max_len=max_len, num_pages=num_pages,
+                       prefill_buckets=buckets, decode_chunk=chunk,
+                       kv_dtype=kv_dtype)
+    engine = DecodeEngine(lm, cfg, queue_capacity=4 * n_requests)
+    engine.start()
+    prompts = make_prompts(n_requests, arch["vocab_size"],
+                           min_len=prompt_lo, max_len=prompt_hi,
+                           seed=0)
+    rng = np.random.RandomState(1)
+    budgets = rng.randint(max(2, max_new // 2), max_new + 1,
+                          n_requests)
+    t0 = time.perf_counter()
+    futs = [engine.submit(p, max_new_tokens=int(b))
+            for p, b in zip(prompts, budgets)]
+    outs = [f.result(1200) for f in futs]
+    elapsed = time.perf_counter() - t0
+    engine.drain(120)
+    snap = engine.stats.snapshot()
+    mem = _decode_mem(engine)
+    engine.close()
+    tokens_total = sum(len(o) for o in outs)
+    assert tokens_total == snap["tokens_generated"], \
+        (tokens_total, snap["tokens_generated"])
+    _, kind = _peak_flops()
+    kv_bytes = sum(
+        int(np.prod(s.shape, dtype=np.int64))
+        * np.dtype(s.dtype).itemsize
+        for s in lm.pool_specs(num_pages, page).values())
+    return {
+        "tokens_per_sec": round(tokens_total / elapsed, 1),
+        "requests_per_sec": round(n_requests / elapsed, 2),
+        "n_requests": n_requests,
+        "tokens_generated": tokens_total,
+        "ttft_p50_ms": snap["ttft_ms"]["p50_ms"],
+        "ttft_p95_ms": snap["ttft_ms"]["p95_ms"],
+        "tpot_p50_ms": snap["tpot_ms"]["p50_ms"],
+        "slot_occupancy": snap["slot_occupancy"],
+        "kv_page_utilization": snap["kv_page_utilization"],
+        "peak_pages_in_use": snap["peak_pages_in_use"],
+        "preemptions": snap["preemptions"],
+        "prefills": snap["prefills"],
+        "decode_dispatches": snap["decode_dispatches"],
+        "decode_iterations": snap["decode_iterations"],
+        "post_warmup_compiles": snap["post_warmup_compiles"],
+        "warmup": snap.get("warmup"),
+        "kv_dtype": kv_dtype,
+        "num_slots": num_slots, "page_size": page,
+        "num_pages": num_pages, "max_len": max_len,
+        "decode_chunk": chunk, "kv_pool_bytes": int(kv_bytes),
+        "device": kind,
+        **mem,
+    }
+
+
+def _decode_mem(engine):
+    """mem_breakdown of the decode-chunk executable (the steady-state
+    resident program: weights + pools + workspace)."""
+    try:
+        from paddle_tpu.observe.memory import memory_report
+
+        rep = memory_report(compiled=engine._decode_exec)
+        out = dict(rep["breakdown"])
+        out["source"] = rep["source"]
+        return {"mem_breakdown": out}
+    except Exception as e:  # noqa: BLE001 — observability must not
+        #                     take down the measurement it describes
+        return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
+
+
 def _probe_hazard(repo_dir: str, flag_fresh_s: float = 7200.0):
     """Machine-enforce the CLAUDE.md attach hazard: a second JAX client
     merely ATTACHING to the tunneled chip mid-bench degrades it ~5x
@@ -1119,7 +1239,8 @@ def main():
     p.add_argument("--model", default="all",
                    choices=["all", "resnet50", "transformer", "bert",
                             "lstm", "deepfm", "serving",
-                            "serving_engine", "longctx"])
+                            "serving_engine", "serving_decode",
+                            "longctx"])
     p.add_argument("--batch", type=int, default=0)
     p.add_argument("--mesh", default=None, metavar="dp=N",
                    help="bench the training models (resnet50/"
@@ -1202,6 +1323,13 @@ def main():
                         "op for decoder cross attention.  A/B "
                         "candidate: default stays off until a recorded "
                         "throughput win in AB_r07.json")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="serving_decode: int8 KV-cache pools with "
+                        "per-row scale sidecars (the blockwise scheme "
+                        "of parallel/collectives.py) instead of the "
+                        "bf16 default — A/B candidate, recorded in "
+                        "AB_r09.json; the default only flips on a "
+                        "chip wall-clock win")
     p.add_argument("--xla-attn", action="store_true",
                    help="longctx: force the XLA flash composition "
                         "instead of the Pallas kernel (the longctx "
@@ -1490,6 +1618,12 @@ def main():
         # post-warmup compiles (docs/SERVING.md)
         _run("serving_engine", bench_serving_engine,
              args.batch or (16 if args.model == "all" else 32))
+    if args.model in ("all", "serving_decode"):
+        # generative-decode proof point (ISSUE 12): continuous
+        # batching + paged KV under an offered-load ragged request
+        # stream; post_warmup_compiles in the entry must be 0
+        _run("serving_decode", bench_serving_decode,
+             n_requests=args.batch or 0, kv_int8=args.kv_int8)
     if args.model in ("all", "longctx"):
         # long-context proof point (VERDICT r4 item 7): seq 8k with the
         # O(T)-memory stack — Pallas flash for self AND cross
@@ -1588,6 +1722,22 @@ def main():
                      % (d["batching_speedup"], d["p50_ms"],
                         d["p99_ms"], d["post_warmup_compiles"])),
             "vs_baseline": d["batching_speedup"],
+            "detail": detail,
+        }
+    elif ("serving_decode" in detail
+          and "tokens_per_sec" in detail["serving_decode"]):
+        d = detail["serving_decode"]
+        result = {
+            "metric": "decoder_serving_decode_tokens_per_sec",
+            "value": d["tokens_per_sec"],
+            "unit": ("generated tokens/s offered-load (occupancy "
+                     "%.2f, pool util %.2f, %d preemptions, %d "
+                     "post-warmup compiles)"
+                     % (d["slot_occupancy"] or 0.0,
+                        d["kv_page_utilization"] or 0.0,
+                        d["preemptions"],
+                        d["post_warmup_compiles"])),
+            "vs_baseline": 0.0,  # first recorded decode line
             "detail": detail,
         }
     elif "examples_per_sec" in detail.get("deepfm", {}):

@@ -995,6 +995,93 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
     return out
 
 
+def paged_attention(q, k_cache, v_cache, page_table, lengths, n_head,
+                    scale=None, use_pallas=None, k_scale=None,
+                    v_scale=None, name=None):
+    """Decode-step ragged paged attention (ops/paged_kv.py): one query
+    token per slot (Q (S, H*D) head-grouped) attends over that slot's
+    K/V pages of the shared (P, page, H*D) pools, addressed through the
+    (S, max_pages) page table and masked to `lengths`.  use_pallas
+    routes to the tiled kernel (ops/pallas/paged_attention.py); the
+    default XLA dense-gather twin is the layout-matched CPU/parity
+    fallback.  k_scale/v_scale: (P, page, 1) sidecar pools for int8
+    caches."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    ins = {"Q": [q], "KCache": [k_cache], "VCache": [v_cache],
+           "PageTable": [page_table], "Lengths": [lengths]}
+    if k_scale is not None:
+        ins["KScale"] = [k_scale]
+        ins["VScale"] = [v_scale]
+    attrs = {"n_head": int(n_head), "use_pallas": use_pallas}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type="paged_attention", inputs=ins,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def _paged_write(op_type, k, v, k_cache, v_cache, page_table, extra_ins,
+                 k_scale, v_scale, name):
+    helper = LayerHelper(op_type, name=name)
+    kc_out = helper.create_variable_for_type_inference(k_cache.dtype)
+    vc_out = helper.create_variable_for_type_inference(v_cache.dtype)
+    ins = {"K": [k], "V": [v], "KCache": [k_cache], "VCache": [v_cache],
+           "PageTable": [page_table]}
+    ins.update(extra_ins)
+    outs = {"KCacheOut": [kc_out], "VCacheOut": [vc_out]}
+    if k_scale is not None:
+        ins["KScale"] = [k_scale]
+        ins["VScale"] = [v_scale]
+        ks_out = helper.create_variable_for_type_inference(k_scale.dtype)
+        vs_out = helper.create_variable_for_type_inference(v_scale.dtype)
+        outs["KScaleOut"] = [ks_out]
+        outs["VScaleOut"] = [vs_out]
+    helper.append_op(type=op_type, inputs=ins, outputs=outs)
+    if k_scale is not None:
+        return kc_out, vc_out, ks_out, vs_out
+    return kc_out, vc_out
+
+
+def paged_kv_write(k, v, k_cache, v_cache, page_table, write_pos,
+                   active=None, k_scale=None, v_scale=None, name=None):
+    """Commit ONE token's K/V per slot into the paged pools at
+    `write_pos` (the decode-step write; ops/paged_kv.py).  Functional:
+    returns the updated pools (+ scale sidecars for int8 caches);
+    inactive slots (active 0) write nothing."""
+    extra = {"WritePos": [write_pos]}
+    if active is not None:
+        extra["Active"] = [active]
+    return _paged_write("paged_kv_write", k, v, k_cache, v_cache,
+                        page_table, extra, k_scale, v_scale, name)
+
+
+def paged_kv_prefill_write(k, v, k_cache, v_cache, page_table, seq_len,
+                           k_scale=None, v_scale=None, name=None):
+    """Commit a whole prompt's K/V (S, T, H*D) into the paged pools
+    (the prefill-on-join write; ops/paged_kv.py).  Positions past
+    seq_len[s] — all of them for a non-joining slot with seq_len 0 —
+    are dropped."""
+    return _paged_write("paged_kv_prefill_write", k, v, k_cache,
+                        v_cache, page_table, {"SeqLen": [seq_len]},
+                        k_scale, v_scale, name)
+
+
+def add_position_encoding_at(x, position, alpha=1.0, beta=1.0,
+                             name=None):
+    """X (S, D) + sinusoidal encoding at one position per row — the
+    decode-step twin of add_position_encoding (same formula), so a
+    decoded token sees exactly the encoding its position would have had
+    inside a prefill."""
+    helper = LayerHelper("add_position_encoding_at", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="add_position_encoding_at",
+                     inputs={"X": [x], "Position": [position]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": float(alpha), "beta": float(beta)})
+    return out
+
+
 def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
                                    max=1.0, input_dim_idx=0,
                                    output_dim_idx=0, seed=0):

@@ -14,6 +14,7 @@ from . import misc  # noqa: F401
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
 from . import optim  # noqa: F401
+from . import paged_kv  # noqa: F401
 from . import quantize  # noqa: F401
 from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401

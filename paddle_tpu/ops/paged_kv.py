@@ -1,0 +1,239 @@
+"""Paged KV-cache ops: the decode-serving analog of the ragged family.
+
+Continuous-batching autoregressive decode (serving/decode.py) keeps
+every slot's K/V in fixed-size PAGES of one shared pool, addressed
+through a per-slot page table — the Ragged Paged Attention design
+(PAPERS.md arxiv 2604.15464) on the repo's padded-dense + lengths
+convention.  Three ops own the cache contract:
+
+- `paged_kv_write`: commit ONE token's K/V per slot at its current
+  length (the decode-step write).  Functional: pools in, pools out —
+  the engine donates the buffers so XLA updates them in place.
+- `paged_kv_prefill_write`: commit a whole prompt's K/V (the
+  prefill-on-join write), positions 0..seq_len-1 per slot.
+- `paged_attention`: one query token per slot attends over its pages,
+  masked to its true length.  Default impl is an XLA dense-gather twin
+  (layout-matched, the CPU/parity fallback); `use_pallas` routes to the
+  tiled kernel (ops/pallas/paged_attention.py).
+
+All three are born in the head-major (S, H*D) / (P, page, H*D) layout
+(ISSUE 8): a page write is a plain row scatter and no transpose exists
+at any boundary.  Writes for inactive/out-of-range slots are dropped by
+scatter mode="drop" (index pushed out of bounds), so one fixed-shape
+executable serves any join/leave pattern — the zero-recompile contract.
+
+Opt-in int8 pools (the EQuARX blockwise scheme of
+parallel/collectives.py applied per cache row): KScale/VScale sidecar
+pools (P, page, 1) carry one f32 scale per written token row; the
+write op quantizes (symmetric, absmax/127), both attention paths
+dequantize.
+
+`add_position_encoding_at` is the decode-step twin of
+add_position_encoding: the sinusoid at ONE position per row (the
+slot's current length), same formula so prefill and decode agree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, opt_in, out
+
+_INT8_MAX = 127.0
+
+
+def _quantize_rows(x):
+    """Per-row symmetric int8: x (..., HD) -> (codes int8, scale f32
+    (..., 1)); zero rows quantize to scale 1 (all-zero codes)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                     keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / _INT8_MAX, 1.0)
+    codes = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                     -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return codes, scale
+
+
+def _write_rows(pool, phys, off, rows):
+    """pool (P, page, HD) <- rows at [phys, off]; OOB phys drops."""
+    return pool.at[phys, off].set(rows.astype(pool.dtype), mode="drop")
+
+
+@register_op("paged_kv_write")
+def paged_kv_write(ctx, ins, attrs):
+    """One decode step's K/V commit.
+
+    K/V (S, HD); KCache/VCache (P, page, HD); PageTable (S, max_pages)
+    int32; WritePos (S,) int32 (the position being committed = current
+    length); optional Active (S,) — 0/false rows write nothing.  With
+    int8 caches, KScale/VScale (P, page, 1) f32 sidecars are required
+    inputs and updated alongside.
+    Outputs: KCacheOut/VCacheOut (+KScaleOut/VScaleOut for int8)."""
+    k, v = first(ins, "K"), first(ins, "V")
+    kc, vc = first(ins, "KCache"), first(ins, "VCache")
+    pt = first(ins, "PageTable").astype(jnp.int32)
+    wp = first(ins, "WritePos").astype(jnp.int32)
+    active = opt_in(ins, "Active")
+    ks, vs = opt_in(ins, "KScale"), opt_in(ins, "VScale")
+    n_pages, page, _ = kc.shape
+    s = k.shape[0]
+    page_idx = wp // page
+    off = wp % page
+    # logical page past the table is a config error; clamp the GATHER
+    # (the scatter below is dropped anyway when inactive)
+    phys = jnp.take_along_axis(
+        pt, jnp.clip(page_idx, 0, pt.shape[1] - 1)[:, None],
+        axis=1)[:, 0]
+    drop = page_idx >= pt.shape[1]
+    if active is not None:
+        drop = drop | (active.astype(jnp.int32) == 0)
+    phys = jnp.where(drop, n_pages, phys)   # OOB -> mode="drop"
+    int8 = kc.dtype == jnp.int8
+    if int8:
+        if ks is None or vs is None:
+            raise ValueError("int8 KV cache needs KScale/VScale "
+                             "sidecar pools")
+        k_q, k_sc = _quantize_rows(k)
+        v_q, v_sc = _quantize_rows(v)
+        res = out(KCacheOut=_write_rows(kc, phys, off, k_q),
+                  VCacheOut=_write_rows(vc, phys, off, v_q))
+        res.update(out(
+            KScaleOut=ks.at[phys, off].set(k_sc, mode="drop"),
+            VScaleOut=vs.at[phys, off].set(v_sc, mode="drop")))
+        return res
+    return out(KCacheOut=_write_rows(kc, phys, off, k),
+               VCacheOut=_write_rows(vc, phys, off, v))
+
+
+@register_op("paged_kv_prefill_write")
+def paged_kv_prefill_write(ctx, ins, attrs):
+    """A whole prompt's K/V commit (prefill-on-join).
+
+    K/V (S, T, HD); caches/table as in paged_kv_write; SeqLen (S,)
+    int32 — positions t >= SeqLen[s] (padding, and every position of a
+    non-joining slot, whose SeqLen is 0) are dropped."""
+    k, v = first(ins, "K"), first(ins, "V")
+    kc, vc = first(ins, "KCache"), first(ins, "VCache")
+    pt = first(ins, "PageTable").astype(jnp.int32)
+    seq_len = first(ins, "SeqLen").astype(jnp.int32)
+    ks, vs = opt_in(ins, "KScale"), opt_in(ins, "VScale")
+    n_pages, page, _ = kc.shape
+    s, t, _ = k.shape
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]            # (1, T)
+    page_idx = pos // page                                    # (1, T)
+    off = jnp.broadcast_to(pos % page, (s, t))
+    phys = jnp.take_along_axis(
+        pt, jnp.clip(jnp.broadcast_to(page_idx, (s, t)), 0,
+                     pt.shape[1] - 1), axis=1)                # (S, T)
+    valid = (pos < seq_len[:, None]) & (page_idx < pt.shape[1])
+    phys = jnp.where(valid, phys, n_pages)   # OOB -> mode="drop"
+    if kc.dtype == jnp.int8:
+        if ks is None or vs is None:
+            raise ValueError("int8 KV cache needs KScale/VScale "
+                             "sidecar pools")
+        k_q, k_sc = _quantize_rows(k)
+        v_q, v_sc = _quantize_rows(v)
+        res = out(KCacheOut=_write_rows(kc, phys, off, k_q),
+                  VCacheOut=_write_rows(vc, phys, off, v_q))
+        res.update(out(
+            KScaleOut=ks.at[phys, off].set(k_sc, mode="drop"),
+            VScaleOut=vs.at[phys, off].set(v_sc, mode="drop")))
+        return res
+    return out(KCacheOut=_write_rows(kc, phys, off, k),
+               VCacheOut=_write_rows(vc, phys, off, v))
+
+
+def _gather_pool(pool, pt):
+    """(P, page, HD) gathered through (S, maxp) -> (S, maxp*page, HD)
+    — a free reshape after the gather, no transpose."""
+    g = pool[pt]                                  # (S, maxp, page, HD)
+    s, maxp, page, hd = g.shape
+    return g.reshape(s, maxp * page, hd)
+
+
+def _xla_paged_attention(q, kc, vc, pt, lengths, n_head, scale,
+                         ks=None, vs=None):
+    """Dense-gather twin, layout-matched to the Pallas kernel: gather
+    every slot's pages to a dense (S, T_cap, HD) view, mask to the true
+    length, head-split via free minor-dim reshapes (the
+    _xla_attention_nthd pattern — no transpose)."""
+    s, hd = q.shape
+    d = hd // n_head
+    k = _gather_pool(kc, pt).astype(jnp.float32)
+    v = _gather_pool(vc, pt).astype(jnp.float32)
+    if ks is not None:
+        k = k * _gather_pool(ks, pt).astype(jnp.float32)
+    if vs is not None:
+        v = v * _gather_pool(vs, pt).astype(jnp.float32)
+    t_cap = k.shape[1]
+    valid = (jnp.arange(t_cap, dtype=jnp.int32)[None, :]
+             < lengths[:, None])                  # (S, T_cap)
+    # zero invalid v rows: pages past a slot's length are undefined
+    # pool memory (possibly another slot's evicted garbage) and
+    # 0 * NaN would poison the weighted sum even at weight 0
+    v = jnp.where(valid[:, :, None], v, 0.0)
+    q4 = q.astype(jnp.float32).reshape(s, n_head, d)
+    k4 = k.reshape(s, t_cap, n_head, d)
+    v4 = v.reshape(s, t_cap, n_head, d)
+    logits = jnp.einsum("shd,sthd->sht", q4, k4) * scale
+    logits = jnp.where(valid[:, None, :], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("sht,sthd->shd", w, v4)
+    return o.reshape(s, hd).astype(q.dtype)
+
+
+@register_op("paged_attention")
+def paged_attention(ctx, ins, attrs):
+    """Decode-step ragged paged attention (see module docstring).
+
+    Q (S, H*D) head-grouped; KCache/VCache (P, page, H*D); PageTable
+    (S, max_pages) int32; Lengths (S,) int32.  attrs: n_head
+    (required), scale (default d^-0.5), use_pallas (default False —
+    the XLA dense-gather twin; the kernel interprets on CPU)."""
+    q = first(ins, "Q")
+    kc, vc = first(ins, "KCache"), first(ins, "VCache")
+    pt = first(ins, "PageTable").astype(jnp.int32)
+    lengths = first(ins, "Lengths").astype(jnp.int32)
+    ks, vs = opt_in(ins, "KScale"), opt_in(ins, "VScale")
+    n_head = int(attrs.get("n_head") or 0)
+    if not n_head:
+        raise ValueError("paged_attention needs the n_head attr "
+                         "(operands are head-grouped (S, H*D))")
+    if q.shape[-1] % n_head:
+        raise ValueError(f"paged_attention: minor dim {q.shape[-1]} "
+                         f"not divisible by n_head {n_head}")
+    scale = attrs.get("scale")
+    if scale is None:
+        scale = (q.shape[-1] // n_head) ** -0.5
+    if (kc.dtype == jnp.int8) != (ks is not None):
+        raise ValueError("int8 KV caches require KScale/VScale inputs "
+                         "(and float caches must not carry them)")
+    if attrs.get("use_pallas", False):
+        from .pallas.paged_attention import ragged_paged_attention
+
+        return out(Out=ragged_paged_attention(
+            q, kc, vc, pt, lengths, n_head=n_head, scale=float(scale),
+            k_scales=ks, v_scales=vs))
+    return out(Out=_xla_paged_attention(q, kc, vc, pt, lengths, n_head,
+                                        float(scale), ks=ks, vs=vs))
+
+
+@register_op("add_position_encoding_at")
+def add_position_encoding_at(ctx, ins, attrs):
+    """X (S, D) + sinusoid(Position[s]) — the single-token decode twin
+    of add_position_encoding (same formula, per-row position instead of
+    0..T-1), so a decoded token sees exactly the encoding its position
+    would have had inside a prefill."""
+    x = first(ins, "X")
+    position = first(ins, "Position").astype(jnp.float32)
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    d = x.shape[-1]
+    pos = position[:, None]                              # (S, 1)
+    div = jnp.exp(jnp.arange(0, d, 2, dtype=jnp.float32)
+                  * (-jnp.log(10000.0) / d))
+    pe = jnp.zeros((x.shape[0], d), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
+    pe = pe.at[:, 1::2].set(jnp.cos(pos * div[: d // 2]))
+    return out(Out=(alpha * x + beta * pe).astype(x.dtype))

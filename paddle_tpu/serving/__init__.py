@@ -10,7 +10,11 @@ The inference-side subsystem (docs/SERVING.md): what `parallel/` +
 - `admission.AdmissionController`: bounded queue with fast-reject load
   shedding, per-request deadlines, health/drain state machine,
 - `stats.ServingStats`: latency percentiles, occupancy, padding waste,
-  shed/deadline counters — emitted as observe.RunEventLog events.
+  shed/deadline counters — emitted as observe.RunEventLog events,
+- `decode.DecodeEngine`: continuous-batching autoregressive decode
+  over a paged KV cache (fixed-slot batch, prefill-on-join,
+  preemption; ISSUE 12) with `stats.DecodeStats` TTFT/TPOT/occupancy/
+  pool-utilization telemetry.
 
 Quick start (or `paddle_tpu.contrib.serve(...)`):
 
@@ -28,6 +32,9 @@ from .admission import (AdmissionController,  # noqa: F401
                         QueueFullError, ServingClosedError,
                         ServingError)
 from .batcher import DynamicBatcher, Request  # noqa: F401
+from .decode import (DecodeBucketMissError,  # noqa: F401
+                     DecodeConfig, DecodeEngine, DecodeMemoryError,
+                     DecodeRequest, PagePool)
 from .engine import (BucketConfig, BucketMemoryError,  # noqa: F401
                      BucketMissError, ServingEngine)
-from .stats import ServingStats  # noqa: F401
+from .stats import DecodeStats, ServingStats  # noqa: F401

@@ -1,0 +1,901 @@
+"""Continuous-batching autoregressive decode over a paged KV cache.
+
+The generative half of the serving subsystem (ISSUE 12): where
+`engine.ServingEngine` serves single-shot inference over shape
+buckets, this engine serves DECODE — requests that produce tokens one
+iteration at a time, live for wildly different lengths, and would
+waste most of the chip under static batching (a batch is as slow as
+its longest member, and a dense per-request KV buffer reserves
+worst-case memory for every slot).  The design is Ragged Paged
+Attention's (PAPERS.md arxiv 2604.15464):
+
+- **fixed-slot batch, paged KV pool** — `num_slots` decode lanes whose
+  K/V lives in fixed-size PAGES of one shared pool, addressed through
+  per-slot page tables.  Pages are allocated on admit, extended as a
+  slot grows, and returned the moment it finishes — memory follows the
+  RAGGED true lengths, not the worst case.
+- **iteration-level (continuous) batching** — new requests join an
+  open slot BETWEEN decode iterations (prefill-on-join through a
+  bucketed prompt ladder), instead of waiting for a full batch.  The
+  admission/circuit-breaker plane (`admission.py`) is wired in from
+  day one: bounded queue, fast-reject shedding, deadline drops,
+  breaker on executor failures.
+- **preemption** — when the pool runs dry, the lowest-priority slot is
+  evicted (pages returned, request requeued); greedy decode makes the
+  regenerated tokens identical, so preemption is invisible to callers
+  except in latency (and in the `preemptions` counter).
+- **jitted While-based decode** — each dispatch runs up to
+  `decode_chunk` iterations as ONE `lax.while_loop` on device (the one
+  loop reserved for decode per CLAUDE.md), exiting early the moment
+  any slot finishes so its pages free and a queued request can join.
+  Chunking amortizes the ~114 ms tunnel dispatch RTT over many tokens
+  (the TTFT/TPOT convention in stats.py).
+
+Every executable has a FIXED shape: the slot batch, the pool, the page
+tables, and the chunk bound never change across joins/leaves/
+preemptions, so steady state performs ZERO XLA compiles — the same
+contract, accounting, and loud-event plumbing as ServingEngine.  The
+pool is sized up front with `observe.memory.plan_fit` (two small-pool
+probe compiles extrapolate the peak) and impossible configs are
+rejected with a structured `DecodeMemoryError` BEFORE warmup, the way
+`ServingEngine.start()` rejects bucket ladders.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..observe.events import RunEventLog
+from ..observe.monitoring import runtime_stats
+from .admission import (AdmissionController, CircuitBreaker,
+                        DeadlineExceededError, ExecutorFailureError,
+                        ServingClosedError, ServingError)
+from .engine import BucketConfig
+from .stats import DecodeStats
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class DecodeBucketMissError(ServingError):
+    """The request fits no prefill bucket / exceeds the slot length
+    budget (structured: carries the offending lengths and ladder)."""
+
+    kind = "decode_bucket_miss"
+
+
+class DecodeMemoryError(ServingError):
+    """The configured slot/pool geometry's PREDICTED peak memory
+    exceeds the device budget — raised by start() BEFORE warmup from
+    the observe.memory fit planner's small-pool probes."""
+
+    kind = "decode_memory"
+
+
+class DecodeConfig:
+    """Geometry + scheduling knobs of the decode engine.
+
+    num_slots: fixed decode lanes (the device batch).
+    page_size: tokens per KV page.
+    max_len: per-slot budget (prompt + generated); sets the page-table
+        width `max_pages_per_slot`.
+    num_pages: shared pool size.  Default: slots * pages-per-slot (no
+        preemption pressure); size it TIGHTER than the worst case to
+        trade preemptions for memory — `kv_page_utilization` and
+        `preemptions` in the stats tell you where you landed.
+    prefill_buckets: ascending prompt-length ladder; one prefill
+        executable compiles per bucket at start() (a prompt pads UP to
+        the smallest fitting bucket).
+    decode_chunk: max While iterations per decode dispatch (early-exits
+        when a slot finishes).
+    eos_id: optional stop token.
+    kv_dtype: pool storage — "float32" (exact parity), "bfloat16"
+        (default production), or "int8" (per-row scale sidecars,
+        opt-in; A/B'd in AB_r09.json, default stays bf16 pending a
+        chip wall-clock win).
+    """
+
+    def __init__(self, num_slots: int = 8, page_size: int = 16,
+                 max_len: int = 256, num_pages: Optional[int] = None,
+                 prefill_buckets: Sequence[int] = (32, 64, 128),
+                 decode_chunk: int = 8, eos_id: Optional[int] = None,
+                 kv_dtype: str = "bfloat16"):
+        if num_slots < 1 or page_size < 1 or max_len < 2:
+            raise ValueError("num_slots/page_size >= 1, max_len >= 2")
+        if decode_chunk < 1:
+            raise ValueError("decode_chunk must be >= 1")
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.max_pages_per_slot = _cdiv(self.max_len, self.page_size)
+        self.num_pages = int(num_pages) if num_pages is not None else \
+            self.num_slots * self.max_pages_per_slot
+        self.prefill_buckets = BucketConfig._ladder("prefill_buckets",
+                                                    prefill_buckets)
+        if self.prefill_buckets[-1] > self.max_len:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} "
+                f"exceeds max_len {self.max_len}")
+        if self.num_pages < self.max_pages_per_slot:
+            raise ValueError(
+                f"num_pages {self.num_pages} below max_pages_per_slot "
+                f"{self.max_pages_per_slot}: one max-length request "
+                f"could never be served, even alone")
+        self.decode_chunk = int(decode_chunk)
+        self.eos_id = eos_id
+        self.kv_dtype = str(kv_dtype)
+
+
+class DecodeRequest:
+    """One accepted generation request."""
+
+    __slots__ = ("prompt", "max_new_tokens", "priority", "future",
+                 "deadline", "t_submit", "preempted")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 priority: int = 0, deadline: Optional[float] = None):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.future: Future = Future()
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+        self.preempted = 0
+
+
+class PagePool:
+    """Host-side free-list allocator over the device pool's page
+    indices.  Single-threaded (the scheduler owns it)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        got = self._free[-n:][::-1]
+        del self._free[-n:]
+        return got
+
+    def free(self, pages: List[int]):
+        self._free.extend(reversed(pages))
+
+
+class _Slot:
+    """Scheduler-side state of one decode lane."""
+
+    __slots__ = ("req", "pages", "committed", "generated", "cur_tok",
+                 "remaining")
+
+    def __init__(self, req: DecodeRequest, pages: List[int]):
+        self.req = req
+        self.pages = pages
+        self.committed = len(req.prompt)   # tokens whose KV is pooled
+        self.generated: List[int] = []     # tokens produced so far
+        self.cur_tok = 0                   # pending (uncommitted) token
+        self.remaining = req.max_new_tokens
+
+    @property
+    def cap_tokens(self) -> int:
+        # the LAST generated token is never committed to KV
+        return len(self.req.prompt) + self.req.max_new_tokens - 1
+
+    def importance(self):
+        # higher tuple = more important (kept under preemption)
+        return (self.req.priority, -self.req.t_submit)
+
+
+class DecodeEngine:
+    """Continuous-batching decode endpoint over a DecoderLM.
+
+        lm = DecoderLM(vocab_size=...)
+        engine = DecodeEngine(lm, DecodeConfig(num_slots=8))
+        engine.start()                       # plan_fit gate + warmup
+        fut = engine.submit(prompt_ids, max_new_tokens=64)
+        tokens = fut.result()                # np.int32 generated ids
+        engine.close()
+
+    model: a models.decoder_lm.DecoderLM (programs + parameter scope).
+    Threading: submit() from any thread; ONE scheduler thread owns
+    dispatch, the page pool, and the slot table.
+    """
+
+    def __init__(self, model, config: Optional[DecodeConfig] = None,
+                 queue_capacity: int = 128,
+                 default_deadline_ms: Optional[float] = None,
+                 event_log: Optional[RunEventLog] = None,
+                 log_path: Optional[str] = None,
+                 stats_window: int = 64,
+                 breaker: Union[CircuitBreaker, bool, None] = None,
+                 memory_budget_bytes: Union[int, bool, None] = None,
+                 donate_pools: Optional[bool] = None):
+        self.model = model
+        self.config = config or DecodeConfig(kv_dtype=model.kv_dtype)
+        if self.config.kv_dtype != model.kv_dtype:
+            raise ValueError(
+                f"config.kv_dtype {self.config.kv_dtype!r} != model "
+                f"kv_dtype {model.kv_dtype!r}")
+        self._own_log = None
+        if event_log is None and log_path is not None:
+            event_log = self._own_log = RunEventLog(
+                log_path, meta={"component": "decode_engine"})
+        self._event_log = event_log
+        self.stats = DecodeStats(event_log=event_log,
+                                 window=stats_window)
+        if breaker is None:
+            breaker = CircuitBreaker(failure_threshold=5, cooldown_s=5.0)
+        elif breaker is False:
+            breaker = None
+        self.admission = AdmissionController(
+            queue_capacity, default_deadline_ms=default_deadline_ms,
+            breaker=breaker)
+        self.memory_budget_bytes = memory_budget_bytes
+        self.fit_plan: Optional[Dict[str, Any]] = None
+        if donate_pools is None:
+            import jax
+
+            donate_pools = jax.default_backend() == "tpu"
+        self._donate = bool(donate_pools)
+
+        self.scope = model.init_params()
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.executor import RNG_STATE_VAR
+
+        self._params = {
+            n: jax.device_put(jnp.asarray(v))
+            for n, v in self.scope.vars.items()
+            if v is not None and n != RNG_STATE_VAR}
+        self._cache_names = model.cache_feed_names()
+        self._pools: Optional[Dict[str, Any]] = None
+        self._decode_exec = None
+        self._prefill_execs: Dict[int, Any] = {}
+        self.page_pool = PagePool(self.config.num_pages)
+        self._page_tables = np.zeros(
+            (self.config.num_slots, self.config.max_pages_per_slot),
+            np.int32)
+        self._slots: List[Optional[_Slot]] = \
+            [None] * self.config.num_slots
+        self._queue: List[DecodeRequest] = []
+        self._unresolved = 0      # accepted requests not yet resolved
+        self._cv = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = False
+        self._started = False
+
+    # -- jitted executables ---------------------------------------------
+    def _feed_env(self, params, pools, **feeds):
+        env = dict(params)
+        env.update(pools)
+        env.update(feeds)
+        return env
+
+    def _build_decode_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.executor import interpret_program
+
+        st = self.model.step
+        program = st["main"]
+        next_name = st["next_token"]
+        cache_outs = st["cache_outs"]
+        cache_names = self._cache_names
+        fetches = (next_name, *cache_outs)
+        chunk = self.config.decode_chunk
+        eos = self.config.eos_id
+
+        def chunk_fn(params, tokens, write_pos, active, remaining,
+                     page_table, pools):
+            outbuf0 = jnp.full((tokens.shape[0], chunk), -1, jnp.int32)
+
+            def cond(c):
+                i, _t, _w, act, fin_any, _r, _p, _o = c
+                return ((i < chunk) & jnp.logical_not(fin_any)
+                        & (jnp.sum(act) > 0))
+
+            def body(c):
+                i, tok, wp, act, _fin, rem, pls, outbuf = c
+                env = self._feed_env(
+                    params, pls, tokens=tok, write_pos=wp,
+                    lengths=wp + 1, active=act, page_table=page_table)
+                env = interpret_program(program, env, None,
+                                        fetch_names=fetches)
+                nxt = env[next_name].astype(jnp.int32)
+                new_pools = {n: env[o] for n, o in
+                             zip(cache_names, cache_outs)}
+                produced = act > 0
+                outbuf = outbuf.at[:, i].set(jnp.where(produced, nxt,
+                                                       -1))
+                new_wp = wp + act
+                new_rem = rem - act
+                fin = produced & (new_rem <= 0)
+                if eos is not None:
+                    fin = fin | (produced & (nxt == eos))
+                new_act = jnp.where(fin, 0, act)
+                new_tok = jnp.where(produced, nxt, tok)
+                return (i + 1, new_tok, new_wp, new_act, jnp.any(fin),
+                        new_rem, new_pools, outbuf)
+
+            init = (jnp.int32(0), tokens, write_pos, active,
+                    jnp.bool_(False), remaining, pools, outbuf0)
+            (steps, tok, wp, act, _fin, rem, pls, outbuf) = \
+                jax.lax.while_loop(cond, body, init)
+            return outbuf, steps, tok, wp, act, rem, pls
+
+        return chunk_fn
+
+    def _build_prefill_fn(self, t_bucket: int):
+        import jax.numpy as jnp
+
+        from ..core.executor import interpret_program
+
+        pre = self.model.prefill(t_bucket)
+        program = pre["main"]
+        next_name = pre["next_token"]
+        cache_outs = pre["cache_outs"]
+        cache_names = self._cache_names
+        fetches = (next_name, *cache_outs)
+
+        def prefill_fn(params, tokens, seq_len, last_idx, page_table,
+                       pools):
+            env = self._feed_env(
+                params, pools, tokens=tokens, seq_len=seq_len,
+                last_idx=last_idx, page_table=page_table)
+            env = interpret_program(program, env, None,
+                                    fetch_names=fetches)
+            nxt = env[next_name].astype(jnp.int32)
+            return nxt, {n: env[o]
+                         for n, o in zip(cache_names, cache_outs)}
+
+        return prefill_fn
+
+    def _specs(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        s = cfg.num_slots
+        i32 = jnp.int32
+        vec = jax.ShapeDtypeStruct((s,), i32)
+        pt = jax.ShapeDtypeStruct((s, cfg.max_pages_per_slot), i32)
+        pool_specs = self.model.pool_specs(cfg.num_pages,
+                                           cfg.page_size)
+        params_spec = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for n, v in self._params.items()}
+        return params_spec, vec, pt, pool_specs
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "DecodeEngine":
+        """Validate the geometry (plan_fit memory gate), AOT-compile
+        every executable (decode chunk + one prefill per bucket), then
+        open for traffic.  Steady state performs zero XLA compiles."""
+        import jax
+
+        with self._cv:
+            if self._started:
+                raise RuntimeError("engine already started")
+            self._started = True
+        cfg = self.config
+        if self._event_log is not None:
+            self._event_log.event(
+                "serving_decode_start",
+                num_slots=cfg.num_slots, page_size=cfg.page_size,
+                num_pages=cfg.num_pages, max_len=cfg.max_len,
+                prefill_buckets=list(cfg.prefill_buckets),
+                decode_chunk=cfg.decode_chunk, kv_dtype=cfg.kv_dtype,
+                queue_capacity=self.admission.queue_capacity)
+        snap = runtime_stats.snapshot()
+        t0 = time.perf_counter()
+        # memory gate BEFORE any full-size compile OR pool allocation
+        # (DecodeMemoryError) — an impossible geometry never touches
+        # the device at its configured size
+        self._validate_memory_budget()
+        self._pools = {n: jax.device_put(v) for n, v in
+                       self.model.fresh_pools(cfg.num_pages,
+                                              cfg.page_size).items()}
+        params_spec, vec, pt, pool_specs = self._specs()
+        donate = (6,) if self._donate else ()
+        self._decode_exec = jax.jit(
+            self._build_decode_fn(),
+            donate_argnums=donate).lower(
+                params_spec, vec, vec, vec, vec, pt,
+                pool_specs).compile()
+        for t in cfg.prefill_buckets:
+            tok = jax.ShapeDtypeStruct((cfg.num_slots, t), jax.numpy.int32)
+            last = jax.ShapeDtypeStruct((cfg.num_slots, 1),
+                                        jax.numpy.int32)
+            donate_p = (5,) if self._donate else ()
+            self._prefill_execs[t] = jax.jit(
+                self._build_prefill_fn(t),
+                donate_argnums=donate_p).lower(
+                    params_spec, tok, vec, last, pt,
+                    pool_specs).compile()
+        delta = runtime_stats.delta(snap)
+        self.stats.record_warmup(1 + len(cfg.prefill_buckets),
+                                 delta["compiles"],
+                                 delta["compile_time_s"],
+                                 time.perf_counter() - t0)
+        self.admission.start()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="decode-scheduler",
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def _validate_memory_budget(self):
+        """Predict the decode step's peak HBM at the CONFIGURED pool
+        size from two small-pool probe compiles (observe.memory
+        plan_fit: peak is affine in the pool page count) and reject an
+        impossible geometry BEFORE the full-size warmup."""
+        budget = self.memory_budget_bytes
+        if budget is False:
+            return
+        if budget is None or budget is True:
+            from ..observe.memory import device_memory_budget
+
+            budget = device_memory_budget()
+        if not budget:
+            self.fit_plan = {"skipped": "no device budget known",
+                             "budget_bytes": None}
+            return
+        cfg = self.config
+        if cfg.num_pages == cfg.num_slots:
+            # plan_fit scales EVERY leading dim equal to `batch`; a
+            # pool exactly the slot count would scale the slot feeds
+            # with it and corrupt the fit
+            self.fit_plan = {"skipped": "num_pages == num_slots "
+                                        "(ambiguous probe axis)",
+                            "budget_bytes": int(budget)}
+            return
+        import jax
+
+        from ..core.executor import Executor, scope_guard
+        from ..observe.memory import plan_fit
+
+        st = self.model.step
+        params_spec, vec, pt, pool_specs = self._specs()
+        feed = dict(pool_specs)
+        i32 = jax.numpy.int32
+        feed.update(tokens=vec, write_pos=vec, lengths=vec,
+                    active=vec, page_table=pt)
+        try:
+            with scope_guard(self.scope):
+                plan = plan_fit(
+                    st["main"], feed,
+                    fetch_list=[st["next_token"]] + st["cache_outs"],
+                    scope=self.scope, batch=cfg.num_pages,
+                    budget_bytes=int(budget))
+        except RuntimeError as e:
+            self.fit_plan = {"skipped": str(e),
+                             "budget_bytes": int(budget)}
+            return
+        self.fit_plan = plan
+        if self._event_log is not None:
+            self._event_log.event("serving_decode_memory_plan", **plan)
+        if plan["fits"] is False:
+            raise DecodeMemoryError(
+                f"decode geometry predicted to exceed the device "
+                f"memory budget: peak "
+                f"{plan['predicted_peak_bytes'] / 1e9:.2f} GB vs "
+                f"budget {budget / 1e9:.2f} GB (num_pages="
+                f"{cfg.num_pages}, page_size={cfg.page_size}, "
+                f"num_slots={cfg.num_slots})",
+                plan=plan, budget_bytes=int(budget))
+
+    def drain(self, timeout_s: float = 120.0) -> bool:
+        """Stop admission, let every accepted request finish decoding.
+        Idempotent."""
+        self.admission.begin_drain()
+        end = time.monotonic() + timeout_s
+        with self._cv:
+            self._cv.notify_all()
+            while self._unresolved > 0:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.05))
+        if self._event_log is not None:
+            self.stats.emit("serving_decode_drain", drained=True)
+        return True
+
+    def close(self, timeout_s: float = 120.0):
+        if self.admission.state == "running":
+            self.drain(timeout_s)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout_s)
+        # shutdown never strands a future
+        leftovers = [s.req for s in self._slots if s is not None]
+        with self._cv:
+            leftovers += self._queue
+            self._queue = []
+            self._slots = [None] * self.config.num_slots
+        for req in leftovers:
+            if not req.future.done():
+                req.future.set_exception(ServingClosedError(
+                    "decode engine shut down before this request "
+                    "completed", state=self.admission.state))
+        self.admission.finish_drain()
+        if self._own_log is not None:
+            self._own_log.close()
+
+    def __enter__(self) -> "DecodeEngine":
+        return self.start() if not self._started else self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def health(self) -> Dict[str, Any]:
+        return self.admission.health(
+            active_slots=sum(s is not None for s in self._slots),
+            num_slots=self.config.num_slots,
+            queue_depth=len(self._queue),
+            pages_in_use=self.page_pool.in_use,
+            num_pages=self.config.num_pages,
+            completed=self.stats.completed,
+            post_warmup_compiles=self.stats.post_warmup_compiles())
+
+    # -- request path ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               priority: int = 0,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Accept one generation request; returns a Future of the
+        generated token ids (np.int32, includes the eos token when one
+        stopped it).  Raises DecodeBucketMissError / QueueFullError /
+        CircuitOpenError / ServingClosedError synchronously."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise DecodeBucketMissError(
+                "prompt must be a non-empty 1-D token array",
+                got_shape=list(prompt.shape))
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        cfg = self.config
+        plen = int(prompt.size)
+        if BucketConfig.pick(cfg.prefill_buckets, plen) is None:
+            self.stats.record_bucket_miss()
+            raise DecodeBucketMissError(
+                f"prompt length {plen} exceeds the largest prefill "
+                f"bucket {cfg.prefill_buckets[-1]}",
+                prompt_len=plen,
+                prefill_buckets=list(cfg.prefill_buckets))
+        if plen + max_new_tokens > cfg.max_len:
+            self.stats.record_bucket_miss()
+            raise DecodeBucketMissError(
+                f"prompt {plen} + max_new_tokens {max_new_tokens} "
+                f"exceeds the per-slot budget max_len {cfg.max_len}",
+                prompt_len=plen, max_new_tokens=int(max_new_tokens),
+                max_len=cfg.max_len)
+        deadline = self.admission.deadline_for(deadline_ms)
+        req = DecodeRequest(prompt.astype(np.int32), max_new_tokens,
+                            priority=priority, deadline=deadline)
+        try:
+            with self._cv:
+                self.admission.check(self._unresolved)
+                self._queue.append(req)
+                self._unresolved += 1
+                self._cv.notify_all()
+        except ServingError as e:
+            if e.kind == "queue_full":
+                self.stats.record_shed()
+            elif e.kind == "circuit_open":
+                self.stats.record_circuit_reject()
+            raise
+        self.stats.record_submit()
+        return req.future
+
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 timeout_s: Optional[float] = None,
+                 **kw) -> np.ndarray:
+        """Synchronous submit()+result() convenience."""
+        return self.submit(prompt, max_new_tokens, **kw).result(
+            timeout_s)
+
+    # -- scheduler ------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while (not self._stop and not self._queue
+                       and not any(self._slots)):
+                    self._cv.wait(0.05)
+                if self._stop:
+                    return
+            try:
+                self._admit()
+                self._decode()
+            except BaseException as e:  # noqa: BLE001 — the scheduler
+                #                         thread must never die silently
+                self._fail_everything(e)
+                return
+            self.stats.maybe_emit()
+
+    def _fail_everything(self, exc: BaseException):
+        wrapped = exc if isinstance(exc, ServingError) else \
+            ExecutorFailureError(
+                f"decode scheduler failed: {type(exc).__name__}: "
+                f"{exc}", error_type=type(exc).__name__)
+        # a dead scheduler must not keep ACCEPTING: later submits get
+        # ServingClosedError instead of queueing forever
+        try:
+            self.admission.begin_drain()
+        except ServingError:
+            pass
+        with self._cv:
+            victims = [s.req for s in self._slots if s is not None]
+            victims += self._queue
+            self._queue = []
+            self._slots = [None] * self.config.num_slots
+            self._unresolved = 0
+            self._cv.notify_all()
+        for req in victims:
+            if not req.future.done():
+                req.future.set_exception(wrapped)
+
+    def _resolve(self, slot_id: int, error: Optional[BaseException]
+                 = None):
+        slot = self._slots[slot_id]
+        self._slots[slot_id] = None
+        self.page_pool.free(slot.pages)
+        self._page_tables[slot_id, :] = 0
+        with self._cv:
+            self._unresolved -= 1
+            self._cv.notify_all()
+        if error is not None:
+            if not slot.req.future.done():
+                slot.req.future.set_exception(error)
+            return
+        if not slot.req.future.done():
+            slot.req.future.set_result(
+                np.asarray(slot.generated, np.int32))
+        self.stats.record_done()
+
+    def _requeue(self, slot_id: int):
+        """Preempt: pages returned, request re-enters the queue head
+        and will regenerate from the prompt (greedy => identical
+        tokens)."""
+        slot = self._slots[slot_id]
+        self._slots[slot_id] = None
+        self.page_pool.free(slot.pages)
+        self._page_tables[slot_id, :] = 0
+        slot.req.preempted += 1
+        with self._cv:
+            self._queue.insert(0, slot.req)
+        self.stats.record_preemption()
+        if self._event_log is not None:
+            self._event_log.event(
+                "serving_decode_preempt", slot=slot_id,
+                priority=slot.req.priority,
+                committed=slot.committed,
+                generated=len(slot.generated),
+                pages_freed=len(slot.pages),
+                pages_free_after=self.page_pool.free_pages)
+
+    def _set_pages(self, slot_id: int, pages: List[int]):
+        self._page_tables[slot_id, :] = 0
+        self._page_tables[slot_id, :len(pages)] = pages
+
+    def _admit(self):
+        """Fill open slots from the queue (prefill-on-join): pick
+        joiners, allocate prompt pages, run ONE bucket-padded prefill
+        dispatch over the whole slot batch (non-joiners masked out by
+        seq_len 0)."""
+        cfg = self.config
+        now = time.monotonic()
+        joiners: List[int] = []
+        while True:
+            free_ids = [i for i, s in enumerate(self._slots)
+                        if s is None]
+            if not free_ids:
+                break
+            req = None
+            with self._cv:
+                # priority first, then FIFO; expired requests drop
+                # before any device time is spent on them
+                self._queue.sort(key=lambda r: (-r.priority,
+                                                r.t_submit))
+                while self._queue:
+                    cand = self._queue[0]
+                    if cand.deadline is not None \
+                            and now > cand.deadline:
+                        self._queue.pop(0)
+                        self._unresolved -= 1
+                        self.stats.record_deadline_miss()
+                        cand.future.set_exception(
+                            DeadlineExceededError(
+                                "deadline expired before a slot "
+                                "opened",
+                                queued_ms=round(
+                                    (now - cand.t_submit) * 1e3, 3)))
+                        continue
+                    req = cand
+                    break
+                if req is not None:
+                    need = _cdiv(len(req.prompt), cfg.page_size)
+                    pages = self.page_pool.alloc(need)
+                    if pages is None:
+                        req = None  # pool dry: decode frees pages,
+                        #             not admission
+                    else:
+                        self._queue.pop(0)
+            if req is None:
+                break
+            slot_id = free_ids[0]
+            self._slots[slot_id] = _Slot(req, pages)
+            self._set_pages(slot_id, pages)
+            joiners.append(slot_id)
+        if not joiners:
+            return
+        self._dispatch_prefill(joiners)
+
+    def _dispatch_prefill(self, joiners: List[int]):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        bucket = BucketConfig.pick(
+            cfg.prefill_buckets,
+            max(len(self._slots[i].req.prompt) for i in joiners))
+        tokens = np.zeros((cfg.num_slots, bucket), np.int32)
+        seq_len = np.zeros((cfg.num_slots,), np.int32)
+        last_idx = np.zeros((cfg.num_slots, 1), np.int32)
+        for i in joiners:
+            p = self._slots[i].req.prompt
+            tokens[i, :len(p)] = p
+            seq_len[i] = len(p)
+            last_idx[i, 0] = len(p) - 1
+        exec_ = self._prefill_execs[bucket]
+        try:
+            nxt, pools = exec_(self._params, jnp.asarray(tokens),
+                               jnp.asarray(seq_len),
+                               jnp.asarray(last_idx),
+                               jnp.asarray(self._page_tables),
+                               self._pools)
+        except BaseException as e:
+            self.stats.record_executor_failure()
+            self._breaker_result(False, len(joiners))
+            err = ExecutorFailureError(
+                f"prefill dispatch failed for {len(joiners)} join(s): "
+                f"{type(e).__name__}: {e}",
+                error_type=type(e).__name__, joins=len(joiners))
+            for i in joiners:
+                self._resolve(i, error=err)
+            return
+        self._breaker_result(True, len(joiners))
+        self._pools = pools
+        nxt = np.asarray(nxt)
+        now = time.monotonic()
+        ttfts = []
+        for i in joiners:
+            slot = self._slots[i]
+            tok = int(nxt[i])
+            slot.cur_tok = tok
+            slot.generated.append(tok)
+            slot.remaining = slot.req.max_new_tokens - 1
+            ttfts.append((now - slot.req.t_submit) * 1e3)
+        self.stats.record_prefill(len(joiners), ttfts)
+        # a request satisfied by its very first token resolves here
+        for i in joiners:
+            slot = self._slots[i]
+            if slot.remaining <= 0 or (cfg.eos_id is not None
+                                       and slot.cur_tok == cfg.eos_id):
+                self._resolve(i)
+
+    def _breaker_result(self, ok: bool, n: int):
+        res = self.admission.record_dispatch_result(ok)
+        if res and self._event_log is not None:
+            self._event_log.event(
+                f"serving_breaker_{'open' if res == 'opened' else 'close'}",
+                state=self.admission.state, component="decode_engine",
+                breaker=self.admission.breaker.snapshot(),
+                batch=n)
+
+    def _ensure_decode_pages(self) -> List[int]:
+        """Extend every active slot's pages to cover the next chunk,
+        preempting the least-important slots when the pool runs dry.
+        Returns the slot ids still active afterwards."""
+        cfg = self.config
+        order = sorted(
+            (i for i, s in enumerate(self._slots) if s is not None),
+            key=lambda i: self._slots[i].importance(), reverse=True)
+        for i in order:
+            slot = self._slots[i]
+            if slot is None:
+                continue  # preempted as a victim earlier in the loop
+            target = _cdiv(min(slot.committed + cfg.decode_chunk,
+                               slot.cap_tokens), cfg.page_size)
+            while slot is not None and target > len(slot.pages):
+                got = self.page_pool.alloc(target - len(slot.pages))
+                if got is not None:
+                    slot.pages.extend(got)
+                    self._set_pages(i, slot.pages)
+                    break
+                # pool dry: evict the least-important active slot
+                # (possibly this one)
+                victims = [j for j, sj in enumerate(self._slots)
+                           if sj is not None]
+                victim = min(victims,
+                             key=lambda j: self._slots[j].importance())
+                self._requeue(victim)
+                slot = self._slots[i]
+        return [i for i, s in enumerate(self._slots) if s is not None]
+
+    def _decode(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        active_ids = self._ensure_decode_pages()
+        if not active_ids:
+            return
+        s = cfg.num_slots
+        tokens = np.zeros((s,), np.int32)
+        write_pos = np.zeros((s,), np.int32)
+        active = np.zeros((s,), np.int32)
+        remaining = np.zeros((s,), np.int32)
+        for i in active_ids:
+            slot = self._slots[i]
+            tokens[i] = slot.cur_tok
+            write_pos[i] = slot.committed
+            active[i] = 1
+            remaining[i] = slot.remaining
+        t0 = time.perf_counter()
+        try:
+            (outbuf, steps, new_tok, new_wp, new_act, new_rem,
+             pools) = self._decode_exec(
+                self._params, jnp.asarray(tokens),
+                jnp.asarray(write_pos), jnp.asarray(active),
+                jnp.asarray(remaining),
+                jnp.asarray(self._page_tables), self._pools)
+        except BaseException as e:
+            self.stats.record_executor_failure()
+            self._breaker_result(False, len(active_ids))
+            err = ExecutorFailureError(
+                f"decode dispatch failed for {len(active_ids)} "
+                f"slot(s): {type(e).__name__}: {e}",
+                error_type=type(e).__name__, slots=len(active_ids))
+            for i in active_ids:
+                self._resolve(i, error=err)
+            return
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        self._breaker_result(True, len(active_ids))
+        self._pools = pools
+        outbuf = np.asarray(outbuf)
+        steps = int(steps)
+        new_wp = np.asarray(new_wp)
+        new_act = np.asarray(new_act)
+        new_rem = np.asarray(new_rem)
+        new_tok = np.asarray(new_tok)
+        total_tokens = 0
+        for i in active_ids:
+            slot = self._slots[i]
+            produced = int(new_wp[i]) - slot.committed
+            toks = [int(t) for t in outbuf[i, :produced] if t >= 0]
+            slot.generated.extend(toks)
+            total_tokens += len(toks)
+            slot.committed = int(new_wp[i])
+            slot.cur_tok = int(new_tok[i])
+            slot.remaining = int(new_rem[i])
+        self.stats.record_decode(
+            steps, len(active_ids), cfg.num_slots, total_tokens,
+            self.page_pool.in_use, cfg.num_pages, elapsed_ms)
+        for i in active_ids:
+            if int(new_act[i]) == 0:
+                self._resolve(i)

@@ -168,6 +168,46 @@ def test_full_longctx_train_step_lowers_for_tpu():
     assert exp.mlir_module().count("tpu_custom_call") >= 5
 
 
+def test_paged_attention_lowers_for_tpu():
+    """The ragged paged-attention decode kernel (ISSUE 12) lowers to
+    Mosaic for the TPU target — scalar-prefetched page-table block
+    index maps included — and its module carries ZERO
+    stablehlo.transpose (the head-major from-birth boundary proof,
+    chip-free)."""
+    from paddle_tpu.ops.pallas.paged_attention import \
+        ragged_paged_attention
+
+    s, h, d, p, page, maxp = 8, 4, 64, 32, 16, 8
+    q = jnp.zeros((s, h * d), jnp.float32)
+    kc = jnp.zeros((p, page, h * d), jnp.bfloat16)
+    pt = jnp.zeros((s, maxp), jnp.int32)
+    ln = jnp.ones((s,), jnp.int32)
+    exp = _export_tpu(
+        lambda q, kc, vc, pt, ln: ragged_paged_attention(
+            q, kc, vc, pt, ln, n_head=h), q, kc, kc, pt, ln)
+    mlir = exp.mlir_module()
+    assert "stablehlo.transpose" not in mlir, \
+        "transpose at the paged-attention kernel boundary"
+
+
+def test_paged_attention_int8_lowers_for_tpu():
+    """The int8-pool variant (per-row scale sidecars) also lowers."""
+    from paddle_tpu.ops.pallas.paged_attention import \
+        ragged_paged_attention
+
+    s, h, d, p, page, maxp = 4, 2, 64, 16, 16, 4
+    q = jnp.zeros((s, h * d), jnp.float32)
+    kc = jnp.zeros((p, page, h * d), jnp.int8)
+    sc = jnp.ones((p, page, 1), jnp.float32)
+    pt = jnp.zeros((s, maxp), jnp.int32)
+    ln = jnp.ones((s,), jnp.int32)
+    exp = _export_tpu(
+        lambda q, kc, vc, ks, vs, pt, ln: ragged_paged_attention(
+            q, kc, vc, pt, ln, n_head=h, k_scales=ks, v_scales=vs),
+        q, kc, kc, sc, sc, pt, ln)
+    assert "stablehlo.transpose" not in exp.mlir_module()
+
+
 def test_fused_lstm_fwd_lowers_for_tpu():
     from paddle_tpu.ops.pallas.recurrence import fused_lstm
 

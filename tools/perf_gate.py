@@ -176,6 +176,25 @@ def check_schema(candidate):
                                   f"missing {field!r} (numerics "
                                   f"observability, docs/OBSERVE.md "
                                   f"pillar 6)")
+        if name.startswith("serving_decode"):
+            # decode contract (ISSUE 12, docs/SERVING.md §decode): a
+            # continuous-batching decode entry must carry the
+            # steady-state throughput, the scheduler's occupancy/
+            # preemption telemetry, and the zero-recompile proof —
+            # a tokens/s number without them is not interpretable
+            for field in ("tokens_per_sec", "slot_occupancy",
+                          "kv_page_utilization", "preemptions",
+                          "post_warmup_compiles"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: decode entry "
+                                  f"missing {field!r} (decode "
+                                  f"telemetry contract)")
+            if entry.get("post_warmup_compiles"):
+                errors.append(
+                    f"detail.{name}: {entry['post_warmup_compiles']} "
+                    f"post-warmup compile(s) — a shape leaked across "
+                    f"joins/leaves/preemptions (the zero-recompile "
+                    f"decode contract)")
         if "mesh" in entry:
             # dp-mesh contract (ISSUE 10, docs/DIST.md): a multi-chip
             # entry must carry per-device AND aggregate throughput plus

@@ -120,6 +120,16 @@ VARIANTS = [
     ("dp8_bf16", ["--model", "transformer", "--mesh", "dp=8"]),
     ("dp8_int8ar", ["--model", "transformer", "--mesh", "dp=8",
                     "--grad-sync", "int8"]),
+    # r09: the paged-KV decode cache precision pair (ISSUE 12 stretch).
+    # int8 pools halve KV bytes vs bf16 (per-row f32 scale sidecars,
+    # the blockwise scheme of parallel/collectives.py) — whether that
+    # converts to tokens/s depends on whether decode attention is
+    # pool-bandwidth-bound at the benched geometry.  wins() compares
+    # the decode entry's tokens_per_sec as everywhere; the kv default
+    # stays bf16 pending a chip wall-clock win (device-tag rule).
+    ("serving_decode_kv_bf16", ["--model", "serving_decode"]),
+    ("serving_decode_kv_int8", ["--model", "serving_decode",
+                                "--kv-int8"]),
 ]
 
 
@@ -324,6 +334,9 @@ _PAIRS = {
     # at the same dp degree; per-pair comm-bytes context rides the
     # summary (<name>_comm_bytes)
     "dp8_int8ar": ("dp8_int8ar", "dp8_bf16"),
+    # int8 KV pools vs the bf16 default for continuous-batching decode
+    "decode_kv_int8": ("serving_decode_kv_int8",
+                       "serving_decode_kv_bf16"),
 }
 
 
@@ -358,7 +371,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r08.json")
+    p.add_argument("--out", default="AB_r09.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     p.add_argument("--bench-args", default=None,

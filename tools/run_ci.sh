@@ -261,6 +261,92 @@ print("serving smoke OK:",
                          "post_warmup_compiles")})
 EOF
 
+echo "== continuous-batching decode smoke (cpu) =="
+# ISSUE 12 tentpole: the paged-KV decode engine end-to-end — requests
+# JOIN open slots mid-generation (more requests than slots), a
+# deliberately tight pool forces at least one preemption, drain
+# resolves everything, and the whole stream performs ZERO XLA compiles
+# after warmup (fixed-shape executables across any join/leave/preempt
+# pattern).  Parity: the continuous-batching tokens must be identical
+# to the SAME requests decoded one-at-a-time in a single-slot engine —
+# a request's output may not depend on who shared the batch (the
+# full-KV reference parity runs in tests/test_paged_decode.py below).
+python - <<'EOF'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.observe.monitoring import runtime_stats
+from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+lm = DecoderLM(vocab_size=96, n_layer=2, n_head=2, d_model=32,
+               d_inner=64, kv_dtype="float32", seed=3)
+prompts = make_prompts(6, 96, min_len=3, max_len=14, seed=2)
+budgets = [8, 3, 10, 5, 7, 4]
+
+# continuous: 2 slots, pool below 2x worst case -> joins + preemption
+cfg = DecodeConfig(num_slots=2, page_size=4, max_len=40, num_pages=11,
+                   prefill_buckets=(8, 16), decode_chunk=4,
+                   kv_dtype="float32")
+eng = DecodeEngine(lm, cfg, memory_budget_bytes=False).start()
+snap = runtime_stats.snapshot()
+futs = [eng.submit(p, max_new_tokens=b, priority=i % 2)
+        for i, (p, b) in enumerate(zip(prompts, budgets))]
+outs = [f.result(300).tolist() for f in futs]
+assert eng.drain(120), "drain timed out"
+compiles = runtime_stats.delta(snap)["compiles"]
+s = eng.stats.snapshot()
+eng.close()
+assert compiles == 0, f"{compiles} XLA compiles AFTER warmup (shape leak)"
+assert s["post_warmup_compiles"] == 0 and s["completed"] == 6, s
+assert s["prefills"] >= 3, f"no mid-generation joins happened: {s}"
+assert s["tokens_generated"] == sum(budgets)
+
+# one-at-a-time isolation reference (single-slot engine)
+cfg1 = DecodeConfig(num_slots=1, page_size=4, max_len=40, num_pages=10,
+                    prefill_buckets=(8, 16), decode_chunk=4,
+                    kv_dtype="float32")
+solo = DecodeEngine(lm, cfg1, memory_budget_bytes=False).start()
+refs = [solo.generate(p, max_new_tokens=b, timeout_s=300).tolist()
+        for p, b in zip(prompts, budgets)]
+solo.close()
+assert outs == refs, "continuous-batching tokens depend on batch-mates"
+print("decode smoke OK:",
+      {k: s[k] for k in ("completed", "prefills", "preemptions",
+                         "slot_occupancy", "kv_page_utilization",
+                         "post_warmup_compiles")})
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_paged_decode.py -q
+
+echo "== decode bench line + schema gate (cpu) =="
+# the --model serving_decode entry must print one JSON line carrying
+# steady-state tokens/s + the decode telemetry contract with
+# post_warmup_compiles == 0, and satisfy perf_gate --schema
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "serving_decode",
+     "--probe-timeout", "0"],
+    capture_output=True, text=True, timeout=900)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["serving_decode"]
+assert "error" not in d, d
+assert d["tokens_per_sec"] > 0 and d["post_warmup_compiles"] == 0, d
+for k in ("slot_occupancy", "kv_page_utilization", "preemptions",
+          "ttft_p50_ms", "tpot_p50_ms", "kv_dtype"):
+    assert k in d, k
+with open("/tmp/bench_decode_line.json", "w") as f:
+    f.write(lines[-1])
+print("decode bench smoke OK:",
+      {k: d[k] for k in ("tokens_per_sec", "slot_occupancy",
+                         "kv_page_utilization", "preemptions",
+                         "post_warmup_compiles", "kv_dtype")})
+EOF
+python tools/perf_gate.py --schema --candidate /tmp/bench_decode_line.json
+
 echo "== resilience chaos smoke (cpu) =="
 # the fault-tolerance contract end-to-end (docs/RESILIENCE.md): inject
 # NaN at step 3 -> the guard skips exactly that update; corrupt the
