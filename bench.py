@@ -32,13 +32,16 @@ transformer|bert|lstm|deepfm|serving|serving_engine] [--batch N] [--steps N]
 [--no-amp] [--no-flash] [--data synthetic|frozen|host]`.  Default 60
 timed steps: a ~3 s timed window keeps MFU stable run-to-run.
 
-Multi-chip (docs/DIST.md): `--mesh dp=N` benches the training models
-data-parallel over a device mesh — global-batch feeds shard over the
-dp axis, entries key `<model>_dpN` and carry per_device_* throughput
-next to the aggregate, MFU against the aggregate peak, and the
-sharded step's comm-bucket bytes; `--grad-sync int8` swaps the
-gradient all-reduce for the EQuARX blockwise-quantized exchange
-(opt-in, A/B'd in AB_r08.json).
+Multi-chip (docs/DIST.md): `--mesh dp=N` (or `dp=2,mp=2`, `fsdp=4`)
+benches the training models over a device mesh — global-batch feeds
+shard over the data axes (dp + fsdp), an mp axis applies the Megatron
+transformer rules, an fsdp axis ZeRO-shards optimizer state.  Entries
+key `<model>_dp8` / `<model>_dp2mp2` and carry per_device_*
+throughput next to the aggregate, MFU against the aggregate peak, the
+sharded step's comm-bucket bytes, and opt_state_bytes_per_device;
+`--grad-sync int8` swaps the gradient all-reduce for the EQuARX
+blockwise-quantized exchange (opt-in, A/B'd in AB_r08.json;
+psum-form on composed meshes).
 """
 
 from __future__ import annotations
@@ -287,7 +290,10 @@ def _mfu_result(step_flops, steps, elapsed, extra, n_devices=1):
 
 
 def _parse_mesh(spec: str):
-    """--mesh "dp=8" (or "dp=4,mp=2") -> ordered axis dict."""
+    """--mesh "dp=8" (or "dp=2,mp=2", "fsdp=4") -> ordered axis dict.
+    Any named axis parses; "dp"/"fsdp" shard the batch (fsdp
+    additionally ZeRO-shards optimizer state), "mp" turns on the
+    Megatron transformer rules (docs/DIST.md §hybrid)."""
     axes = {}
     for part in spec.split(","):
         name, _, size = part.partition("=")
@@ -304,20 +310,35 @@ def _parse_mesh(spec: str):
     return axes
 
 
+def _mesh_key(mesh_axes) -> str:
+    """Unambiguous entry-key suffix for a mesh: "_dp8", "_dp2mp2",
+    "_fsdp4" — one token per axis, no separators, so a multi-axis key
+    can never collide with two single-axis runs' keys."""
+    return "_" + "".join(f"{a}{s}" for a, s in mesh_axes.items())
+
+
 def _dp_compile(program, loss, mesh_axes, grad_sync):
-    """Wrap a built training program for the dp-mesh bench: feeds get a
-    batch-dim PartitionSpec over the data axis
-    (ShardingRules.feed_spec_for), params replicate (the
-    ParallelExecutor AllReduce mode) and gradients all-reduce
-    implicitly via GSPMD — or explicitly, blockwise-int8-quantized,
-    with --grad-sync int8 (docs/DIST.md).  Executor.run routes through
-    the wrapper automatically from here on."""
+    """Wrap a built training program for the mesh bench: feeds get a
+    batch-dim PartitionSpec over the data axes (dp + fsdp,
+    ShardingRules.feed_spec_for), params replicate (the
+    ParallelExecutor AllReduce mode) unless the mesh has an "mp" axis —
+    then the Megatron transformer rules shard them — and optimizer
+    state ZeRO-shards over an "fsdp" axis when present
+    (strategies.zero_axis).  Gradients all-reduce implicitly via GSPMD
+    — or explicitly, blockwise-int8-quantized, with --grad-sync int8
+    (docs/DIST.md).  Executor.run routes through the wrapper
+    automatically from here on."""
     import paddle_tpu as fluid
     from paddle_tpu.parallel import make_mesh
 
     mesh = make_mesh(mesh_axes)
     bs = fluid.BuildStrategy()
     bs.grad_sync = grad_sync
+    if mesh_axes.get("mp", 1) > 1:
+        from paddle_tpu.parallel.strategies import \
+            megatron_transformer_rules
+
+        bs.sharding_rules = megatron_transformer_rules()
     fluid.CompiledProgram(program).with_data_parallel(
         loss_name=loss.name, build_strategy=bs, mesh=mesh)
     return mesh
@@ -354,11 +375,34 @@ def _comm_fields(program, feed, loss, scope):
                 "comm_error": f"{type(e).__name__}: {e}"}
 
 
+def _opt_state_fields(program, feed, loss, scope):
+    """Per-device optimizer-state accounting of the SHARDED step
+    (ISSUE 13): `opt_state_bytes_per_device` is the resident
+    accumulator bytes one device holds (observe.resident_state_bytes
+    over the sharded compile's buffer assignment) — the number the
+    fsdp/ZeRO A/B claims drops ~1/N.  Failures record in-band."""
+    try:
+        from paddle_tpu import observe
+
+        rep = observe.sharded_memory_report(
+            program, feed=feed, fetch_list=[loss], scope=scope)
+        return {"opt_state_bytes_per_device":
+                observe.resident_state_bytes(rep),
+                "params_bytes_per_device":
+                observe.resident_state_bytes(rep, bucket="params")}
+    except Exception as e:  # noqa: BLE001 — observability must not
+        #                     take down the measurement it describes
+        return {"opt_state_bytes_per_device": None,
+                "opt_state_error": f"{type(e).__name__}: {e}"}
+
+
 def _dp_fields(program, feed, loss, scope, mesh_axes, grad_sync,
                agg_throughput: dict):
-    """The per-entry dp contract (perf_gate --schema enforces it on
-    mesh entries): the mesh, device count, grad-sync mode, PER-DEVICE
-    throughput next to the aggregate, and the comm-bucket bytes."""
+    """The per-entry mesh contract (perf_gate --schema enforces it on
+    mesh entries): the mesh (per-axis sizes), device count, grad-sync
+    mode, PER-DEVICE throughput next to the aggregate, the comm-bucket
+    bytes, and the per-device optimizer-state bytes of the sharded
+    step."""
     n_dev = 1
     for s in mesh_axes.values():
         n_dev *= s
@@ -367,6 +411,7 @@ def _dp_fields(program, feed, loss, scope, mesh_axes, grad_sync,
     for key, val in agg_throughput.items():
         out[f"per_device_{key}"] = round(val / n_dev, 2)
     out.update(_comm_fields(program, feed, loss, scope))
+    out.update(_opt_state_fields(program, feed, loss, scope))
     return out
 
 
@@ -1242,18 +1287,22 @@ def main():
                             "serving_engine", "serving_decode",
                             "longctx"])
     p.add_argument("--batch", type=int, default=0)
-    p.add_argument("--mesh", default=None, metavar="dp=N",
+    p.add_argument("--mesh", default=None, metavar="dp=N[,mp=M]",
                    help="bench the training models (resnet50/"
-                        "transformer/bert/deepfm) data-parallel over a "
-                        "device mesh, e.g. --mesh dp=8: the --batch is "
-                        "the GLOBAL batch, feeds shard over the dp "
-                        "axis via GSPMD and grads all-reduce "
-                        "implicitly.  Entries gain per_device_* "
-                        "throughput + comm_bytes and key as "
-                        "<model>_dp<N>.  With BENCH_PLATFORM=cpu the "
-                        "virtual host-device count is raised to fit "
-                        "(the CI smoke mesh); on a real slice the "
-                        "devices must exist (docs/DIST.md)")
+                        "transformer/bert/deepfm) over a device mesh, "
+                        "e.g. --mesh dp=8, --mesh dp=2,mp=2, --mesh "
+                        "fsdp=4: the --batch is the GLOBAL batch, "
+                        "feeds shard over the data axes (dp + fsdp) "
+                        "via GSPMD and grads all-reduce implicitly; "
+                        "an mp axis applies the Megatron transformer "
+                        "rules; an fsdp axis ZeRO-shards optimizer "
+                        "state ~1/N per device.  Entries gain "
+                        "per_device_* throughput + comm_bytes + "
+                        "opt_state_bytes_per_device and key as "
+                        "<model>_dp2mp2-style.  With BENCH_PLATFORM="
+                        "cpu the virtual host-device count is raised "
+                        "to fit (the CI smoke mesh); on a real slice "
+                        "the devices must exist (docs/DIST.md)")
     p.add_argument("--grad-sync", default="none",
                    choices=["none", "bf16", "int8"],
                    help="dp gradient-exchange mode (needs --mesh): "
@@ -1564,11 +1613,11 @@ def main():
         detail[name]["peak_mem_bytes"] = _obs.peak_memory_bytes()
         _snapshot()
 
-    # dp-mesh entries key as <model>_<mesh> (e.g. transformer_dp8): a
-    # dp number must never collide with (or gate against) the
-    # single-device entry of the same model in an artifact
-    mesh_sfx = ("_" + "_".join(f"{a}{s}" for a, s in mesh_axes.items())
-                if mesh_axes else "")
+    # mesh entries key as <model>_<mesh> (transformer_dp8,
+    # transformer_dp2mp2, transformer_fsdp4): a mesh number must never
+    # collide with (or gate against) the single-device entry of the
+    # same model — or a different mesh's — in an artifact
+    mesh_sfx = _mesh_key(mesh_axes) if mesh_axes else ""
     dp_kw = {"mesh_axes": mesh_axes, "grad_sync": grad_sync}
 
     if args.model in ("all", "resnet50"):

@@ -490,21 +490,36 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
     # jitted step.
     gs_cfg = getattr(program, "_grad_sync", None)
     gs_ectx = None
+    gs_axes: Tuple[str, ...] = ()
     if gs_cfg is not None:
         from ..parallel.mesh import get_exec_context
 
         _ectx = get_exec_context()
-        if (_ectx is not None
-                and _ectx.mesh.shape.get(_ectx.batch_axis, 1) > 1):
-            gs_ectx = _ectx
+        if _ectx is not None:
+            # the DATA axes of the mesh: the batch axis plus the
+            # ZeRO/fsdp axis when the wrapper's rules name one
+            # (strategies.data_axes_for) — fsdp is dp with sharded
+            # optimizer state, so the explicit exchange spans both
+            _wrapper = getattr(program, "_compiled_wrapper", None)
+            if _wrapper is not None and _wrapper._rules is not None:
+                gs_axes = _wrapper._rules.data_axes_for(
+                    _ectx.mesh, _ectx.batch_axis)
+            else:
+                gs_axes = tuple(
+                    a for a in (_ectx.batch_axis,)
+                    if _ectx.mesh.shape.get(a, 1) > 1)
+            if gs_axes:
+                gs_ectx = _ectx
     if gs_ectx is not None:
-        # a FINAL PARTIAL batch that no longer divides the dp axis
+        # a FINAL PARTIAL batch that no longer divides the data axes
         # falls back to the ordinary (replicated-feed) path — exact
         # grads, no dp speedup for that one step — mirroring
         # ShardingRules.feed_spec_for's replicate-on-indivisible rule
         # instead of crashing the epoch tail (found by driving the
         # surface; pinned in tests/test_grad_sync.py)
-        _n_dp = gs_ectx.mesh.shape[gs_ectx.batch_axis]
+        _n_dp = 1
+        for _a in gs_axes:
+            _n_dp *= gs_ectx.mesh.shape[_a]
         if not any(
                 hasattr(env.get(f), "ndim")
                 and getattr(env.get(f), "ndim", 0) >= 1
@@ -521,7 +536,8 @@ def interpret_program(program: Program, env: Dict[str, Any], rng_key,
                 "grad_sync without accumulation.")
         loss_val, grads, env = _dp_sync_value_and_grad(
             grad_fwd, fwd_ops, sparse_lookups, trainable, env, rng_key,
-            gs_ectx, gs_cfg, feed_names, fwd_keep)
+            gs_ectx, gs_cfg, feed_names, fwd_keep, gs_axes,
+            program=program)
     elif accum_steps <= 1:
         if sparse_lookups:
             loss_val, grads, env = _sparse_value_and_grad(
@@ -674,21 +690,27 @@ def _sparse_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
 
 
 def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
-                            rng_key, ectx, cfg, feed_names, keep_names):
+                            rng_key, ectx, cfg, feed_names, keep_names,
+                            data_axes=None, program=None):
     """Data-parallel fwd+bwd with an EXPLICIT gradient exchange
     (docs/DIST.md).  The forward/backward runs inside a shard_map over
-    the mesh's batch axis: every rank differentiates its local batch
+    the mesh's DATA axes (the batch axis, plus the fsdp/ZeRO axis when
+    present — ISSUE 13): every rank differentiates its local batch
     shard's mean loss, then
 
       - dense grads sync through `cfg.mode`: exact lax.pmean ("bf16")
-        or collectives.quantized_all_reduce_local ("int8" — blockwise
-        int8 payloads + f32 scale sidecars, two-phase, EQuARX);
+        or the EQuARX blockwise-int8 two-phase exchange ("int8") —
+        collectives.quantized_all_reduce_local on a single-axis
+        fully-manual mesh, its psum-form twin
+        (quantized_all_reduce_psum: same quantization, same error
+        model, single-psum movement) on multi-axis data groups and
+        under partial-auto, where all_to_all/all_gather cannot lower;
         tensors below cfg.min_quant_numel ride the exact psum either
         way (the bf16-fallback floor);
-      - SparseGrad STAYS SPARSE: ids+rows all_gather over dp (the
-        concatenation densifies to the same scatter-add sum a global
-        batch would produce) — O(touched rows) on the wire, and hot
-        embedding rows never eat quantization error;
+      - SparseGrad STAYS SPARSE: ids+rows gathered over the data axes
+        (all_gather on the single-axis manual path, a
+        dynamic_update_slice + psum concatenation elsewhere — same
+        O(touched-rows) payload, never quantized);
       - the loss pmeans; forward-written values someone reads
         downstream (fetches, persistable BN stats, lr-schedule vars)
         leave the shard_map classified per name: batch-dim outputs
@@ -696,36 +718,70 @@ def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
         (cross-replica-mean BN semantics), replicated ints pmax.
 
     Both sync modes produce BITWISE-identical results on every rank
-    (fixed-order accumulation + gathered bytes are shared), so the
-    replicated parameters can never drift apart across dp ranks.
+    (fixed-order/all-reduce accumulation + shared bytes), so the
+    replicated parameters can never drift apart across data ranks.
 
-    RNG: each rank folds its axis index into the step key — dropout
-    draws differ per rank like separate workers' would; exact-parity
-    tests against single-device runs therefore pin dropout=0.
+    Composition (ISSUE 13): non-data sharded axes (mp/ep/sp) stay
+    GSPMD-owned via partial-auto shard_map — params enter with their
+    mp shardings intact and the Megatron collectives are still
+    GSPMD-inserted inside the body.  The one DESIGNED error left:
+    params sharded over a data axis (ZeRO-3-style default="fsdp"
+    rules) — the replicated param entry would silently all-gather the
+    model every step.
 
-    Restriction (loud): pure-dp meshes only — on a mesh with another
-    sharded axis the replicated param entry would all-gather the model.
+    RNG: each rank folds its linearized data-rank index into the step
+    key — dropout draws differ per rank like separate workers' would;
+    exact-parity tests against single-device runs therefore pin
+    dropout=0.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.collectives import (compat_shard_map,
-                                        quantized_all_reduce_local)
+                                        quantized_all_reduce_local,
+                                        quantized_all_reduce_psum)
     from .selected_rows import SparseGrad
 
-    mesh, axis = ectx.mesh, ectx.batch_axis
-    n = mesh.shape[axis]
-    other = sorted(a for a, s in mesh.shape.items()
-                   if a != axis and s > 1)
-    if other:
-        raise ValueError(
-            f"grad_sync={cfg.mode!r} supports pure data-parallel "
-            f"meshes; this mesh also has sharded axes {other}.  The "
-            f"explicit exchange enters a shard_map over {axis!r} with "
-            f"params replicated, which would silently all-gather "
-            f"{other}-sharded params.  Use the default GSPMD grad "
-            f"sync on composed meshes (docs/DIST.md).")
+    mesh = ectx.mesh
+    axes = tuple(data_axes) if data_axes else (ectx.batch_axis,)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    auto = tuple(sorted(a for a, s in mesh.shape.items()
+                        if a not in axes and s > 1))
+    # the one remaining designed restriction: a param sharded over a
+    # DATA axis cannot enter the exchange replicated (it would
+    # all-gather the model); mp/ep-sharded params are fine — they ride
+    # the auto axes with their shardings intact
+    _wrapper = getattr(program, "_compiled_wrapper", None) \
+        if program is not None else None
+    if _wrapper is not None and _wrapper._rules is not None:
+        def _spec_axes(spec):
+            for e in spec:
+                if e is None:
+                    continue
+                yield from (e if isinstance(e, (tuple, list)) else (e,))
+
+        bad = sorted(
+            pname for pname, v in trainable.items()
+            if any(ax in axes for ax in _spec_axes(
+                _wrapper._rules.spec_for(pname, v.shape, mesh))))
+        if bad:
+            raise ValueError(
+                f"grad_sync={cfg.mode!r} cannot run with params "
+                f"sharded over the data axes {axes}: {bad[:4]}… enter "
+                f"the exchange shard_map replicated, which would "
+                f"silently all-gather them every step.  Keep param "
+                f"sharding on non-data axes (mp), or use the default "
+                f"GSPMD sync for ZeRO-3-style param sharding "
+                f"(docs/DIST.md §hybrid).")
+    # the collective axis argument: a bare name for single-axis data
+    # groups, the tuple for composed dp×fsdp groups
+    ax = axes[0] if len(axes) == 1 else axes
+    # all_to_all/all_gather survive only the fully-manual single-axis
+    # mesh; everything else uses the psum-form exchanges
+    psum_only = bool(auto) or len(axes) > 1
 
     feeds = {}
     for name in feed_names:
@@ -736,7 +792,7 @@ def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
     if not feeds:
         raise ValueError(
             f"grad_sync needs at least one feed with a batch dim "
-            f"divisible by {axis}={n}; got "
+            f"divisible by {axes}={n}; got "
             f"{[(k, getattr(env.get(k), 'shape', None)) for k in feed_names]}")
     base_env = {k: v for k, v in env.items() if k not in feeds}
 
@@ -787,29 +843,62 @@ def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
                 f"local-shard shape {sl} vs global shape {sg} differ "
                 f"beyond the leading batch dim")
 
+    # the linearized data-rank index (RNG fold, sparse-concat offset)
+    # enters as a SHARDED IOTA input rather than lax.axis_index:
+    # axis_index of a manual axis lowers to stablehlo.partition_id,
+    # which this XLA's SPMD partitioner rejects inside partial-auto
+    # regions ("PartitionId instruction is not supported...") — found
+    # the hard way benching dropout on dp×mp.  An arange split over the
+    # data axes hands every rank its own index with plain math.
+    _rank_holder = []
+
+    def rank_index():
+        return _rank_holder[0]
+
+    def gather_concat(v, scale=None):
+        """Concatenate per-rank arrays along dim 0 across the data
+        group.  Single-axis manual meshes use all_gather; multi-axis /
+        partial-auto groups emulate it with dynamic_update_slice +
+        psum (all_gather hard-aborts the partitioner there)."""
+        if scale is not None:
+            v = v * jnp.asarray(scale, v.dtype)
+        if not psum_only:
+            return jax.lax.all_gather(v, ax, axis=0, tiled=True)
+        full = jnp.zeros((n * v.shape[0],) + v.shape[1:], v.dtype)
+        start = (rank_index() * v.shape[0],) + (0,) * (v.ndim - 1)
+        return jax.lax.psum(jax.lax.dynamic_update_slice(full, v, start),
+                            ax)
+
     def sync_grad(g):
         if isinstance(g, SparseGrad):
-            ids = jax.lax.all_gather(g.ids, axis, axis=0, tiled=True)
-            rows = jax.lax.all_gather(
-                g.rows * jnp.asarray(1.0 / n, g.rows.dtype), axis,
-                axis=0, tiled=True)
-            return SparseGrad(ids, rows, g.dense_shape)
+            # ids+rows concatenation over the data group: densifies to
+            # the same scatter-add sum a global batch would produce —
+            # O(touched rows), never quantized
+            return SparseGrad(gather_concat(g.ids),
+                              gather_concat(g.rows, scale=1.0 / n),
+                              g.dense_shape)
         if cfg.mode == "int8":
+            if psum_only:
+                return quantized_all_reduce_psum(
+                    g, ax, n, None, block_size=cfg.block_size,
+                    min_quant_numel=cfg.min_quant_numel, op="mean")
             return quantized_all_reduce_local(
-                g, axis, n, block_size=cfg.block_size,
+                g, ax, n, block_size=cfg.block_size,
                 min_quant_numel=cfg.min_quant_numel, op="mean")
-        return jax.lax.pmean(g, axis)
+        return jax.lax.pmean(g, ax)
 
     # numerics bitmap (observe pillar 6): per-rank bitmaps differ (each
     # rank sees its own batch shard), so the step bitmap is the exact
-    # bitwise OR across the dp axis — provenance names the earliest
+    # bitwise OR across the data axes — provenance names the earliest
     # poisoned op on ANY rank
     track_bits = "__numerics_bits__" in base_env
 
-    def body(params, feed_shards):
-        key = jax.random.fold_in(rng_key, jax.lax.axis_index(axis))
+    def body(params, feed_shards, ridx):
+        _rank_holder.clear()
+        _rank_holder.append(ridx[0])
+        key = jax.random.fold_in(rng_key, rank_index())
         loss, grads, e_after = local_grads(params, feed_shards, key)
-        loss = jax.lax.pmean(loss, axis)
+        loss = jax.lax.pmean(loss, ax)
         grads = {k: sync_grad(g) for k, g in grads.items()}
         outs = []
         for name in out_names:
@@ -817,27 +906,30 @@ def _dp_sync_value_and_grad(fwd, fwd_ops, sparse_lookups, trainable, env,
             if batchish[name]:
                 outs.append(v)
             elif jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
-                outs.append(jax.lax.pmean(v, axis))
+                outs.append(jax.lax.pmean(v, ax))
             elif jnp.asarray(v).dtype == jnp.bool_:
                 outs.append(jax.lax.pmax(
-                    jnp.asarray(v).astype(jnp.int32), axis) > 0)
+                    jnp.asarray(v).astype(jnp.int32), ax) > 0)
             else:
-                outs.append(jax.lax.pmax(v, axis))
+                outs.append(jax.lax.pmax(v, ax))
         if track_bits:
             from ..observe import numerics as _obs_num
 
             outs.append(_obs_num.or_across_axis(
-                e_after["__numerics_bits__"], axis))
+                e_after["__numerics_bits__"], ax))
         return loss, grads, tuple(outs)
 
+    batch_entry = axes[0] if len(axes) == 1 else tuple(axes)
     out_specs = (P(), P(), tuple(
-        P(axis) if batchish[name] else P() for name in out_names)
+        P(batch_entry) if batchish[name] else P() for name in out_names)
         + ((P(),) if track_bits else ()))
     sm = compat_shard_map(
         body, mesh,
-        in_specs=(P(), {k: P(axis) for k in feeds}),
-        out_specs=out_specs)
-    loss_val, grads, outs = sm(trainable, feeds)
+        in_specs=(P(), {k: P(batch_entry) for k in feeds},
+                  P(batch_entry)),
+        out_specs=out_specs, auto=frozenset(auto))
+    loss_val, grads, outs = sm(trainable, feeds,
+                               jnp.arange(n, dtype=jnp.int32))
     if track_bits:
         env["__numerics_bits__"] = outs[-1]
         outs = outs[:-1]
@@ -1132,7 +1224,8 @@ class Executor:
             from ..observe.memory import _arg_labels
 
             compiled = fn.lower(state, feed_arrays).compile()
-            entry = (compiled, _arg_labels(state, feed_arrays))
+            entry = (compiled,
+                     _arg_labels(state, feed_arrays, compiled=compiled))
             self._aot_cache[key] = entry
         return entry if with_names else entry[0]
 

@@ -691,6 +691,20 @@ def _assemble_index(meta, files, dirname, index):
     return buf
 
 
+def _optimizer_state_names(program) -> set:
+    """Optimizer-state var names of `program` (the ZeRO-sharded
+    population) — same classification as observe.memory's buckets and
+    CompiledProgram's state shardings."""
+    try:
+        from .observe.memory import _program_var_buckets
+
+        _params, opt = _program_var_buckets(program)
+        return opt
+    except Exception:  # noqa: BLE001 — inference programs have no
+        #                optimizer ops; degrade to "nothing is opt state"
+        return set()
+
+
 def load_sharded(executor: Executor, dirname: str,
                  main_program: Optional[Program] = None,
                  vars: Optional[Sequence[Variable]] = None,
@@ -699,7 +713,17 @@ def load_sharded(executor: Executor, dirname: str,
     `sharding_rules`, defaulting to the program's CompiledProgram rules)
     each variable is materialized directly INTO its target
     NamedSharding — every device reads only its own slice.  Without a
-    mesh, arrays load host-side (small-model fallback)."""
+    mesh, arrays load host-side (small-model fallback).
+
+    Mesh-shape-AGNOSTIC (ISSUE 13, gang elasticity): the manifest
+    records each shard's GLOBAL index, and assembly reads whichever
+    saved shards intersect the target slice — so state saved on a dp=8
+    (or fsdp=8) mesh loads onto dp=4, dp=2×mp=2, or a single device
+    with bit-identical logical arrays, re-laid-out under the TARGET
+    sharding.  Optimizer-state vars get the ZeRO axis composed into
+    their target spec exactly as CompiledProgram shards them
+    (state_spec_for), so a shrunken gang's opt-state shards land
+    1/N'-sharded, never accidentally replicated."""
     import jax
     import jax.numpy as jnp
 
@@ -711,10 +735,30 @@ def load_sharded(executor: Executor, dirname: str,
     manifest = _read_manifest(dirname, SHARD_MANIFEST)
     metas = manifest["vars"]
 
-    if mesh is not None and sharding_rules is None:
-        wrapper = getattr(program, "_compiled_wrapper", None)
-        if wrapper is not None:
-            sharding_rules = wrapper._rules
+    wrapper = getattr(program, "_compiled_wrapper", None)
+    spec_fn = None
+    if mesh is not None:
+        if sharding_rules is not None:
+            opt_names = _optimizer_state_names(program)
+
+            def spec_fn(name, shape):
+                if name in opt_names:
+                    return sharding_rules.opt_state_spec_for(
+                        name, shape, mesh)
+                return sharding_rules.spec_for(name, shape, mesh)
+        elif wrapper is not None and wrapper._mesh is mesh:
+            # the wrapper's own spec logic (rules + ZeRO composition)
+            spec_fn = wrapper.state_spec_for
+        elif wrapper is not None and wrapper._rules is not None:
+            # resharding onto a DIFFERENT mesh than the wrapper's:
+            # same rules, target mesh
+            rules = wrapper._rules
+            opt_names = _optimizer_state_names(program)
+
+            def spec_fn(name, shape):
+                if name in opt_names:
+                    return rules.opt_state_spec_for(name, shape, mesh)
+                return rules.spec_for(name, shape, mesh)
 
     scope = global_scope()
     files: dict = {}
@@ -736,8 +780,8 @@ def load_sharded(executor: Executor, dirname: str,
             continue
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if sharding_rules is not None:
-            spec = sharding_rules.spec_for(v.name, meta["shape"], mesh)
+        if spec_fn is not None:
+            spec = spec_fn(v.name, meta["shape"])
         else:
             spec = (None,) * len(meta["shape"])
         sharding = NamedSharding(mesh, P(*spec))

@@ -60,7 +60,8 @@ from .events import (GANG_EVENTS, NUMERICS_EVENTS,  # noqa: F401
 from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401
                      device_memory_budget, export_chrome_trace,
                      format_memory_table, memory_report, memory_table,
-                     memory_timeline, plan_fit, step_mem_breakdown)
+                     memory_timeline, plan_fit, resident_state_bytes,
+                     sharded_memory_report, step_mem_breakdown)
 from .metrics import (TELEMETRY_VAR, StepTelemetry,  # noqa: F401
                       enable_telemetry, fetch_telemetry, init_telemetry,
                       telemetry_enabled)

@@ -339,9 +339,14 @@ def _instr_bucket(op_name: str) -> str:
     return "activations"
 
 
-def _arg_labels(state, feed_arrays) -> List[Tuple[str, str]]:
+def _arg_labels(state, feed_arrays, compiled=None
+                ) -> List[Tuple[str, str]]:
     """Flattened (kind, name) per HLO entry parameter, in jax's pytree
-    leaf order for fn(state, feeds)."""
+    leaf order for fn(state, feeds).  With `compiled`, labels of
+    arguments jax PRUNED from the executable (keep_unused=False drops
+    unused leaves) are filtered out via the executable's kept-var set —
+    otherwise a pruned leaf shifts every later label and memory_report
+    falls back to nameless params."""
     import jax.tree_util as jtu
 
     labels: List[Tuple[str, str]] = []
@@ -350,6 +355,12 @@ def _arg_labels(state, feed_arrays) -> List[Tuple[str, str]]:
         name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                         for p in path[1:])
         labels.append((kind, name))
+    if compiled is not None:
+        try:  # private API; absence degrades to the nameless fallback
+            kept = compiled._executable._kept_var_idx
+            labels = [lb for i, lb in enumerate(labels) if i in kept]
+        except AttributeError:
+            pass
     return labels
 
 
@@ -631,12 +642,56 @@ def format_memory_table(rows: Sequence[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def sharded_memory_report(program, feed=None, fetch_list=None,
+                          scope=None) -> Dict[str, Any]:
+    """memory_report of the SHARDED (post-SPMD) step: buffer
+    accounting of one device's partition of the CompiledProgram
+    executable — `breakdown["optimizer_state"]` here is the PER-DEVICE
+    resident opt-state bytes, the number the fsdp/ZeRO A/B claims
+    drops ~1/N (ISSUE 13).  Requires the program to carry a
+    CompiledProgram wrapper (with_data_parallel)."""
+    wrapper = getattr(program, "_compiled_wrapper", None)
+    if wrapper is None:
+        raise ValueError("sharded_memory_report needs a program "
+                         "compiled with CompiledProgram"
+                         ".with_data_parallel")
+    names = [f.name if hasattr(f, "name") else str(f)
+             for f in (fetch_list or [])]
+    compiled, arg_names = wrapper.compiled_step(
+        dict(feed or {}), names, scope, with_names=True)
+    return memory_report(program=program, compiled=compiled,
+                         arg_names=arg_names)
+
+
+def resident_state_bytes(report: Dict[str, Any],
+                         bucket: str = "optimizer_state") -> int:
+    """Resident bytes of a bucket's ENTRY-PARAMETER allocations in a
+    memory_report — the arrays that must live in HBM for the whole
+    step (accumulators, params), EXCLUDING scope-attributed temps
+    (e.g. the pre-all-gather updated-param shard the ZeRO update
+    materializes inside the adam scope).  This is the
+    `opt_state_bytes_per_device` number the fsdp A/B tracks: on a
+    sharded compile it is exactly the per-device accumulator
+    footprint, 1/N under ZeRO."""
+    return sum(r["bytes"] for r in report["rows"]
+               if r["bucket"] == bucket and r["opcode"] == "parameter")
+
+
 def step_mem_breakdown(program=None, feed=None, fetch_list=None,
                        scope=None, exe=None) -> Dict[str, Any]:
     """The one-dict summary bench.py entries carry: per-bucket byte
-    sums + peak_bytes + source."""
-    rep = memory_report(program, feed=feed, fetch_list=fetch_list,
-                        scope=scope, exe=exe)
+    sums + peak_bytes + source.  A program compiled over a REAL
+    (multi-device) mesh reports its SHARDED step's per-device buffer
+    assignment — the number that must fit each chip — instead of the
+    unsharded single-device twin's."""
+    wrapper = getattr(program, "_compiled_wrapper", None)
+    if wrapper is not None and wrapper._mesh is not None \
+            and wrapper._mesh.devices.size > 1:
+        rep = sharded_memory_report(program, feed=feed,
+                                    fetch_list=fetch_list, scope=scope)
+    else:
+        rep = memory_report(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope, exe=exe)
     out = dict(rep["breakdown"])
     out["source"] = rep["source"]
     return out

@@ -18,11 +18,19 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def compat_shard_map(fn, mesh, in_specs, out_specs, check=False):
+def compat_shard_map(fn, mesh, in_specs, out_specs, check=False,
+                     auto=frozenset()):
     """shard_map with two jax API drifts smoothed over: the import
     location (jax.shard_map vs jax.experimental.shard_map) and the
     replication-check kwarg rename (check_rep -> check_vma).  `check`
-    feeds whichever kwarg this jax has."""
+    feeds whichever kwarg this jax has.
+
+    `auto`: mesh axes left to GSPMD (partial-auto shard_map) — the
+    composed grad-sync path maps manually over the data axes while mp
+    stays auto-partitioned.  CAUTION: only psum-family collectives
+    (psum/pmean/pmax) survive partial-auto on this XLA; all_gather /
+    all_to_all hard-abort the SPMD partitioner (the reason
+    quantized_all_reduce_psum exists)."""
     import inspect
 
     try:
@@ -30,10 +38,17 @@ def compat_shard_map(fn, mesh, in_specs, out_specs, check=False):
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    kw = ("check_vma" if "check_vma" in
-          inspect.signature(shard_map).parameters else "check_rep")
+    params = inspect.signature(shard_map).parameters
+    kw = {("check_vma" if "check_vma" in params else "check_rep"):
+          check}
+    if auto:
+        if "auto" not in params:
+            raise NotImplementedError(
+                "this jax's shard_map has no partial-auto support; "
+                "composed-mesh grad sync needs it")
+        kw["auto"] = frozenset(auto)
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                     out_specs=out_specs, **{kw: check})
+                     out_specs=out_specs, **kw)
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -230,6 +245,71 @@ def quantized_all_reduce_local(x, axis: str, n_ranks: int,
     q2, s2 = quantize_blockwise(reduced, block_size)
     q2 = jax.lax.all_gather(q2, axis, axis=0, tiled=True)
     s2 = jax.lax.all_gather(s2, axis, axis=0, tiled=True)
+    out = dequantize_blockwise(q2, s2).reshape(-1)
+    if pad:
+        out = out[:size]
+    return (out * inv).reshape(x.shape).astype(orig_dtype)
+
+
+def quantized_all_reduce_psum(x, axes, n_ranks: int, rank_index,
+                              block_size: int = DEFAULT_QUANT_BLOCK,
+                              min_quant_numel: int = DEFAULT_QUANT_FLOOR,
+                              op: str = "mean"):
+    """The EQuARX two-phase exchange in its psum-only form — for
+    shard_map regions where all_to_all/all_gather cannot lower (a
+    partial-auto region with GSPMD-owned axes, or a multi-axis data
+    group): SAME quantization steps, SAME error model, but the data
+    movement is a single psum.
+
+      phase 1: quantize every chunk per block (identical bytes to the
+        wire path), dequantize locally, psum over `axes` — every rank
+        now holds every reduced chunk (the wire path's rank i holds
+        only chunk i);
+      phase 2: re-quantize ALL reduced chunks (rank i's chunk i
+        quantizes identically on every rank — same input bytes, same
+        rint), dequantize.  No gather needed: the phase-2 result is
+        already replicated, bitwise-identically, everywhere.
+
+    Determinism: quantization is value-deterministic and psum produces
+    bitwise-identical results on every participating rank, so all
+    ranks agree bitwise — the dp grad-sync invariant.  `rank_index` is
+    accepted for signature symmetry with a future chunk-local variant
+    and unused (every rank computes all chunks).
+
+    Byte honesty: this form moves f32 psum bytes, not int8 payloads —
+    the numerics/error-model guarantees hold, the wire-byte saving
+    does NOT (docs/DIST.md §hybrid).  Pure single-axis dp keeps the
+    real all_to_all/all_gather exchange."""
+    del rank_index
+    if op not in ("sum", "mean"):
+        raise ValueError(f"unknown reduce op {op!r}")
+    inv = 1.0 / n_ranks if op == "mean" else 1.0
+
+    def exact(v):
+        r = jax.lax.psum(v, axes)
+        return r * jnp.asarray(inv, r.dtype) if op == "mean" else r
+
+    size = _numel(x.shape)
+    if (not jnp.issubdtype(x.dtype, jnp.floating)
+            or size < max(min_quant_numel, n_ranks * block_size)):
+        return exact(x)
+
+    orig_dtype = x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-size) % (n_ranks * block_size)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n_ranks, -1, block_size)
+
+    # phase 1: quantize outgoing chunks, reduce via psum of the
+    # dequantized payloads (numerically the wire path's fixed-order
+    # rank sum up to all-reduce ordering; bitwise-identical everywhere)
+    q, scales = quantize_blockwise(chunks, block_size)
+    reduced = jax.lax.psum(dequantize_blockwise(q, scales), axes)
+
+    # phase 2: re-quantize the reduced chunks — replicated input bytes
+    # make the rounding identical on every rank, so no gather is needed
+    q2, s2 = quantize_blockwise(reduced, block_size)
     out = dequantize_blockwise(q2, s2).reshape(-1)
     if pad:
         out = out[:size]

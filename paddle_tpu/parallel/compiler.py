@@ -81,6 +81,7 @@ class CompiledProgram:
         self._accum_steps = 1
         self._pp_microbatches = 0
         self._aot_cache: Dict[Any, Any] = {}
+        self._opt_names = None  # lazy: optimizer-state var names
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -113,6 +114,34 @@ class CompiledProgram:
         return self
 
     # -- shardings -------------------------------------------------------
+    def _optimizer_state_names(self) -> set:
+        """Names of the program's optimizer-state vars (accumulators,
+        pow counters, the lr var) — the set the ZeRO axis shards.  Uses
+        the same op-slot classification as observe.memory's buckets so
+        the sharded bytes and the reported optimizer_state bucket are
+        the SAME population."""
+        if self._opt_names is None:
+            from ..observe.memory import _program_var_buckets
+
+            _params, opt = _program_var_buckets(self._program)
+            self._opt_names = opt
+        return self._opt_names
+
+    def state_spec_for(self, name: str, shape) -> tuple:
+        """The PartitionSpec dims this wrapper assigns to a STATE var:
+        the rule spec, with the ZeRO axis composed in for
+        optimizer-state vars (strategies.opt_state_spec_for).  Public
+        because io.load_sharded reshards checkpoints into exactly these
+        specs (mesh-shape-agnostic load)."""
+        if name in self._optimizer_state_names():
+            return self._rules.opt_state_spec_for(name, shape,
+                                                  self._mesh)
+        return self._rules.spec_for(name, shape, self._mesh)
+
+    def data_axes(self) -> tuple:
+        """Mesh axes the batch shards over (batch axis + fsdp/ZeRO)."""
+        return self._rules.data_axes_for(self._mesh, self._batch_axis)
+
     def _state_sharding(self, name: str, value):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -123,7 +152,7 @@ class CompiledProgram:
             # the telemetry accumulator is a dict pytree of scalars: a
             # single replicated sharding acts as a pytree prefix
             return NamedSharding(self._mesh, P())
-        spec = self._rules.spec_for(name, np.shape(value), self._mesh)
+        spec = self.state_spec_for(name, np.shape(value))
         return NamedSharding(self._mesh, P(*spec))
 
     def _feed_sharding(self, name, value):
@@ -167,7 +196,8 @@ class CompiledProgram:
                                   iterations=iterations).as_text()
 
     def compiled_step(self, feed: Dict[str, Any], fetch_names=(),
-                      scope=None, iterations: int = 1):
+                      scope=None, iterations: int = 1,
+                      with_names: bool = False):
         """AOT-compile the SHARDED step and return the jax Compiled
         object — the multi-device analog of Executor.compiled_step.
         This is what the dp bench's comm accounting reads: the
@@ -176,7 +206,14 @@ class CompiledProgram:
         reduce-scatter/all-to-all/collective-permute), so
         `comm_bytes` comes from the SAME analytic accounting as every
         other bucket.  Memoized per (feed signature, fetches,
-        iterations) — bench's comm fields reuse one compile."""
+        iterations) — bench's comm fields reuse one compile.
+
+        with_names=True returns (compiled, arg_names) like
+        Executor.compiled_step: the per-entry-parameter
+        ("state"|"feed", var_name) labels observe.memory uses to
+        attribute PER-DEVICE buffer bytes to named state vars — how
+        the fsdp A/B proves opt-state bytes actually dropped on the
+        sharded step."""
         from ..core.executor import global_scope
 
         fn, state, feed_arrays, _, _ = self._prepare_step(
@@ -187,11 +224,15 @@ class CompiledProgram:
                tuple((n, tuple(getattr(v, "shape", ()) or ()),
                       str(getattr(v, "dtype", type(v).__name__)))
                      for n, v in sorted(feed_arrays.items())))
-        compiled = self._aot_cache.get(key)
-        if compiled is None:
+        entry = self._aot_cache.get(key)
+        if entry is None:
+            from ..observe.memory import _arg_labels
+
             compiled = fn.lower(state, feed_arrays).compile()
-            self._aot_cache[key] = compiled
-        return compiled
+            entry = (compiled,
+                     _arg_labels(state, feed_arrays, compiled=compiled))
+            self._aot_cache[key] = entry
+        return entry if with_names else entry[0]
 
     def _prepare_step(self, feed, fetch_names, scope, iterations,
                       accumulation_steps):
@@ -291,6 +332,13 @@ class CompiledProgram:
             fn = jax.jit(
                 chain_iterations(step, iterations),
                 in_shardings=(state_shardings, feed_shardings),
+                # pin the updated state to the SAME shardings it came
+                # in with: without this XLA may infer a different
+                # (replicated) output layout for ZeRO-sharded optimizer
+                # state, which silently breaks donation — per-device
+                # opt-state bytes then DOUBLE (input + undonated
+                # output) and an all-gather sneaks into every step
+                out_shardings=(state_shardings, None),
                 donate_argnums=(0,),
             )
             entry = (fn, state_shardings, feed_shardings)

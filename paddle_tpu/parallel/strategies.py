@@ -32,10 +32,15 @@ class GradSyncConfig:
     The default (no GradSyncConfig) keeps the implicit GSPMD all-reduce
     inserted from sharding annotations alone.
 
-    Restriction (designed, loud): explicit grad sync supports pure-dp
-    meshes — on dp×mp/dp×pp meshes params entering the exchange
-    shard_map would be all-gathered, silently un-sharding the model;
-    the executor raises instead (core/executor.py)."""
+    Composition (ISSUE 13): the explicit exchange spans the mesh's
+    DATA axes (batch axis + fsdp/ZeRO axis) and composes with
+    mp/ep-sharded params via partial-auto shard_map — on composed
+    meshes int8 rides the psum-form exchange
+    (collectives.quantized_all_reduce_psum; same quantization and
+    error model, wire-byte saving modeled only).  The one remaining
+    designed restriction: params sharded over a DATA axis (ZeRO-3
+    style) raise loudly — the replicated param entry would silently
+    all-gather the model (core/executor.py)."""
 
     MODES = ("bf16", "int8")
 
@@ -71,14 +76,27 @@ class ShardingRules:
     (None, "mp") shards dim 1 over the "mp" axis.  `default` applies to
     unmatched params (None = replicated; "fsdp" = shard dim 0 over the
     given axis when divisible).
+
+    zero_axis (ISSUE 13, ZeRO-style hybrid parallelism): the mesh axis
+    OPTIMIZER STATE shards over, composed on top of whatever spec the
+    rules produce (`opt_state_spec_for`) — per-device opt-state bytes
+    drop ~1/N while params stay wherever their own rules put them
+    (replicated for pure dp/fsdp, mp-sharded under Megatron rules).
+    Inert on meshes without the axis, so the default ("fsdp") makes
+    `make_mesh({"dp": ..., "fsdp": ...})` — or a pure {"fsdp": N}
+    mesh — ZeRO-1 without any rule changes.  The axis is a DATA axis:
+    the batch additionally shards over it (`data_axes_for`), so fsdp=N
+    behaves like dp=N plus 1/N opt state.
     """
 
     def __init__(self, rules: Optional[Sequence[Tuple[str, tuple]]] = None,
                  default: Optional[str] = None,
-                 fsdp_axis: str = "dp"):
+                 fsdp_axis: str = "dp",
+                 zero_axis: Optional[str] = "fsdp"):
         self.rules = [(re.compile(p), spec) for p, spec in (rules or [])]
         self.default = default
         self.fsdp_axis = fsdp_axis
+        self.zero_axis = zero_axis
 
     def spec_for(self, name: str, shape, mesh) -> tuple:
         for pat, spec in self.rules:
@@ -93,11 +111,41 @@ class ShardingRules:
                     return tuple(spec)
         return (None,) * len(shape)
 
+    def data_axes_for(self, mesh, batch_axis: str = "dp") -> tuple:
+        """The mesh axes that carry DATA parallelism: the batch axis
+        plus the ZeRO axis when present (fsdp is dp with sharded
+        optimizer state — the batch shards over both).  Order is the
+        mesh's axis order so rank linearization is deterministic."""
+        wanted = {batch_axis}
+        if self.zero_axis is not None:
+            wanted.add(self.zero_axis)
+        return tuple(a for a in mesh.shape
+                     if a in wanted and mesh.shape[a] > 1)
+
+    def opt_state_spec_for(self, name: str, shape, mesh) -> tuple:
+        """PartitionSpec dims for an OPTIMIZER-STATE var (moments,
+        velocities, …): the rule-derived spec with `zero_axis` composed
+        onto the first unsharded divisible dim (ZeRO-1).  Accumulators
+        named `<param>.<acc>` match their param's rule, so an
+        mp-sharded param's moments stay mp-sharded AND additionally
+        shard over the zero axis when a dim allows it."""
+        spec = list(self.spec_for(name, shape, mesh))
+        za = self.zero_axis
+        if za is None or mesh.shape.get(za, 1) <= 1:
+            return tuple(spec)
+        n = mesh.shape[za]
+        for dim, (d, ax) in enumerate(zip(shape, spec)):
+            if ax is None and d >= n and d % n == 0:
+                spec[dim] = za
+                break
+        return tuple(spec)
+
     def feed_spec_for(self, name: str, shape, mesh,
                       batch_axis: str = "dp") -> tuple:
-        """PartitionSpec dims for a FEED (the data axis of the mesh):
-        dim 0 shards over `batch_axis` when the mesh has it and the
-        batch divides — GSPMD then partitions the whole forward by
+        """PartitionSpec dims for a FEED (the data axes of the mesh):
+        dim 0 shards over `batch_axis` — plus the ZeRO/fsdp axis when
+        the mesh has one (data_axes_for) — when the batch divides the
+        combined degree.  GSPMD then partitions the whole forward by
         batch and inserts the gradient all-reduce implicitly (the
         ParallelExecutor AllReduce mode).  An explicit rule matching
         the feed name wins, so ragged companions or non-batch-major
@@ -107,8 +155,18 @@ class ShardingRules:
         for pat, spec in self.rules:
             if pat.search(name):
                 return self._validate(spec, shape, mesh)
+        axes = self.data_axes_for(mesh, batch_axis)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        if (n > 1 and len(shape) >= 1 and shape[0] > 0
+                and shape[0] % n == 0):
+            first = axes[0] if len(axes) == 1 else tuple(axes)
+            return (first,) + (None,) * (len(shape) - 1)
+        # the combined degree does not divide: fall back to the batch
+        # axis alone (the dp speedup survives an fsdp-indivisible batch)
         dp = mesh.shape.get(batch_axis, 1)
-        if (dp > 1 and len(shape) >= 1 and shape[0] > 0
+        if (dp > 1 and n != dp and len(shape) >= 1 and shape[0] > 0
                 and shape[0] % dp == 0):
             return (batch_axis,) + (None,) * (len(shape) - 1)
         return (None,) * len(shape)

@@ -109,6 +109,17 @@ class Supervisor:
         `env` overrides.
     log_dir: when set, rank stdout/stderr go to
         `<log_dir>/attempt<k>_rank<r>.out/.err` (default: inherited).
+    elastic: relaunch a broken gang at the SURVIVING world size
+        (ISSUE 13, gang elasticity): ranks that died BY SIGNAL
+        (SIGKILL, OOM — the machine-lost signature) are treated as
+        lost capacity and the next attempt spawns
+        `num_workers - lost` ranks (floor 1); deliberate exits
+        (peer_lost 43, preempt 77, crashes) relaunch at full size —
+        the process died, not the machine.  Workers read the new
+        world size from PADDLE_TRAINERS and are expected to reshard
+        their state from checkpoints (io.load_sharded is
+        mesh-shape-agnostic).  Each shrink is recorded in the attempt
+        dict (`shrunk_to`).
     host_coordinator: host the jax coordination SERVICE in the
         supervisor process (one fresh service per attempt) instead of
         inside worker rank 0.  This makes EVERY rank killable with
@@ -133,6 +144,7 @@ class Supervisor:
                  log_dir: Optional[str] = None,
                  coordinator_host: str = "127.0.0.1",
                  host_coordinator: bool = False,
+                 elastic: bool = False,
                  poll_s: float = 0.2,
                  sleep: Callable[[float], None] = time.sleep,
                  event_log=None):
@@ -156,6 +168,7 @@ class Supervisor:
         self.log_dir = log_dir
         self.coordinator_host = coordinator_host
         self.host_coordinator = bool(host_coordinator)
+        self.elastic = bool(elastic)
         self.poll_s = float(poll_s)
         self.sleep = sleep
         self.event_log = event_log
@@ -306,6 +319,16 @@ class Supervisor:
                        "classified": {r: classify_exit(rc)
                                       for r, rc in sorted(codes.items())},
                        "reason": reason}
+                if self.elastic and reason != "ok":
+                    # signal deaths = lost capacity (preempted machine);
+                    # the next attempt runs with the survivors only and
+                    # workers reshard their checkpoints to the new size
+                    lost = [r for r, rc in codes.items()
+                            if classify_exit(rc).startswith("signal")]
+                    new_n = max(1, self.num_workers - len(lost))
+                    if new_n != self.num_workers:
+                        rec["shrunk_to"] = new_n
+                        self.num_workers = new_n
                 attempts.append(rec)
                 if self.event_log is not None:
                     self.event_log.event(

@@ -675,6 +675,83 @@ def run_gang_sigkill_chaos(tmp_path):
             "detect_age_s": age, "wall_s": round(elapsed, 1)}
 
 
+ELASTIC_WORKER = os.path.join(HERE, "elastic_worker.py")
+
+
+def _elastic_cmd(d):
+    return [sys.executable, ELASTIC_WORKER,
+            "--ckpt-root", os.path.join(d, "ck"),
+            "--out-root", os.path.join(d, "out"),
+            "--log-root", os.path.join(d, "log"),
+            "--epochs", str(EPOCHS), "--pace-s", "0.12"]
+
+
+def run_elastic_reshard_chaos(tmp_path):
+    """Gang elasticity (ISSUE 13): SIGKILL rank 1 mid-train; the
+    elastic supervisor relaunches at the SURVIVING world size (1), the
+    worker sizes its mesh from PADDLE_TRAINERS (fsdp=4 -> fsdp=2) and
+    io.load_sharded reshards the fsdp=4-saved checkpoint — ZeRO-sharded
+    Momentum state included — onto the smaller mesh.  The resumed run
+    must converge to the uninterrupted control's loss/params (float
+    reduction tolerance: steps after the resume point run on a
+    different mesh size)."""
+    import random
+
+    rng = random.Random(os.urandom(8))
+    kill_at = rng.randrange(4, (EPOCHS * STEPS_PER_EPOCH * 3) // 4)
+
+    dc = os.path.join(tmp_path, "ectl")
+    sup_c = Supervisor(_elastic_cmd(dc), 2, max_restarts=0, grace_s=8.0,
+                       env=_gang_env(), host_coordinator=True,
+                       log_dir=os.path.join(dc, "sup"))
+    assert sup_c.run().ok
+
+    dv = os.path.join(tmp_path, "echaos")
+    env = _gang_env()
+    chaos.arm_kill_rank_env(env, rank=1, at_step=kill_at,
+                            once_file=os.path.join(tmp_path,
+                                                   "ekilled.flag"))
+    sup = Supervisor(_elastic_cmd(dv), 2, max_restarts=2, grace_s=8.0,
+                     backoff_base_s=0.2, env=env, host_coordinator=True,
+                     elastic=True, log_dir=os.path.join(dv, "sup"))
+    result = sup.run()
+
+    assert result.ok and result.restarts == 1, result.as_dict()
+    a0 = result.attempts[0]
+    assert a0["classified"][1] == "signal:SIGKILL", a0
+    assert a0["exit_codes"][0] == PEER_LOST_EXIT_CODE, a0
+    # the elastic shrink is recorded and the relaunch ran ONE rank
+    assert a0["shrunk_to"] == 1, a0
+    assert sorted(result.attempts[1]["exit_codes"]) == [0], result.attempts
+
+    # the relaunched rank 0 really resumed mid-run on the SMALLER mesh
+    out1 = open(os.path.join(dv, "sup", "attempt1_rank0.out")).read()
+    mesh_line = [ln for ln in out1.splitlines()
+                 if ln.startswith("MESH ")][0]
+    assert "fsdp=2 world=1" in mesh_line, mesh_line
+    assert "resume_epoch=0 resume_step=0" not in mesh_line, \
+        f"relaunch started FRESH instead of resuming: {mesh_line}"
+
+    # convergence: final loss + params match the uninterrupted control
+    # within float-reduction tolerance (mesh size changed mid-run)
+    ctl = np.load(os.path.join(dc, "out", "rank0.npz"))
+    got = np.load(os.path.join(dv, "out", "rank0.npz"))
+    ctl_loss = float(ctl["__final_loss__"])
+    got_loss = float(got["__final_loss__"])
+    assert abs(got_loss - ctl_loss) <= 1e-4 * max(abs(ctl_loss), 1e-6), \
+        (got_loss, ctl_loss)
+    for k in ctl.files:
+        if k == "__final_loss__":
+            continue
+        np.testing.assert_allclose(
+            got[k], ctl[k], rtol=1e-4, atol=1e-6,
+            err_msg=f"{k} diverged after the elastic reshard resume")
+    _assert_no_orphans(tmp_path)
+    return {"kill_at": kill_at, "ctl_loss": round(ctl_loss, 6),
+            "resumed_loss": round(got_loss, 6),
+            "shrunk_to": a0["shrunk_to"]}
+
+
 def run_barrier_poison_chaos(tmp_path):
     """A rank already WAITING in a checkpoint barrier when a peer
     poisons the gang and dies must abort in seconds (vs the 120 s
@@ -716,8 +793,41 @@ def test_barrier_with_poisoned_peer_fails_bounded(tmp_path):
     print("barrier poison chaos:", info)
 
 
+@pytest.mark.slow
+def test_elastic_gang_shrinks_and_reshards(tmp_path):
+    info = run_elastic_reshard_chaos(str(tmp_path))
+    print("elastic reshard chaos:", info)
+
+
+def test_supervisor_elastic_shrinks_to_survivors(tmp_path):
+    """Unit (fast, jax-free): an elastic supervisor relaunches a gang
+    whose rank died BY SIGNAL at the surviving world size, and the
+    shrink is recorded on the attempt.  Deliberate exits do not
+    shrink."""
+    marker = os.path.join(str(tmp_path), "attempt2.flag")
+    script = (
+        "import os, signal, sys\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS'])\n"
+        f"marker = {marker!r}\n"
+        "if world == 2:\n"
+        "    if rank == 1:\n"
+        "        os.kill(os.getpid(), signal.SIGKILL)\n"
+        "    sys.exit(43)\n"          # survivor: deliberate peer-lost
+        "open(marker, 'w').write(str(world))\n"
+        "sys.exit(0)\n")
+    sup = Supervisor([sys.executable, "-c", script], 2, max_restarts=2,
+                     grace_s=2.0, backoff_base_s=0.0, elastic=True,
+                     poll_s=0.05)
+    result = sup.run()
+    assert result.ok and result.restarts == 1, result.as_dict()
+    assert result.attempts[0]["shrunk_to"] == 1, result.attempts
+    assert list(result.attempts[1]["exit_codes"]) == [0]
+    assert open(marker).read() == "1"  # relaunched at world size 1
+
+
 if __name__ == "__main__":
-    # run_ci.sh gang-chaos smoke: both subprocess scenarios, no pytest
+    # run_ci.sh gang-chaos smoke: the subprocess scenarios, no pytest
     import argparse
     import tempfile
 
@@ -728,4 +838,5 @@ if __name__ == "__main__":
     d = tempfile.mkdtemp(prefix="gang_smoke_")
     info = run_gang_sigkill_chaos(d)
     info2 = run_barrier_poison_chaos(d)
-    print("gang-chaos smoke OK:", {**info, **info2})
+    info3 = run_elastic_reshard_chaos(d)
+    print("gang-chaos smoke OK:", {**info, **info2, **info3})

@@ -223,9 +223,32 @@ def test_grad_sync_partial_batch_falls_back_exact():
     assert np.isfinite(int8).all() and len(int8) == 4
 
 
-def test_grad_sync_rejects_composed_mesh():
-    with pytest.raises(ValueError, match="pure data-parallel"):
-        _run("int8", {"dp": N_DEV // 2, "mp": 2})
+def test_grad_sync_rejects_params_sharded_over_data_axis():
+    """ISSUE 13 moved the composition line: dp×mp / dp×fsdp meshes now
+    TRAIN under explicit grad sync (tests/test_hybrid_parallel.py); the
+    one remaining designed error is ZeRO-3-style param sharding over a
+    DATA axis — the replicated param entry would silently all-gather
+    the model every step."""
+
+    def run_zero3():
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), fluid.unique_name.guard():
+            loss = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            bs = fluid.BuildStrategy()
+            bs.grad_sync = "int8"
+            # params sharded over the batch axis (the Reduce strategy)
+            bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+            fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs,
+                mesh=make_mesh({"dp": N_DEV}))
+            exe.run(main, feed=_batches(1)[0], fetch_list=[loss])
+
+    with pytest.raises(ValueError, match="sharded over the data ax"):
+        run_zero3()
 
 
 def test_grad_sync_rejects_gradient_accumulation():
@@ -334,6 +357,9 @@ def _dp_entry(**over):
     e = {"mfu": 0.3, "tokens_per_sec": 1000.0,
          "per_device_tokens_per_sec": 125.0, "mesh": {"dp": 8},
          "n_devices": 8, "grad_sync": None, "comm_bytes": 5.0e8,
+         # hybrid-parallel contract (ISSUE 13): every mesh entry
+         # carries the sharded step's per-device opt-state bytes
+         "opt_state_bytes_per_device": 2.0e8,
          "last_loss": 1.0, "ckpt_blocking_ms": 1.0,
          # numerics observability contract (ISSUE 11): training
          # entries carry the window's grad norm + worst update ratio
@@ -349,10 +375,14 @@ def test_perf_gate_schema_requires_dp_keys():
     assert pg.check_schema(line) == []
     broken = _dp_entry()
     del broken["comm_bytes"], broken["per_device_tokens_per_sec"]
+    del broken["opt_state_bytes_per_device"]
+    broken["mesh"] = {}
     line["detail"] = {"transformer_dp8": broken}
     errs = pg.check_schema(line)
     assert any("comm_bytes" in e for e in errs)
     assert any("per_device_" in e for e in errs)
+    assert any("opt_state_bytes_per_device" in e for e in errs)
+    assert any("non-empty axis->size dict" in e for e in errs)
 
 
 def test_perf_gate_catches_per_device_and_comm_regressions():

@@ -1,0 +1,460 @@
+"""Hybrid-parallel scale-out (ISSUE 13): the fsdp/ZeRO axis, composable
+dp×mp / dp×fsdp grad sync, and mesh-shape-agnostic checkpoint
+resharding.
+
+Acceptance pins:
+- ZeRO memory: per-device resident optimizer-state bytes
+  (observe.resident_state_bytes over the SHARDED compile) drop >=1.7x
+  at fsdp=2 and scale ~N/1 at fsdp=4/8;
+- dp×mp loss parity vs the single-device twin <=1e-5 (the
+  test_grad_sync acceptance pattern) with Megatron-sharded params, for
+  the implicit GSPMD path AND the explicit bf16 exchange; int8 on the
+  composed mesh is bitwise-deterministic and within the documented
+  1e-2 of bf16;
+- dp×fsdp: the explicit exchange spans BOTH data axes;
+- reshard-on-load: a checkpoint saved on a dp=8 mesh loads onto dp=4
+  and dp=2×mp=2 with bit-identical LOGICAL params; ZeRO-sharded opt
+  state saved at fsdp=8 reassembles bit-identically at fsdp=4 and
+  actually lands sharded;
+- feed/data-axis spec rules for fsdp meshes.
+
+All models deliberately tiny (8 virtual devices share one host core).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.parallel import GradSyncConfig, make_mesh
+from paddle_tpu.parallel.strategies import ShardingRules
+
+N_DEV = 8
+STEPS = 5
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _need_devices():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+
+
+def _mp_rules():
+    # the Megatron pairing: ffn_in column-parallel, ffn_out row-parallel
+    return ShardingRules(rules=[
+        (r"ffn_in\S*\.w", (None, "mp")),
+        (r"ffn_out\S*\.w", ("mp", None)),
+    ])
+
+
+def _build(optimizer="momentum"):
+    x = layers.data("x", shape=[32], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=128, act="relu", name="ffn_in")
+    pred = layers.fc(h, size=1, name="ffn_out")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    if optimizer == "adam":
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    else:
+        fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _batches(n=STEPS, b=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(b, 32).astype(np.float32),
+             "y": rng.randn(b, 1).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _run(mesh_axes, grad_sync=None, rules=None, optimizer="momentum",
+         batches=None, want_scope=False):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = _build(optimizer)
+        exe = fluid.Executor()
+        exe.run(startup)
+        if mesh_axes:
+            bs = fluid.BuildStrategy()
+            bs.grad_sync = grad_sync
+            if rules is not None:
+                bs.sharding_rules = rules
+            fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs,
+                mesh=make_mesh(mesh_axes))
+        losses = []
+        for b in (batches or _batches()):
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return np.asarray(losses), scope
+
+
+# -- ZeRO optimizer-state sharding ----------------------------------------
+
+def _opt_bytes(mesh_axes):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = _build("adam")
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=make_mesh(mesh_axes))
+        feed = _batches(1)[0]
+        exe.run(main, feed=feed, fetch_list=[loss])
+        rep = observe.sharded_memory_report(
+            main, feed=feed, fetch_list=[loss], scope=scope)
+    return (observe.resident_state_bytes(rep),
+            observe.resident_state_bytes(rep, bucket="params"))
+
+
+def test_fsdp_opt_state_bytes_drop_and_scale():
+    """ACCEPTANCE: per-device resident opt-state bytes drop >=1.7x at
+    fsdp=2 vs pure dp, and scale ~N/1 at fsdp=4/8 (the ZeRO claim,
+    proven chip-free from the sharded compile's buffer assignment).
+    Params stay replicated — ZeRO-1 shards ONLY the accumulators."""
+    base, base_params = _opt_bytes({"dp": 2})
+    by_n = {}
+    for n in (2, 4, 8):
+        got, params = _opt_bytes({"fsdp": n})
+        by_n[n] = got
+        assert params == base_params, (params, base_params)
+    assert base / by_n[2] >= 1.7, (base, by_n)
+    for n in (4, 8):
+        # ~N/1: the big accumulators shard exactly 1/N; only the tiny
+        # pow counters/lr stay replicated, so allow 25% slack
+        assert base / by_n[n] >= n * 0.75, (n, base, by_n)
+    assert by_n[4] < by_n[2] and by_n[8] < by_n[4], by_n
+
+
+def test_zero_spec_composition():
+    """opt_state_spec_for composes the zero axis onto rule specs: an
+    mp-sharded accumulator keeps its mp dim and gains fsdp on the
+    first free divisible dim; indivisible/scalar state replicates."""
+    mesh = make_mesh({"fsdp": 2, "mp": 2})
+    rules = _mp_rules()
+    assert rules.opt_state_spec_for(
+        "ffn_in.w_0.velocity", (32, 128), mesh) == ("fsdp", "mp")
+    assert rules.opt_state_spec_for(
+        "ffn_in.b_0.velocity", (128,), mesh) == ("fsdp",)
+    assert rules.opt_state_spec_for(
+        "ffn_in.w_0.beta1_pow_acc", (1,), mesh) == (None,)
+    # no fsdp axis in the mesh -> inert
+    mesh_dp = make_mesh({"dp": 8})
+    assert rules.opt_state_spec_for(
+        "ffn_in.b_0.velocity", (128,), mesh_dp) == (None,)
+
+
+def test_data_axes_and_feed_specs():
+    rules = ShardingRules()
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "mp": 2})
+    assert rules.data_axes_for(mesh, "dp") == ("dp", "fsdp")
+    # feed dim0 shards over BOTH data axes when the batch divides
+    assert rules.feed_spec_for("x", (8, 4), mesh) == \
+        (("dp", "fsdp"), None)
+    # divides dp but not dp*fsdp -> dp alone keeps the speedup
+    assert rules.feed_spec_for("x", (6, 4), mesh) == ("dp", None)
+    # divides nothing -> replicated
+    assert rules.feed_spec_for("x", (3, 4), mesh) == (None, None)
+    # pure-fsdp mesh: fsdp IS the data axis
+    mesh_f = make_mesh({"fsdp": 4})
+    assert rules.data_axes_for(mesh_f, "dp") == ("fsdp",)
+    assert rules.feed_spec_for("x", (8, 4), mesh_f) == ("fsdp", None)
+
+
+def test_fsdp_loss_parity_vs_single_device():
+    """fsdp=8 (implicit GSPMD, ZeRO opt state) matches the
+    single-device twin at a fixed global batch — the dp parity
+    acceptance bar extended to the new axis."""
+    single, _ = _run(None)
+    fsdp, scope = _run({"fsdp": N_DEV})
+    np.testing.assert_allclose(fsdp, single, rtol=1e-5, atol=1e-7)
+    # and the opt state really is sharded on-device
+    vel = next(k for k in scope.vars if k.endswith(".velocity")
+               and np.ndim(scope.find_var(k)) == 2)
+    v = scope.find_var(vel)
+    shapes = {s.data.shape for s in v.addressable_shards}
+    assert shapes == {(v.shape[0] // N_DEV, v.shape[1])}, shapes
+
+
+# -- composable explicit grad sync ----------------------------------------
+
+def test_dpxmp_loss_parity_vs_single_device():
+    """ACCEPTANCE: dp=4×mp=2 with Megatron-sharded params — implicit
+    GSPMD and the explicit bf16 exchange (partial-auto shard_map over
+    dp, mp left to GSPMD) both pin <=1e-5 vs the single-device twin."""
+    single, _ = _run(None)
+    implicit, _ = _run({"dp": 4, "mp": 2}, rules=_mp_rules())
+    np.testing.assert_allclose(implicit, single, rtol=1e-5, atol=1e-7)
+    bf16, _ = _run({"dp": 4, "mp": 2}, grad_sync="bf16",
+                   rules=_mp_rules())
+    np.testing.assert_allclose(bf16, single, rtol=1e-5, atol=1e-7)
+
+
+def test_dpxmp_int8_deterministic_and_within_tolerance():
+    """ACCEPTANCE: int8 grad sync on the composed mesh (psum-form
+    exchange) is bitwise-deterministic run-to-run, actually quantizes,
+    descends, and stays within the documented 1e-2 of the bf16 control
+    arm."""
+    cfg = GradSyncConfig("int8", min_quant_numel=1)
+    a, _ = _run({"dp": 4, "mp": 2}, grad_sync=cfg, rules=_mp_rules())
+    b, _ = _run({"dp": 4, "mp": 2}, grad_sync=cfg, rules=_mp_rules())
+    assert np.array_equal(a, b), "int8 on dp×mp not deterministic"
+    bf16, _ = _run({"dp": 4, "mp": 2}, grad_sync="bf16",
+                   rules=_mp_rules())
+    assert not np.array_equal(a, bf16), \
+        "quantization inactive — the A/B would compare the exchange " \
+        "to itself"
+    rel = np.abs(a - bf16) / np.maximum(np.abs(bf16), 1e-6)
+    assert rel.max() < 1e-2, rel
+    assert np.isfinite(a).all()
+
+
+def test_dpxfsdp_explicit_sync_spans_both_axes():
+    """dp=4×fsdp=2: the explicit exchange maps over BOTH data axes
+    (bf16 parity vs single device) and int8 rides the psum-form
+    exchange deterministically."""
+    single, _ = _run(None)
+    bf16, _ = _run({"dp": 4, "fsdp": 2}, grad_sync="bf16")
+    np.testing.assert_allclose(bf16, single, rtol=1e-5, atol=1e-7)
+    cfg = GradSyncConfig("int8", min_quant_numel=1)
+    a, _ = _run({"dp": 4, "fsdp": 2}, grad_sync=cfg)
+    b, _ = _run({"dp": 4, "fsdp": 2}, grad_sync=cfg)
+    assert np.array_equal(a, b)
+    rel = np.abs(a - bf16) / np.maximum(np.abs(bf16), 1e-6)
+    assert rel.max() < 1e-2, rel
+
+
+def test_quantized_all_reduce_psum_matches_wire_form():
+    """The psum-form exchange is the SAME quantization scheme as the
+    wire (all_to_all/all_gather) form: on identical per-rank inputs
+    the two produce results within the analytic error bound of each
+    other, and the psum form is deterministic and replicated-bitwise
+    across ranks."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.collectives import (
+        compat_shard_map, quantized_all_reduce_local,
+        quantized_all_reduce_psum)
+
+    mesh = make_mesh({"dp": N_DEV})
+    rng = np.random.RandomState(0)
+    x = rng.randn(N_DEV, 70000).astype(np.float32)
+
+    def wire(xs):
+        return quantized_all_reduce_local(
+            xs.reshape(-1), "dp", N_DEV, op="mean").reshape(1, -1)
+
+    def psum_form(xs):
+        return quantized_all_reduce_psum(
+            xs.reshape(-1), "dp", N_DEV, None, op="mean"
+        ).reshape(1, -1)
+
+    got_wire = np.asarray(compat_shard_map(
+        wire, mesh, (P("dp", None),), P("dp", None))(jnp.asarray(x)))
+    got_psum = np.asarray(compat_shard_map(
+        psum_form, mesh, (P("dp", None),), P("dp", None))(
+            jnp.asarray(x)))
+    exact = x.mean(0)
+    # every rank's copy is identical (replicated-bitwise)
+    assert all(np.array_equal(got_psum[0], got_psum[i])
+               for i in range(N_DEV))
+    # both forms sit within the documented elementwise bound of exact
+    for got in (got_wire[0], got_psum[0]):
+        rel = np.abs(got - exact).max() / np.abs(exact).max()
+        assert rel < 0.05, rel
+    # and the two forms agree with each other far tighter than the
+    # bound (same quantize/dequantize; only the sum order differs)
+    rel = np.abs(got_wire[0] - got_psum[0]).max() / np.abs(exact).max()
+    assert rel < 0.05, rel
+
+
+def test_sparse_grads_stay_sparse_on_composed_mesh(monkeypatch):
+    """The SparseGrad path on a dp×fsdp mesh: the table grad rides the
+    psum-concat gather (never the quantized dense exchange) and
+    untouched rows stay bit-identical."""
+    from paddle_tpu.parallel import collectives
+
+    V, D, B, F = 64, 16, 32, 4
+
+    def build_sparse():
+        ids = layers.data("ids", shape=[B, F], dtype="int64",
+                          append_batch_size=False)
+        y = layers.data("y", shape=[B, 1], append_batch_size=False)
+        emb = layers.embedding(
+            ids, size=[V, D], is_sparse=True,
+            param_attr=fluid.ParamAttr(
+                name="tbl",
+                initializer=fluid.initializer.Constant(0.05)))
+        s = layers.reduce_sum(emb, dim=1)
+        h = layers.fc(s, size=256, act="relu")
+        p = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return loss
+
+    seen = []
+    real = collectives.quantized_all_reduce_psum
+
+    def spy(g, *a, **kw):
+        seen.append(tuple(g.shape))
+        return real(g, *a, **kw)
+
+    monkeypatch.setattr(collectives, "quantized_all_reduce_psum", spy)
+
+    rng = np.random.RandomState(1)
+    batches = [{"ids": rng.randint(0, V // 2, (B, F)).astype(np.int64),
+                "y": rng.rand(B, 1).astype(np.float32)}
+               for _ in range(3)]
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = build_sparse()
+        exe = fluid.Executor()
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        bs.grad_sync = GradSyncConfig("int8", min_quant_numel=1)
+        fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs,
+            mesh=make_mesh({"dp": 4, "fsdp": 2}))
+        losses = []
+        for b in batches:
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    assert (V, D) not in seen, seen          # table never densified
+    assert any(len(s) == 2 and s[0] * s[1] >= 256 for s in seen), seen
+    table = np.asarray(scope.find_var("tbl"))
+    np.testing.assert_array_equal(
+        table[V // 2:], np.full((V - V // 2, D), 0.05, np.float32))
+
+
+# -- mesh-shape-agnostic reshard on load ----------------------------------
+
+def _train_and_save(mesh_axes, ckpt, steps=2, rules=None,
+                    optimizer="momentum"):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = _build(optimizer)
+        exe = fluid.Executor()
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        if rules is not None:
+            bs.sharding_rules = rules
+        fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs,
+            mesh=make_mesh(mesh_axes))
+        for b in _batches(steps):
+            exe.run(main, feed=b, fetch_list=[loss])
+        fluid.io.save_sharded(exe, ckpt, main_program=main)
+        vals = {v.name: np.asarray(scope.find_var(v.name))
+                for v in main.list_vars() if v.persistable}
+    return vals
+
+
+def _load_on(mesh_axes, ckpt, rules=None, optimizer="momentum"):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = _build(optimizer)
+        exe = fluid.Executor()
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        if rules is not None:
+            bs.sharding_rules = rules
+        fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs,
+            mesh=make_mesh(mesh_axes))
+        fluid.io.load_sharded(exe, ckpt, main_program=main,
+                              mesh=make_mesh(mesh_axes))
+        vals = {v.name: np.asarray(scope.find_var(v.name))
+                for v in main.list_vars() if v.persistable}
+        # per-device shard shapes BEFORE the step (the step donates
+        # and consumes the loaded arrays)
+        shard_shapes = {
+            v.name: {s.data.shape
+                     for s in scope.find_var(v.name).addressable_shards}
+            for v in main.list_vars() if v.persistable
+            if hasattr(scope.find_var(v.name), "addressable_shards")}
+        # the loaded state still trains (one step proves the shardings
+        # entered the executable coherently)
+        (lv,) = exe.run(main, feed=_batches(1, seed=9)[0],
+                        fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv).reshape(-1)[0]))
+    return vals, shard_shapes
+
+
+def test_reshard_dp8_to_dp4_and_dp2mp2(tmp_path):
+    """ACCEPTANCE: a dp=8-saved checkpoint loads onto dp=4 and
+    dp=2×mp=2 meshes with bit-identical LOGICAL params — the missing
+    half of gang elasticity."""
+    ckpt = str(tmp_path / "ck_dp8")
+    saved = _train_and_save({"dp": 8}, ckpt)
+    for axes, rules in (({"dp": 4}, None),
+                        ({"dp": 2, "mp": 2}, _mp_rules())):
+        got, shard_shapes = _load_on(axes, ckpt, rules=rules)
+        for name, want in saved.items():
+            np.testing.assert_array_equal(
+                got[name], want,
+                err_msg=f"{name} not bit-identical on {axes}")
+    # and on the dp2mp2 mesh the mp-sharded fc really landed SHARDED
+    # (shard_shapes is from the dp2mp2 iteration above)
+    w = next(n for n in shard_shapes if "ffn_in" in n and ".w_" in n
+             and not n.split(".w_0")[-1])
+    assert shard_shapes[w] == {(32, 64)}, \
+        (w, shard_shapes[w])  # (32,128) split over mp=2
+
+
+def test_reshard_zero_opt_state_fsdp8_to_fsdp4(tmp_path):
+    """ZeRO-sharded optimizer state saved at fsdp=8 reassembles
+    bit-identically at fsdp=4 AND lands 1/4-sharded (the
+    state_spec_for composition on load) — a shrunken gang resumes with
+    its opt-state memory win intact."""
+    ckpt = str(tmp_path / "ck_fsdp8")
+    saved = _train_and_save({"fsdp": 8}, ckpt, optimizer="adam")
+    got, shard_shapes = _load_on({"fsdp": 4}, ckpt, optimizer="adam")
+    for name, want in saved.items():
+        np.testing.assert_array_equal(got[name], want, err_msg=name)
+    mom = next(n for n in shard_shapes if n.endswith(".moment1")
+               and saved[n].ndim == 2)
+    d0, d1 = saved[mom].shape
+    assert shard_shapes[mom] == {(d0 // 4, d1)}, \
+        (mom, shard_shapes[mom])
+
+
+def test_reshard_to_single_device(tmp_path):
+    """The degenerate reshard: a dp=8-sharded save loads host-side
+    (mesh=None) bit-identically — the manifest's global indices are
+    the only source of truth."""
+    ckpt = str(tmp_path / "ck_dp8s")
+    saved = _train_and_save({"fsdp": 8}, ckpt, optimizer="adam")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        _build("adam")
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.load_sharded(exe, ckpt, main_program=main)
+        for name, want in saved.items():
+            got = np.asarray(scope.find_var(name))
+            np.testing.assert_array_equal(got, want, err_msg=name)

@@ -52,6 +52,14 @@ def main(argv=None):
                          "supervisor (fresh service per attempt) so "
                          "even rank 0 is killable with structured "
                          "detection by the survivors")
+    ap.add_argument("--elastic", action="store_true",
+                    help="relaunch a broken gang at the SURVIVING "
+                         "world size: ranks killed by signal are "
+                         "treated as lost capacity; workers read the "
+                         "shrunken PADDLE_TRAINERS and reshard their "
+                         "sharded checkpoints onto the smaller mesh "
+                         "(io.load_sharded is mesh-shape-agnostic; "
+                         "docs/DIST.md §hybrid)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command (prefix with --)")
     args = ap.parse_args(argv)
@@ -66,7 +74,8 @@ def main(argv=None):
                      backoff_base_s=args.backoff_base_s,
                      backoff_max_s=args.backoff_max_s,
                      log_dir=args.log_dir,
-                     host_coordinator=args.host_coordinator)
+                     host_coordinator=args.host_coordinator,
+                     elastic=args.elastic)
     try:
         result = sup.run()
     except GangFailedError as e:

@@ -196,16 +196,25 @@ def check_schema(candidate):
                     f"joins/leaves/preemptions (the zero-recompile "
                     f"decode contract)")
         if "mesh" in entry:
-            # dp-mesh contract (ISSUE 10, docs/DIST.md): a multi-chip
-            # entry must carry per-device AND aggregate throughput plus
-            # the comm-bucket bytes — a dp number without its comm cost
-            # is not interpretable
-            for field in ("n_devices", "comm_bytes", "grad_sync"):
+            # mesh contract (ISSUE 10 + 13, docs/DIST.md): a multi-chip
+            # entry must carry per-device AND aggregate throughput, the
+            # comm-bucket bytes, and — since the fsdp/ZeRO axis — the
+            # per-device optimizer-state bytes of the sharded step (a
+            # mesh number without its memory footprint cannot back a
+            # ZeRO claim); the mesh itself must name its axes
+            for field in ("n_devices", "comm_bytes", "grad_sync",
+                          "opt_state_bytes_per_device"):
                 if field not in entry:
-                    errors.append(f"detail.{name}: dp entry missing "
+                    errors.append(f"detail.{name}: mesh entry missing "
                                   f"{field!r}")
+            if not (isinstance(entry["mesh"], dict) and entry["mesh"]
+                    and all(isinstance(s, int) and s >= 1
+                            for s in entry["mesh"].values())):
+                errors.append(f"detail.{name}: mesh entry's mesh must "
+                              f"be a non-empty axis->size dict, got "
+                              f"{entry['mesh']!r}")
             if not any(k.startswith("per_device_") for k in entry):
-                errors.append(f"detail.{name}: dp entry missing "
+                errors.append(f"detail.{name}: mesh entry missing "
                               f"per_device_* throughput")
     return errors
 
@@ -303,6 +312,19 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
         report.append(line)
         if rise > tol_comm:
             regressions.append(line + f" exceeds tol {tol_comm:.0%}")
+    # ZeRO opt-state footprint: per-device resident accumulator bytes
+    # of the sharded step (same mesh + grad_sync guaranteed above) —
+    # creeping back up means the fsdp sharding quietly stopped applying
+    bob, cob = (base.get("opt_state_bytes_per_device"),
+                cand.get("opt_state_bytes_per_device"))
+    if isinstance(bob, (int, float)) and isinstance(cob, (int, float)) \
+            and bob:
+        rise = (cob - bob) / bob
+        line = (f"{name}.opt_state_bytes_per_device: "
+                f"{bob / 1e6:.1f}MB -> {cob / 1e6:.1f}MB ({rise:+.2%})")
+        report.append(line)
+        if rise > tol_mem:
+            regressions.append(line + f" exceeds tol {tol_mem:.0%}")
 
 
 def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,

@@ -14,7 +14,12 @@ recurrence kernel vs the scan base).  r08 adds the dp-mesh pair
 dp8_int8ar (EQuARX blockwise-int8 quantized exchange, --grad-sync
 int8), with per-pair comm_bytes context in the summary — on the 8-CPU
 virtual mesh the pair records correctness + comm-byte deltas; the
-grad-sync default only flips on a chip throughput win.  r07 added the
+grad-sync default only flips on a chip throughput win.  r10 adds the
+hybrid-parallel ladder (ISSUE 13): fsdp2/4/8 (ZeRO-sharded optimizer
+state — the summary's fsdp_opt_state_scaling records the per-device
+opt-state byte drop vs dp8) and the composed dp2mp2 pair (Megatron mp
+sharding × dp, int8 riding the psum-form exchange).  The fsdp claim
+is MEMORY; throughput decides defaults, device-tagged as always.  r07 added the
 head-major layout
 variants (ISSUE 8): transformer_headmajor / transformer_pallas_headmajor
 record the layout at the short-seq headline shape — the latter is the
@@ -120,6 +125,21 @@ VARIANTS = [
     ("dp8_bf16", ["--model", "transformer", "--mesh", "dp=8"]),
     ("dp8_int8ar", ["--model", "transformer", "--mesh", "dp=8",
                     "--grad-sync", "int8"]),
+    # r10 (ISSUE 13): the fsdp/ZeRO ladder — same data-parallel math
+    # as dp=N (loss parity pinned in tests/test_hybrid_parallel.py)
+    # with optimizer state sharded ~1/N per device.  The A/B claim is
+    # MEMORY (each entry's opt_state_bytes_per_device, summarized as
+    # fsdp_opt_state_scaling); throughput decides defaults as
+    # everywhere, per the device-tag rule.
+    ("fsdp2", ["--model", "transformer", "--mesh", "fsdp=2"]),
+    ("fsdp4", ["--model", "transformer", "--mesh", "fsdp=4"]),
+    ("fsdp8", ["--model", "transformer", "--mesh", "fsdp=8"]),
+    # the composed dp×mp mesh record: Megatron-sharded params + data
+    # parallelism in ONE entry (keyed transformer_dp2mp2), with the
+    # int8 exchange riding the psum-form on the composed mesh
+    ("dp2mp2", ["--model", "transformer", "--mesh", "dp=2,mp=2"]),
+    ("dp2mp2_int8ar", ["--model", "transformer", "--mesh", "dp=2,mp=2",
+                       "--grad-sync", "int8"]),
     # r09: the paged-KV decode cache precision pair (ISSUE 12 stretch).
     # int8 pools halve KV bytes vs bf16 (per-row f32 scale sidecars,
     # the blockwise scheme of parallel/collectives.py) — whether that
@@ -297,6 +317,26 @@ def comm_measure(results, k):
     return None
 
 
+def opt_state_measure(results, k):
+    """The variant's opt_state_bytes_per_device (resident per-device
+    accumulator bytes of the sharded step, bench.py/_opt_state_fields),
+    or None for NO DATA — the fsdp/ZeRO pairs' point: the memory claim
+    is only real if the sharded step's buffer assignment shows it."""
+    d = results.get(k, {})
+    if "error" in d or "failed" in d or \
+            d.get("metric") == "bench_failed":
+        return None
+    detail = d.get("detail") or {}
+    model = _VARIANT_MODEL.get(k)
+    subs = (_model_entries(detail, model) if model is not None
+            else [sub for sub in detail.values() if isinstance(sub, dict)])
+    for sub in subs:
+        if isinstance(sub.get("opt_state_bytes_per_device"),
+                      (int, float)):
+            return sub["opt_state_bytes_per_device"]
+    return None
+
+
 def wins(results, a, b):
     # a missing side must yield "no data", never a vacuous win —
     # AB wins gate bench defaults (CLAUDE.md measured-wins-only).
@@ -334,6 +374,12 @@ _PAIRS = {
     # at the same dp degree; per-pair comm-bytes context rides the
     # summary (<name>_comm_bytes)
     "dp8_int8ar": ("dp8_int8ar", "dp8_bf16"),
+    # fsdp-vs-dp at the same device count: the ZeRO memory claim
+    # (opt-state + peak deltas ride the summary); throughput still
+    # decides defaults
+    "fsdp8_zero": ("fsdp8", "dp8_bf16"),
+    # the composed-mesh int8 exchange (psum-form) vs its bf16 twin
+    "dp2mp2_int8ar": ("dp2mp2_int8ar", "dp2mp2"),
     # int8 KV pools vs the bf16 default for continuous-batching decode
     "decode_kv_int8": ("serving_decode_kv_int8",
                        "serving_decode_kv_bf16"),
@@ -364,6 +410,31 @@ def compute_summary(results):
             # actually moves per step (int8's claim is ~half); recorded
             # next to the throughput verdict that decides the default
             out[f"{name}_comm_bytes"] = {a: ca, b: cb}
+        oa, ob = (opt_state_measure(results, a),
+                  opt_state_measure(results, b))
+        if oa is not None and ob is not None:
+            # the fsdp pairs' point: per-device resident opt-state
+            # bytes — the ZeRO ~1/N claim in the artifact itself
+            out[f"{name}_opt_state_bytes"] = {a: oa, b: ob}
+    # the ZeRO scaling record (ISSUE 13 acceptance): opt-state bytes
+    # per device across the fsdp ladder vs the dp=8 replicated
+    # baseline — drop >=1.7x at fsdp=2, ~N/1 at fsdp=4/8 (the pinned
+    # chip-free assert lives in tests/test_hybrid_parallel.py; this is
+    # the recorded artifact form)
+    base = opt_state_measure(results, "dp8_bf16")
+    ladder = {n: opt_state_measure(results, f"fsdp{n}")
+              for n in (2, 4, 8)}
+    if base and all(v for v in ladder.values()):
+        out["fsdp_opt_state_scaling"] = {
+            "dp8_bytes": base,
+            **{f"fsdp{n}_bytes": v for n, v in ladder.items()},
+            **{f"fsdp{n}_drop_x": round(base / v, 3)
+               for n, v in ladder.items()},
+            "zero_scaling_ok": bool(
+                base / ladder[2] >= 1.7
+                and base / ladder[4] >= 4 * 0.75
+                and base / ladder[8] >= 8 * 0.75),
+        }
     return out
 
 
@@ -371,7 +442,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r09.json")
+    p.add_argument("--out", default="AB_r10.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     p.add_argument("--bench-args", default=None,

@@ -592,6 +592,151 @@ print("dp bench smoke OK:",
 EOF
 python tools/perf_gate.py --schema --candidate /tmp/bench_dp_line.json
 
+echo "== hybrid-parallel smoke: fsdp ZeRO + dpxmp + reshard-load (cpu) =="
+# ISSUE 13 tentpole: (1) an fsdp mesh must ZeRO-shard optimizer state —
+# per-device resident opt-state bytes from the SHARDED compile drop
+# >=1.7x at fsdp=2 and ~N/1 at fsdp=8; (2) a dp×mp mesh with
+# Megatron-sharded params trains with loss parity vs the single-device
+# twin, int8 grad sync deterministic on the composed mesh; (3) a
+# checkpoint saved on a dp=8 virtual mesh RESUMES on dp=4 and dp=2×mp=2
+# meshes with bit-identical logical params (the reshard-load contract)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+python - <<'EOF'
+import tempfile
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.parallel import GradSyncConfig, make_mesh
+from paddle_tpu.parallel.strategies import ShardingRules
+
+def build():
+    x = layers.data("x", shape=[32], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=128, act="relu", name="ffn_in")
+    pred = layers.fc(h, size=1, name="ffn_out")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    return loss
+
+def rules():
+    return ShardingRules(rules=[(r"ffn_in\S*\.w", (None, "mp")),
+                                (r"ffn_out\S*\.w", ("mp", None))])
+
+def batches(n, seed=0):
+    r = np.random.RandomState(seed)
+    return [{"x": r.randn(64, 32).astype(np.float32),
+             "y": r.randn(64, 1).astype(np.float32)} for _ in range(n)]
+
+def run(mesh_axes, grad_sync=None, mp=False, steps=3, ckpt=None,
+        load=None, opt_bytes=False):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    scope = fluid.Scope()
+    out = {}
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        loss = build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        if mesh_axes:
+            bs = fluid.BuildStrategy()
+            bs.grad_sync = grad_sync
+            if mp:
+                bs.sharding_rules = rules()
+            fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs,
+                mesh=make_mesh(mesh_axes))
+        if load:
+            fluid.io.load_sharded(exe, load, main_program=main,
+                                  mesh=make_mesh(mesh_axes)
+                                  if mesh_axes else None)
+            out["loaded"] = {
+                v.name: np.asarray(scope.find_var(v.name))
+                for v in main.list_vars() if v.persistable}
+            return out
+        losses = []
+        for b in batches(steps):
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        out["losses"] = np.asarray(losses)
+        if opt_bytes:
+            rep = observe.sharded_memory_report(
+                main, feed=batches(1)[0], fetch_list=[loss],
+                scope=scope)
+            out["opt_bytes"] = observe.resident_state_bytes(rep)
+        if ckpt:
+            fluid.io.save_sharded(exe, ckpt, main_program=main)
+            out["saved"] = {
+                v.name: np.asarray(scope.find_var(v.name))
+                for v in main.list_vars() if v.persistable}
+    return out
+
+# (1) ZeRO memory
+base = run({"dp": 2}, opt_bytes=True)["opt_bytes"]
+f2 = run({"fsdp": 2}, opt_bytes=True)["opt_bytes"]
+f8 = run({"fsdp": 8}, opt_bytes=True)["opt_bytes"]
+assert base / f2 >= 1.7, (base, f2)
+assert base / f8 >= 8 * 0.75, (base, f8)
+
+# (2) dp×mp parity + composed int8 determinism
+single = run(None)["losses"]
+dpmp = run({"dp": 4, "mp": 2}, mp=True)["losses"]
+np.testing.assert_allclose(dpmp, single, rtol=1e-5, atol=1e-7)
+cfg = GradSyncConfig("int8", min_quant_numel=1)
+i8a = run({"dp": 4, "mp": 2}, grad_sync=cfg, mp=True)["losses"]
+i8b = run({"dp": 4, "mp": 2}, grad_sync=cfg, mp=True)["losses"]
+assert np.array_equal(i8a, i8b), "composed-mesh int8 not deterministic"
+assert np.isfinite(i8a).all()
+
+# (3) reshard-load: save at dp=8, resume at dp=4 and dp=2×mp=2
+d = tempfile.mkdtemp(prefix="hybrid_reshard_")
+saved = run({"dp": 8}, ckpt=d)["saved"]
+for axes, mp_on in (({"dp": 4}, False), ({"dp": 2, "mp": 2}, True)):
+    got = run(axes, mp=mp_on, load=d)["loaded"]
+    for k, want in saved.items():
+        assert np.array_equal(got[k], want), (axes, k)
+print("hybrid-parallel smoke OK:",
+      {"opt_bytes_dp2": base, "fsdp2": f2, "fsdp8": f8,
+       "zero_drop_fsdp2": round(base / f2, 2),
+       "zero_drop_fsdp8": round(base / f8, 2),
+       "dpxmp_parity": True, "int8_composed_deterministic": True,
+       "reshard_bit_identical": ["dp4", "dp2mp2"]})
+EOF
+
+echo "== composed-mesh bench smoke (dp=2,mp=2, cpu) =="
+# ISSUE 13 satellite: --mesh parses multi-axis specs, the entry keys
+# unambiguously (<model>_dp2mp2), and carries the mesh contract incl.
+# opt_state_bytes_per_device; perf_gate --schema must accept the line
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "transformer", "--mesh",
+     "dp=2,mp=2", "--batch", "8", "--steps", "2", "--warmup", "1",
+     "--probe-timeout", "0", "--model-deadline", "2400"],
+    capture_output=True, text=True, timeout=3000)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "composed bench printed no JSON line:\n" + \
+    (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["transformer_dp2mp2"]
+assert "error" not in d, d
+assert d["mesh"] == {"dp": 2, "mp": 2} and d["n_devices"] == 4, d
+assert d["tokens_per_sec"] > 0
+assert isinstance(d["opt_state_bytes_per_device"], (int, float)) and \
+    d["opt_state_bytes_per_device"] > 0, \
+    d.get("opt_state_error", d.get("opt_state_bytes_per_device"))
+with open("/tmp/bench_dp2mp2_line.json", "w") as f:
+    f.write(lines[-1])
+print("composed-mesh bench smoke OK:",
+      {k: d[k] for k in ("tokens_per_sec", "per_device_tokens_per_sec",
+                         "comm_bytes", "opt_state_bytes_per_device",
+                         "n_devices", "grad_sync")})
+EOF
+python tools/perf_gate.py --schema --candidate /tmp/bench_dp2mp2_line.json
+
 echo "== quantized all-reduce parity smoke (8 virtual devices, cpu) =="
 # ISSUE 10: the EQuARX blockwise-int8 exchange must stay (1) within
 # its analytic error bound of the exact sum, (2) bitwise
