@@ -1230,6 +1230,122 @@ def _decode_mem(engine):
         return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
 
 
+def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
+    """Offered-load closed loop over an N-replica decode fleet with a
+    SCRIPTED mid-run replica kill and a rolling hot weight reload —
+    the serving-resilience proof line (ISSUE 14, docs/SERVING.md
+    §fleet).
+
+    Phase A submits half the stream and immediately fault-injects
+    replica 0 (chaos.kill_replica drives the real scheduler-death
+    path), so its in-flight generations fail over to survivors and
+    regenerate token-identically (the fleet verifies committed
+    prefixes; a parity break fails the run).  Phase B submits the rest
+    and rolls the SAME weights through the survivors mid-stream
+    (fleet.reload: evacuate → io.load_sharded → same-shape swap).  The
+    headline is requests/s sustained ACROSS both events with zero
+    client-visible failures; the entry carries the failover/hedge/
+    retry counters, reload_pause_ms, and the fleet-wide
+    post_warmup_compiles == 0 proof."""
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.executor import Executor, scope_guard
+    from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+    from paddle_tpu.serving.fleet import Fleet, FleetConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        arch = dict(vocab_size=8192, n_layer=4, n_head=8, d_model=512,
+                    d_inner=1024)
+        num_slots, page, max_len, chunk = 8, 16, 256, 8
+        buckets = (32, 64)
+        max_new = 48
+        n_requests = n_requests or 48
+        prompt_lo, prompt_hi = 8, 64
+    else:
+        # CPU smoke: the contract (failover parity, zero drops across
+        # the roll, zero compiles), not the throughput
+        arch = dict(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                    d_inner=128)
+        num_slots, page, max_len, chunk = 2, 8, 96, 4
+        buckets = (16, 32)
+        max_new = 16
+        n_requests = n_requests or 16
+        prompt_lo, prompt_hi = 4, 30
+
+    def mk_engine():
+        lm = DecoderLM(kv_dtype="bfloat16", seed=0, **arch)
+        cfg = DecodeConfig(num_slots=num_slots, page_size=page,
+                           max_len=max_len,
+                           prefill_buckets=buckets,
+                           decode_chunk=chunk, kv_dtype="bfloat16")
+        return DecodeEngine(lm, cfg, queue_capacity=4 * n_requests,
+                            memory_budget_bytes=False)
+
+    engines = [mk_engine() for _ in range(n_replicas)]
+    fleet = Fleet(engines, FleetConfig()).start()
+    prompts = make_prompts(n_requests, arch["vocab_size"],
+                           min_len=prompt_lo, max_len=prompt_hi, seed=0)
+    rng = np.random.RandomState(1)
+    budgets = rng.randint(max(2, max_new // 2), max_new + 1,
+                          n_requests)
+    half = n_requests // 2
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        with scope_guard(engines[0].scope):
+            fluid.io.save_sharded(
+                Executor(), ckpt_dir,
+                main_program=engines[0].model.step["main"])
+        t0 = time.perf_counter()
+        futs = [fleet.submit(p, max_new_tokens=int(b))
+                for p, b in zip(prompts[:half], budgets[:half])]
+        chaos.kill_replica(engines[0])  # the scripted mid-run death
+        outs = [f.result(1200) for f in futs]
+        futs = [fleet.submit(p, max_new_tokens=int(b))
+                for p, b in zip(prompts[half:], budgets[half:])]
+        reload_info = fleet.reload(ckpt_dir)
+        outs += [f.result(1200) for f in futs]
+        elapsed = time.perf_counter() - t0
+    snap = fleet.snapshot()
+    survivors = [h.engine for h in fleet.replicas if not h.dead]
+    mem = _decode_mem(survivors[0]) if survivors else {}
+    fleet.close()
+    tokens_total = sum(len(r.tokens) for r in outs)
+    assert snap["failed"] == 0, snap
+    assert snap["parity_failed"] == 0, snap
+    assert tokens_total == int(np.sum(budgets)), \
+        (tokens_total, int(np.sum(budgets)))
+    _, kind = _peak_flops()
+    return {
+        "requests_per_sec": round(n_requests / elapsed, 2),
+        "tokens_per_sec": round(tokens_total / elapsed, 1),
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "tokens_generated": tokens_total,
+        "failover_count": snap["failovers"],
+        "hedged": snap["hedges"],
+        "retried": snap["retries"],
+        "ejects": snap["ejects"],
+        "saturated_rejects": snap["saturated"],
+        "parity_checked": snap["parity_checked"],
+        "reload_pause_ms": snap["reload_pause_ms"],
+        "reload_seconds": reload_info["seconds"],
+        "model_version": snap["model_version"],
+        "zero_client_failures": snap["failed"] == 0,
+        "post_warmup_compiles": snap["post_warmup_compiles"],
+        "e2e_p50_ms": snap["e2e_ms"]["p50_ms"],
+        "e2e_p99_ms": snap["e2e_ms"]["p99_ms"],
+        "num_slots": num_slots, "page_size": page,
+        "decode_chunk": chunk, "kv_dtype": "bfloat16",
+        "device": kind,
+        **mem,
+    }
+
+
 def _probe_hazard(repo_dir: str, flag_fresh_s: float = 7200.0):
     """Machine-enforce the CLAUDE.md attach hazard: a second JAX client
     merely ATTACHING to the tunneled chip mid-bench degrades it ~5x
@@ -1285,7 +1401,7 @@ def main():
                    choices=["all", "resnet50", "transformer", "bert",
                             "lstm", "deepfm", "serving",
                             "serving_engine", "serving_decode",
-                            "longctx"])
+                            "serving_fleet", "longctx"])
     p.add_argument("--batch", type=int, default=0)
     p.add_argument("--mesh", default=None, metavar="dp=N[,mp=M]",
                    help="bench the training models (resnet50/"
@@ -1673,6 +1789,13 @@ def main():
         # stream; post_warmup_compiles in the entry must be 0
         _run("serving_decode", bench_serving_decode,
              n_requests=args.batch or 0, kv_int8=args.kv_int8)
+    if args.model in ("all", "serving_fleet"):
+        # serving-resilience proof line (ISSUE 14): offered load across
+        # a scripted replica kill + rolling hot weight reload — zero
+        # client-visible failures and zero fleet-wide post-warmup
+        # compiles by contract (perf_gate --schema enforces the keys)
+        _run("serving_fleet", bench_serving_fleet,
+             n_requests=args.batch or 0)
     if args.model in ("all", "longctx"):
         # long-context proof point (VERDICT r4 item 7): seq 8k with the
         # O(T)-memory stack — Pallas flash for self AND cross
@@ -1787,6 +1910,20 @@ def main():
                         d["preemptions"],
                         d["post_warmup_compiles"])),
             "vs_baseline": 0.0,  # first recorded decode line
+            "detail": detail,
+        }
+    elif ("serving_fleet" in detail
+          and "requests_per_sec" in detail["serving_fleet"]):
+        d = detail["serving_fleet"]
+        result = {
+            "metric": "decoder_serving_fleet_requests_per_sec",
+            "value": d["requests_per_sec"],
+            "unit": ("req/s offered-load across a replica kill + "
+                     "weight roll (%d failovers, reload pause %.1fms, "
+                     "%d post-warmup compiles)"
+                     % (d["failover_count"], d["reload_pause_ms"],
+                        d["post_warmup_compiles"])),
+            "vs_baseline": 0.0,  # first recorded fleet line
             "detail": detail,
         }
     elif "examples_per_sec" in detail.get("deepfm", {}):

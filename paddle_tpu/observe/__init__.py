@@ -54,9 +54,10 @@ from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    device_peaks, flash_boundary_layout,
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
-from .events import (GANG_EVENTS, NUMERICS_EVENTS,  # noqa: F401
-                     RESILIENCE_EVENTS, SERVING_EVENTS, RunEventLog,
-                     git_sha, new_run_id, read_events)
+from .events import (FLEET_EVENTS, GANG_EVENTS,  # noqa: F401
+                     NUMERICS_EVENTS, RESILIENCE_EVENTS, SERVING_EVENTS,
+                     BoundEventLog, RunEventLog, git_sha, new_run_id,
+                     read_events)
 from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401
                      device_memory_budget, export_chrome_trace,
                      format_memory_table, memory_report, memory_table,

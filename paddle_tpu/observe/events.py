@@ -33,6 +33,29 @@ SERVING_EVENTS = (
     "serving_breaker_close",        # half-open probe succeeded; RUNNING
 )
 
+# serving-fleet event kinds (docs/SERVING.md §fleet): the router layer
+# fronting N engine replicas.  Every record carries replica_id where
+# one replica is the subject (engines stamp their own events with it
+# too, via RunEventLog.bind — N replicas sharing one log stay
+# disambiguated).
+FLEET_EVENTS = (
+    "serving_fleet_start",     # fleet config at start(): kind, replicas
+    "serving_fleet_failover",  # LOUD: an in-flight request was pulled
+    #                            off a replica and requeued on a
+    #                            survivor (committed-token count rides)
+    "serving_fleet_eject",     # LOUD: a replica was removed from
+    #                            routing (scheduler death / manual)
+    "serving_fleet_hedge",     # a slow attempt got a duplicate on
+    #                            another replica (idempotent only)
+    "serving_fleet_saturated", # LOUD: every replica fast-rejected —
+    #                            the structured whole-fleet shed
+    "serving_fleet_reload",    # one roll: begin/done phases + version
+    "serving_fleet_reload_replica",  # per-replica swap: pause_ms,
+    #                                  evacuated count
+    "serving_fleet_window",    # periodic fleet-merged stats snapshot
+    "serving_fleet_close",     # final merged snapshot at close
+)
+
 # resilience event kinds (docs/RESILIENCE.md): checkpoint fallback,
 # save telemetry, and preemption-drain lifecycle, emitted by
 # contrib.Trainer / the chaos CI smoke
@@ -220,6 +243,15 @@ class RunEventLog:
         fields.update(extra)
         return self.event("serving_window", **fields)
 
+    def bind(self, **fields: Any) -> "BoundEventLog":
+        """A view over this log that stamps `fields` (e.g. replica_id)
+        into every record it emits — the way N serving-engine replicas
+        share ONE process log without their events becoming
+        indistinguishable.  The view shares the file, write lock, and
+        run_id; closing the view is a no-op (the owner closes the
+        base)."""
+        return BoundEventLog(self, fields)
+
     def close(self):
         if not self._f.closed:
             self.event("run_end")
@@ -231,6 +263,48 @@ class RunEventLog:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+class BoundEventLog:
+    """RunEventLog view with fixed fields merged into every record
+    (see RunEventLog.bind).  Explicit per-event fields win on key
+    collision.  Safe to re-bind (views nest by merging)."""
+
+    def __init__(self, base: RunEventLog, fields: Dict[str, Any]):
+        while isinstance(base, BoundEventLog):
+            fields = {**base._fields, **fields}
+            base = base._base
+        self._base = base
+        self._fields = dict(fields)
+
+    @property
+    def run_id(self) -> str:
+        return self._base.run_id
+
+    @property
+    def path(self) -> str:
+        return self._base.path
+
+    def bind(self, **fields: Any) -> "BoundEventLog":
+        return BoundEventLog(self, fields)
+
+    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        return self._base.event(kind, **{**self._fields, **fields})
+
+    def telemetry_window(self, telemetry, **extra: Any) -> Dict[str, Any]:
+        fields = (telemetry.as_dict() if hasattr(telemetry, "as_dict")
+                  else dict(telemetry))
+        fields.update(extra)
+        return self.event("telemetry", **fields)
+
+    def serving_window(self, stats, **extra: Any) -> Dict[str, Any]:
+        fields = (stats.snapshot() if hasattr(stats, "snapshot")
+                  else dict(stats))
+        fields.update(extra)
+        return self.event("serving_window", **fields)
+
+    def close(self):
+        """No-op: the view does not own the underlying file."""
 
 
 def _jsonable(v):

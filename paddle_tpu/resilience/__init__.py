@@ -35,7 +35,8 @@ failures): this subsystem survives them (docs/RESILIENCE.md):
   (`tools/launch_gang.py` is the CLI),
 - `chaos`: deterministic fault injectors (failpoints, delaypoints, NaN
   batches, shard corruption, torn checkpoints, executor failure
-  bursts, env-armed per-rank kill/hang for gang workers, `FakeKv`)
+  bursts, env-armed per-rank kill/hang for gang workers, in-process
+  serving-replica kill/delay for fleet failover proofs, `FakeKv`)
   that the tests and the CI chaos smokes use to prove all of the
   above.
 """
@@ -45,8 +46,9 @@ from . import health  # noqa: F401
 from . import preempt  # noqa: F401
 from . import supervisor  # noqa: F401
 from .chaos import (ChaosKilled, FakeKv, FlakyPredictor,  # noqa: F401
-                    corrupt_file, corrupt_shard, hang_rank, kill_rank,
-                    nan_reader, poison_feed, tear_checkpoint)
+                    corrupt_file, corrupt_shard, delay_replica,
+                    hang_rank, kill_rank, kill_replica, nan_reader,
+                    poison_feed, tear_checkpoint)
 from .errors import (CheckpointBarrierPoisonedError,  # noqa: F401
                      CheckpointBarrierTimeoutError,
                      CheckpointCorruptError, CheckpointError,

@@ -246,6 +246,40 @@ class FlakyPredictor:
         return getattr(self._predictor, name)
 
 
+def _replica_point(engine, what: str) -> str:
+    rid = getattr(engine, "replica_id", None)
+    if rid is None:
+        raise ValueError(
+            "engine has no replica_id — chaos replica primitives "
+            "target FLEET replicas (Fleet assigns ids at construction, "
+            "or call engine.set_replica_id first)")
+    return f"replica:{rid}:{what}"
+
+
+def kill_replica(engine) -> str:
+    """Arm the abrupt-death failpoint of one fleet replica: the
+    engine's next scheduled iteration raises ChaosKilled exactly where
+    an executor crash would land, driving the REAL failure path — a
+    DecodeEngine's scheduler dies through `_fail_everything` (every
+    in-flight request resolves with the structured retryable
+    DecodeReplicaFailedError and a router fails it over); a
+    ServingEngine's next dispatch fails the batch with the retryable
+    ExecutorFailureError.  The in-process analog of SIGKILLing a
+    replica process, with the same caller-visible evidence.  Returns
+    the armed failpoint name (chaos.disarm(name) cancels)."""
+    name = _replica_point(engine, "kill")
+    arm(name)
+    return name
+
+
+def delay_replica(engine, seconds: float, times: int = 1) -> str:
+    """Arm a per-iteration stall on one fleet replica — the straggler
+    a router's hedging must beat.  Returns the delaypoint name."""
+    name = _replica_point(engine, "delay")
+    arm_delay(name, seconds, times)
+    return name
+
+
 def hang(seconds: float) -> None:
     """An injected hang the watchdog must interrupt (sleep re-enters
     the interpreter, so SIGALRM / the timer-thread async-exc can

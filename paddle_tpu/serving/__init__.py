@@ -14,7 +14,11 @@ The inference-side subsystem (docs/SERVING.md): what `parallel/` +
 - `decode.DecodeEngine`: continuous-batching autoregressive decode
   over a paged KV cache (fixed-slot batch, prefill-on-join,
   preemption; ISSUE 12) with `stats.DecodeStats` TTFT/TPOT/occupancy/
-  pool-utilization telemetry.
+  pool-utilization telemetry,
+- `fleet.Fleet`: N engine replicas behind one health-checked router —
+  least-loaded routing, per-replica breakers, hedging, in-flight
+  decode failover (token-identical regeneration), and rolling hot
+  weight reload (ISSUE 14; docs/SERVING.md §fleet).
 
 Quick start (or `paddle_tpu.contrib.serve(...)`):
 
@@ -30,11 +34,14 @@ from .admission import (AdmissionController,  # noqa: F401
                         CircuitBreaker, CircuitOpenError,
                         DeadlineExceededError, ExecutorFailureError,
                         QueueFullError, ServingClosedError,
-                        ServingError)
+                        ServingError, WeightReloadError)
 from .batcher import DynamicBatcher, Request  # noqa: F401
 from .decode import (DecodeBucketMissError,  # noqa: F401
                      DecodeConfig, DecodeEngine, DecodeMemoryError,
-                     DecodeRequest, PagePool)
+                     DecodeReplicaFailedError, DecodeRequest, PagePool)
 from .engine import (BucketConfig, BucketMemoryError,  # noqa: F401
                      BucketMissError, ServingEngine)
+from .fleet import (FailoverParityError, Fleet,  # noqa: F401
+                    FleetClosedError, FleetConfig, FleetResponse,
+                    FleetSaturatedError, FleetStats, ReplicaHandle)
 from .stats import DecodeStats, ServingStats  # noqa: F401

@@ -50,16 +50,25 @@ class ServingError(RuntimeError):
 
     `details` is machine-readable; `as_dict()` is the wire form a
     frontend returns to the client (and what tests assert on).
+
+    `retryable` marks errors a ROUTER may transparently resubmit on
+    another replica: the request itself is fine, the replica that held
+    it is not (executor crash, scheduler death, evacuation for a
+    weight roll).  Client-side rejections (bucket miss, deadline,
+    queue full) stay non-retryable — resubmitting them elsewhere would
+    produce the same answer or violate the caller's deadline.
     """
 
     kind = "serving_error"
+    retryable = False
 
     def __init__(self, message: str, **details: Any):
         super().__init__(message)
         self.details = details
 
     def as_dict(self) -> Dict[str, Any]:
-        out = {"error": self.kind, "message": str(self)}
+        out = {"error": self.kind, "message": str(self),
+               "retryable": self.retryable}
         out.update(self.details)
         return out
 
@@ -94,9 +103,22 @@ class CircuitOpenError(ServingError):
 
 class ExecutorFailureError(ServingError):
     """The batch dispatch (executor call) failed; every future in the
-    batch resolves with this structured wrapper around the raw error."""
+    batch resolves with this structured wrapper around the raw error.
+    Retryable: the batch's requests were never at fault — a router may
+    replay them on another replica."""
 
     kind = "executor_failure"
+    retryable = True
+
+
+class WeightReloadError(ServingError):
+    """A hot weight reload was refused or broke its contract: shape/
+    dtype mismatch vs the live parameters (a same-shape swap is what
+    guarantees zero recompiles), an attempt to swap under live
+    generations without evacuating first, or an XLA compile observed
+    during a fleet roll."""
+
+    kind = "weight_reload"
 
 
 class CircuitBreaker:

@@ -54,7 +54,8 @@ from ..observe.events import RunEventLog
 from ..observe.monitoring import runtime_stats
 from .admission import (AdmissionController, CircuitBreaker,
                         DeadlineExceededError, ExecutorFailureError,
-                        ServingClosedError, ServingError)
+                        ServingClosedError, ServingError,
+                        WeightReloadError)
 from .engine import BucketConfig
 from .stats import DecodeStats
 
@@ -76,6 +77,24 @@ class DecodeMemoryError(ServingError):
     the observe.memory fit planner's small-pool probes."""
 
     kind = "decode_memory"
+
+
+class DecodeReplicaFailedError(ServingError):
+    """An accepted request was pulled off its replica mid-generation —
+    the scheduler died, the request was evacuated for a weight roll,
+    or the engine shut down with it unresolved.
+
+    RETRYABLE by construction: greedy decode regenerates
+    token-identically from the prompt alone, so the error carries the
+    full requeue `descriptor` (prompt, sampling params, priority, the
+    committed-token count and the tokens emitted so far) — a router
+    resubmits it on a surviving replica and can verify the
+    regeneration reproduces the committed prefix exactly.  `reason` is
+    one of "scheduler_failed" / "evacuated" / "shutdown"; `cause`
+    carries the original failure when one exists."""
+
+    kind = "decode_replica_failed"
+    retryable = True
 
 
 class DecodeConfig:
@@ -148,6 +167,21 @@ class DecodeRequest:
         self.t_submit = time.monotonic()
         self.preempted = 0
 
+    def descriptor(self, generated: Optional[List[int]] = None
+                   ) -> Dict[str, Any]:
+        """The requeue wire form a router resubmits on another replica
+        (and verifies token-identity against): everything that defines
+        the greedy generation, plus what this replica had already
+        committed."""
+        gen = [int(t) for t in (generated or [])]
+        return {"prompt": [int(t) for t in self.prompt],
+                "max_new_tokens": self.max_new_tokens,
+                "priority": self.priority,
+                "deadline": self.deadline,
+                "committed_tokens": len(gen),
+                "generated": gen,
+                "preempted": self.preempted}
+
 
 class PagePool:
     """Host-side free-list allocator over the device pool's page
@@ -180,15 +214,18 @@ class _Slot:
     """Scheduler-side state of one decode lane."""
 
     __slots__ = ("req", "pages", "committed", "generated", "cur_tok",
-                 "remaining")
+                 "remaining", "version")
 
-    def __init__(self, req: DecodeRequest, pages: List[int]):
+    def __init__(self, req: DecodeRequest, pages: List[int],
+                 version: int = 0):
         self.req = req
         self.pages = pages
         self.committed = len(req.prompt)   # tokens whose KV is pooled
         self.generated: List[int] = []     # tokens produced so far
         self.cur_tok = 0                   # pending (uncommitted) token
         self.remaining = req.max_new_tokens
+        self.version = version             # model_version that serves
+        #                                    this whole generation
 
     @property
     def cap_tokens(self) -> int:
@@ -278,6 +315,26 @@ class DecodeEngine:
         self._worker: Optional[threading.Thread] = None
         self._stop = False
         self._started = False
+        # fleet surface: replica identity, weight version, and the
+        # control requests (evacuation / weight swap) the scheduler
+        # services between dispatches
+        self.replica_id: Optional[int] = None
+        self.model_version = 0
+        self._evac_waiters: List[Dict[str, Any]] = []
+        self._pending_reload: Optional[Dict[str, Any]] = None
+
+    def set_replica_id(self, replica_id: int) -> None:
+        """Name this engine as fleet replica `replica_id` and stamp the
+        id on every event it (and its stats) emits — N replicas sharing
+        one RunEventLog stay disambiguated (the log's write lock
+        already makes the concurrent emits safe; this makes them
+        attributable)."""
+        self.replica_id = int(replica_id)
+        if self._event_log is not None \
+                and hasattr(self._event_log, "bind"):
+            bound = self._event_log.bind(replica_id=self.replica_id)
+            self._event_log = bound
+            self.stats._event_log = bound
 
     # -- jitted executables ---------------------------------------------
     def _feed_env(self, params, pools, **feeds):
@@ -523,17 +580,11 @@ class DecodeEngine:
             self._cv.notify_all()
         if self._worker is not None:
             self._worker.join(timeout_s)
-        # shutdown never strands a future
-        leftovers = [s.req for s in self._slots if s is not None]
-        with self._cv:
-            leftovers += self._queue
-            self._queue = []
-            self._slots = [None] * self.config.num_slots
-        for req in leftovers:
-            if not req.future.done():
-                req.future.set_exception(ServingClosedError(
-                    "decode engine shut down before this request "
-                    "completed", state=self.admission.state))
+        # shutdown never strands a future: anything a timed-out drain
+        # left behind resolves with the RETRYABLE structured error
+        # (requeue descriptor attached) so a router can still finish
+        # the request on another replica
+        self._pull_all("shutdown")
         self.admission.finish_drain()
         if self._own_log is not None:
             self._own_log.close()
@@ -553,7 +604,134 @@ class DecodeEngine:
             pages_in_use=self.page_pool.in_use,
             num_pages=self.config.num_pages,
             completed=self.stats.completed,
+            replica_id=self.replica_id,
+            model_version=self.model_version,
             post_warmup_compiles=self.stats.post_warmup_compiles())
+
+    # -- fleet surface: evacuation + hot weight reload ------------------
+    def evacuate(self, timeout_s: float = 30.0) -> List[Dict[str, Any]]:
+        """Pull every accepted-but-unresolved request off this replica
+        and return their requeue descriptors.  Each future resolves
+        with the structured, retryable DecodeReplicaFailedError (the
+        same wire form `_fail_everything` uses), so a router that
+        chained them fails the requests over; the returned descriptors
+        are the same data for routers that track requests themselves.
+        Runs on the scheduler thread at a batch boundary (inline when
+        the scheduler is not running); the engine keeps serving — new
+        submits after the evacuation are admitted normally."""
+        with self._cv:
+            alive = (self._worker is not None and self._worker.is_alive()
+                     and not self._stop)
+            if alive:
+                waiter = {"ev": threading.Event(), "result": None}
+                self._evac_waiters.append(waiter)
+                self._cv.notify_all()
+        if not alive:
+            return self._pull_all("evacuated")
+        if not waiter["ev"].wait(timeout_s):
+            raise WeightReloadError(
+                f"evacuation not serviced within {timeout_s:.0f}s "
+                f"(scheduler wedged?)", replica_id=self.replica_id,
+                timeout_s=timeout_s)
+        return waiter["result"]
+
+    def reload(self, source, version: Optional[int] = None,
+               timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Hot weight reload: materialize `source` (a sharded-
+        checkpoint dir via io.load_sharded, or a name→array mapping),
+        assert every array matches the live parameter's shape and dtype
+        — the same-shape swap is what guarantees the jitted executables
+        are reused with ZERO recompiles — and swap at the scheduler's
+        next batch boundary.  Refuses while generations are in flight
+        (evacuate() first; the fleet roll does).  Returns {"version",
+        "pause_ms"}; raises the structured WeightReloadError on any
+        violation, leaving the old weights serving."""
+        t0 = time.perf_counter()
+        params = self._materialize_params(source)
+        self._check_reload_shapes(params)
+        new_version = (self.model_version + 1 if version is None
+                       else int(version))
+        with self._cv:
+            alive = (self._worker is not None and self._worker.is_alive()
+                     and not self._stop)
+            if alive:
+                if self._pending_reload is not None:
+                    raise WeightReloadError(
+                        "another reload is already pending",
+                        replica_id=self.replica_id)
+                pend = {"params": params, "version": new_version,
+                        "ev": threading.Event(), "error": None}
+                self._pending_reload = pend
+                self._cv.notify_all()
+        if not alive:
+            active = sum(s is not None for s in self._slots)
+            if active:
+                raise WeightReloadError(
+                    f"{active} generation(s) still in flight; "
+                    f"evacuate() first", replica_id=self.replica_id)
+            self._params = params
+            self.model_version = new_version
+        else:
+            if not pend["ev"].wait(timeout_s):
+                raise WeightReloadError(
+                    f"reload not applied within {timeout_s:.0f}s "
+                    f"(scheduler wedged?)", replica_id=self.replica_id,
+                    timeout_s=timeout_s)
+            if pend["error"]:
+                raise WeightReloadError(
+                    f"reload refused: {pend['error']}",
+                    replica_id=self.replica_id)
+        pause_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.record_reload(pause_ms)
+        if self._event_log is not None:
+            self._event_log.event(
+                "serving_decode_reload", version=new_version,
+                pause_ms=round(pause_ms, 3),
+                source=source if isinstance(source, str) else "arrays")
+        return {"version": new_version, "pause_ms": round(pause_ms, 3)}
+
+    def _materialize_params(self, source) -> Dict[str, Any]:
+        """Device-resident name→array dict from a sharded checkpoint
+        dir (io.load_sharded into this engine's scope) or a mapping."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.executor import RNG_STATE_VAR
+
+        if isinstance(source, str):
+            from .. import io as fluid_io
+            from ..core.executor import Executor, scope_guard
+
+            with scope_guard(self.scope):
+                fluid_io.load_sharded(Executor(), source,
+                                      main_program=self.model.step["main"])
+            src = {n: v for n, v in self.scope.vars.items()
+                   if v is not None and n != RNG_STATE_VAR}
+        else:
+            src = dict(source)
+        return {n: jax.device_put(jnp.asarray(v))
+                for n, v in src.items() if n in self._params}
+
+    def _check_reload_shapes(self, params: Dict[str, Any]):
+        missing = sorted(set(self._params) - set(params))
+        if missing:
+            raise WeightReloadError(
+                f"reload source missing {len(missing)} parameter(s): "
+                f"{missing[:4]}{' ...' if len(missing) > 4 else ''}",
+                replica_id=self.replica_id, missing=missing)
+        mismatched = [
+            {"name": n, "live": [list(self._params[n].shape),
+                                 str(self._params[n].dtype)],
+             "new": [list(params[n].shape), str(params[n].dtype)]}
+            for n in self._params
+            if (tuple(params[n].shape) != tuple(self._params[n].shape)
+                or params[n].dtype != self._params[n].dtype)]
+        if mismatched:
+            raise WeightReloadError(
+                f"{len(mismatched)} parameter(s) change shape/dtype — "
+                f"a same-shape swap is the zero-recompile contract; "
+                f"first: {mismatched[0]}",
+                replica_id=self.replica_id, mismatched=mismatched)
 
     # -- request path ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -613,14 +791,27 @@ class DecodeEngine:
 
     # -- scheduler ------------------------------------------------------
     def _loop(self):
+        from ..resilience import chaos
+
         while True:
             with self._cv:
                 while (not self._stop and not self._queue
-                       and not any(self._slots)):
+                       and not any(self._slots)
+                       and not self._evac_waiters
+                       and self._pending_reload is None):
                     self._cv.wait(0.05)
                 if self._stop:
                     return
             try:
+                self._service_control()
+                if self.replica_id is not None:
+                    # fleet chaos points (resilience.chaos.kill_replica
+                    # / delay_replica): a kill raises here and drives
+                    # the REAL abrupt-death path below — exactly what
+                    # an executor crash mid-dispatch does; a delay
+                    # models a straggling replica for hedge proofs
+                    chaos.delaypoint(f"replica:{self.replica_id}:delay")
+                    chaos.failpoint(f"replica:{self.replica_id}:kill")
                 self._admit()
                 self._decode()
             except BaseException as e:  # noqa: BLE001 — the scheduler
@@ -629,27 +820,100 @@ class DecodeEngine:
                 return
             self.stats.maybe_emit()
 
+    def _service_control(self):
+        """Evacuations and weight swaps land HERE, on the scheduler
+        thread BETWEEN dispatches — the drain-to-batch-boundary
+        contract: a control action never interleaves with a dispatch,
+        and a swap never touches a live generation (the reload refuses
+        unless the slots are empty; the fleet roll evacuates first)."""
+        with self._cv:
+            evac = self._evac_waiters
+            self._evac_waiters = []
+            pend = self._pending_reload
+            self._pending_reload = None
+        if evac:
+            descs = self._pull_all("evacuated")
+            for w in evac:
+                w["result"] = descs
+                w["ev"].set()
+        if pend is not None:
+            active = sum(s is not None for s in self._slots)
+            if active:
+                pend["error"] = (f"{active} generation(s) still in "
+                                 f"flight; evacuate() first")
+            else:
+                self._params = pend["params"]
+                self.model_version = pend["version"]
+            pend["ev"].set()
+
+    def _pull_all(self, reason: str, cause: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+        """Remove EVERY accepted-but-unresolved request (active slots +
+        queue), resolve each future with the structured, retryable
+        DecodeReplicaFailedError carrying its requeue descriptor, free
+        the pages, and return the descriptors.  Only safe on the
+        scheduler thread or once the scheduler is stopped/dead (the
+        slot table is scheduler-owned)."""
+        victims: List[tuple] = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._slots[i] = None
+            self.page_pool.free(slot.pages)
+            self._page_tables[i, :] = 0
+            victims.append((slot.req, slot.generated))
+        with self._cv:
+            victims += [(r, []) for r in self._queue]
+            self._queue = []
+            self._unresolved -= len(victims)
+            self._cv.notify_all()
+        descs: List[Dict[str, Any]] = []
+        if not victims:
+            return descs
+        self.stats.record_evacuation(len(victims))
+        if self._event_log is not None:
+            self._event_log.event(
+                "serving_decode_evacuate", reason=reason, cause=cause,
+                requests=len(victims),
+                pages_free_after=self.page_pool.free_pages)
+        for req, gen in victims:
+            d = req.descriptor(gen)
+            descs.append(d)
+            if not req.future.done():
+                req.future.set_exception(DecodeReplicaFailedError(
+                    f"request pulled off replica "
+                    f"{self.replica_id if self.replica_id is not None else '?'}"
+                    f" ({reason}) after {len(gen)} committed token(s); "
+                    f"requeue the descriptor on a surviving replica",
+                    reason=reason, cause=cause,
+                    replica_id=self.replica_id, descriptor=d))
+        return descs
+
     def _fail_everything(self, exc: BaseException):
-        wrapped = exc if isinstance(exc, ServingError) else \
-            ExecutorFailureError(
-                f"decode scheduler failed: {type(exc).__name__}: "
-                f"{exc}", error_type=type(exc).__name__)
+        """The scheduler died: stop accepting, then resolve every
+        accepted request with the structured retryable error (requeue
+        descriptors attached) instead of a bare exception — the
+        router-facing half of the failover contract."""
+        cause = f"{type(exc).__name__}: {exc}"
         # a dead scheduler must not keep ACCEPTING: later submits get
         # ServingClosedError instead of queueing forever
         try:
             self.admission.begin_drain()
         except ServingError:
             pass
+        self._pull_all("scheduler_failed", cause=cause)
+        # control waiters must not hang on a dead scheduler either
         with self._cv:
-            victims = [s.req for s in self._slots if s is not None]
-            victims += self._queue
-            self._queue = []
-            self._slots = [None] * self.config.num_slots
-            self._unresolved = 0
-            self._cv.notify_all()
-        for req in victims:
-            if not req.future.done():
-                req.future.set_exception(wrapped)
+            evac = self._evac_waiters
+            self._evac_waiters = []
+            pend = self._pending_reload
+            self._pending_reload = None
+        for w in evac:
+            w["result"] = []
+            w["ev"].set()
+        if pend is not None:
+            pend["error"] = f"scheduler died: {cause}"
+            pend["ev"].set()
 
     def _resolve(self, slot_id: int, error: Optional[BaseException]
                  = None):
@@ -665,6 +929,9 @@ class DecodeEngine:
                 slot.req.future.set_exception(error)
             return
         if not slot.req.future.done():
+            # which weights produced this generation (a router's
+            # response tag for the hot-reload roll)
+            slot.req.future.model_version = slot.version
             slot.req.future.set_result(
                 np.asarray(slot.generated, np.int32))
         self.stats.record_done()
@@ -740,7 +1007,8 @@ class DecodeEngine:
             if req is None:
                 break
             slot_id = free_ids[0]
-            self._slots[slot_id] = _Slot(req, pages)
+            self._slots[slot_id] = _Slot(req, pages,
+                                         version=self.model_version)
             self._set_pages(slot_id, pages)
             joiners.append(slot_id)
         if not joiners:

@@ -45,7 +45,8 @@ from ..inference import AnalysisConfig, Predictor
 from ..observe.events import RunEventLog
 from ..observe.monitoring import runtime_stats
 from .admission import (AdmissionController, CircuitBreaker,
-                        ExecutorFailureError, ServingError)
+                        ExecutorFailureError, ServingError,
+                        WeightReloadError)
 from .batcher import DynamicBatcher, Request
 from .stats import ServingStats
 
@@ -246,6 +247,20 @@ class ServingEngine:
                 self.stats.record_deadline_miss())
         self._started = False
         self._lock = threading.Lock()
+        # fleet surface: replica identity + live weight version
+        self.replica_id: Optional[int] = None
+        self.model_version = 0
+
+    def set_replica_id(self, replica_id: int) -> None:
+        """Name this engine as fleet replica `replica_id` and stamp the
+        id on every event it (and its stats) emits — N replicas sharing
+        one RunEventLog stay disambiguated."""
+        self.replica_id = int(replica_id)
+        if self._event_log is not None \
+                and hasattr(self._event_log, "bind"):
+            bound = self._event_log.bind(replica_id=self.replica_id)
+            self._event_log = bound
+            self.stats._event_log = bound
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -322,7 +337,82 @@ class ServingEngine:
             buckets=self.buckets.n_buckets,
             completed=self.stats.completed,
             executor_failures=self.stats.executor_failures,
+            replica_id=self.replica_id,
+            model_version=self.model_version,
             post_warmup_compiles=self.stats.post_warmup_compiles())
+
+    # -- fleet surface: hot weight reload -------------------------------
+    def reload(self, source, version: Optional[int] = None
+               ) -> Dict[str, Any]:
+        """Hot weight reload: swap the live predictor's device-resident
+        parameters for same-shape arrays — the same-shape contract is
+        asserted (that is what guarantees the per-bucket executables
+        are reused with ZERO recompiles) and the swap is a single
+        attribute rebind, so each dispatch runs wholly on the old or
+        wholly on the new weights (the batcher worker reads the param
+        dict once per executable call — drain-to-batch-boundary for
+        free).  `source` is a sharded-checkpoint dir (io.load_sharded)
+        or a name→array mapping.  Structured WeightReloadError on
+        mismatch; the old weights keep serving."""
+        t0 = time.perf_counter()
+        params = self._materialize_params(source)
+        live = self.predictor._params
+        missing = sorted(set(live) - set(params))
+        if missing:
+            raise WeightReloadError(
+                f"reload source missing {len(missing)} parameter(s): "
+                f"{missing[:4]}{' ...' if len(missing) > 4 else ''}",
+                replica_id=self.replica_id, missing=missing)
+        mismatched = [
+            {"name": n,
+             "live": [list(live[n].shape), str(live[n].dtype)],
+             "new": [list(params[n].shape), str(params[n].dtype)]}
+            for n in live
+            if (tuple(params[n].shape) != tuple(live[n].shape)
+                or params[n].dtype != live[n].dtype)]
+        if mismatched:
+            raise WeightReloadError(
+                f"{len(mismatched)} parameter(s) change shape/dtype — "
+                f"a same-shape swap is the zero-recompile contract; "
+                f"first: {mismatched[0]}",
+                replica_id=self.replica_id, mismatched=mismatched)
+        new_version = (self.model_version + 1 if version is None
+                       else int(version))
+        self.predictor._params = {n: params[n] for n in live}
+        self.model_version = new_version
+        pause_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.record_reload(pause_ms)
+        if self._event_log is not None:
+            self._event_log.event(
+                "serving_reload", version=new_version,
+                pause_ms=round(pause_ms, 3),
+                source=source if isinstance(source, str) else "arrays")
+        return {"version": new_version, "pause_ms": round(pause_ms, 3)}
+
+    def _materialize_params(self, source) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.executor import RNG_STATE_VAR
+
+        if isinstance(source, str):
+            from .. import io as fluid_io
+            from ..core.executor import Executor, scope_guard
+
+            pred = self.predictor
+            with scope_guard(pred._scope):
+                fluid_io.load_sharded(
+                    Executor(), source, main_program=pred._program,
+                    vars=[pred._program.global_block().var(n)
+                          for n in pred._params
+                          if n in pred._program.global_block().vars])
+            src = {n: v for n, v in pred._scope.vars.items()
+                   if v is not None and n != RNG_STATE_VAR}
+        else:
+            src = dict(source)
+        return {n: jax.device_put(jnp.asarray(v))
+                for n, v in src.items()
+                if n in self.predictor._params}
 
     def _breaker_event(self, kind: str, **fields):
         """serving_breaker_open/close: state-transition events a pager
@@ -560,8 +650,18 @@ class ServingEngine:
                 row = float(tpl.size or 1.0)
                 elems_real += n * row
                 elems_padded += bucket_b * row
+        version = self.model_version  # the weights this batch runs on
         t0 = time.perf_counter()
         try:
+            if self.replica_id is not None:
+                # fleet chaos points (resilience.chaos): an armed kill
+                # raises here and rides the REAL dispatch-failure path
+                # below — the batch fails with the structured retryable
+                # wrapper a router fails over
+                from ..resilience import chaos
+
+                chaos.delaypoint(f"replica:{self.replica_id}:delay")
+                chaos.failpoint(f"replica:{self.replica_id}:kill")
             outs = self.predictor.run(feed)
         except BaseException as e:
             # one executor outcome per dispatch feeds the breaker; the
@@ -587,6 +687,7 @@ class ServingEngine:
             res = [o[i] if (getattr(o, "ndim", 0) >= 1
                             and o.shape[0] == bucket_b) else o
                    for o in outs]
+            r.future.model_version = version
             r.future.set_result(res)
             self.stats.record_done((now - r.t_submit) * 1e3)
         self.stats.maybe_emit()

@@ -62,8 +62,12 @@ class ServingStats:
         self._elems_real = 0.0    # element-level fill (ragged-aware)
         self._elems_padded = 0.0
         self.max_queue_depth = 0
+        self.reloads = 0             # hot weight swaps applied
+        self.reload_pause_ms = 0.0   # worst single swap pause
         self.warmup: Dict[str, Any] = {}
         self._rt_base: Optional[Dict[str, Any]] = None
+        self._merged_compiles = 0  # post-warmup compiles folded in by
+        #                            merge() from other replicas' stats
         self._emitted_at = 0      # completed count at last window emit
         self._compiles_reported = 0
 
@@ -120,13 +124,66 @@ class ServingStats:
         with self._lock:
             self.completed += 1
 
+    def record_reload(self, pause_ms: float):
+        with self._lock:
+            self.reloads += 1
+            if pause_ms > self.reload_pause_ms:
+                self.reload_pause_ms = float(pause_ms)
+
     # -- reading --------------------------------------------------------
     def post_warmup_compiles(self) -> int:
         """XLA backend compiles since warmup finished (must stay 0 in
-        steady state — the zero-recompile serving contract)."""
-        if self._rt_base is None:
-            return 0
-        return runtime_stats.delta(self._rt_base)["compiles"]
+        steady state — the zero-recompile serving contract), plus any
+        folded in by merge() from other replicas."""
+        base = 0 if self._rt_base is None \
+            else runtime_stats.delta(self._rt_base)["compiles"]
+        return base + self._merged_compiles
+
+    def reset_compile_base(self):
+        """Restart the post-warmup compile window NOW.  The fleet start
+        path needs this: runtime_stats is process-global, so replica
+        K's warmup compiles would otherwise land inside replica 0's
+        post-warmup window and break the zero-compile contract for a
+        fleet that never leaked a shape."""
+        with self._lock:
+            self._rt_base = runtime_stats.snapshot()
+            self._merged_compiles = 0
+            self._compiles_reported = 0
+
+    def merge(self, other: "ServingStats") -> "ServingStats":
+        """Fold another replica's counters and histograms into this one
+        IN PLACE (and return self) — the fleet aggregation surface.
+        Histograms merge exactly (LatencyHistogram.merge: bin-wise
+        addition, config mismatch rejected); counters sum; gauges
+        (max_queue_depth, reload_pause_ms) take the max.  Mixing stats
+        classes (DecodeStats into ServingStats) is rejected — their
+        snapshots answer different questions."""
+        if type(other) is not type(self):
+            raise TypeError(
+                f"cannot merge {type(other).__name__} into "
+                f"{type(self).__name__} (config mismatch)")
+        # histograms first: a bin-config mismatch must reject BEFORE
+        # any counter has been folded
+        self.e2e_ms.merge(other.e2e_ms)
+        self.exec_ms.merge(other.exec_ms)
+        with other._lock:
+            o = {f: getattr(other, f) for f in (
+                "submitted", "completed", "shed", "deadline_misses",
+                "bucket_misses", "executor_failures", "circuit_rejects",
+                "batches", "reloads", "_slots", "_real", "_elems_real",
+                "_elems_padded")}
+            o_depth = other.max_queue_depth
+            o_pause = other.reload_pause_ms
+        o_compiles = other.post_warmup_compiles()
+        with self._lock:
+            for f, v in o.items():
+                setattr(self, f, getattr(self, f) + v)
+            if o_depth > self.max_queue_depth:
+                self.max_queue_depth = o_depth
+            if o_pause > self.reload_pause_ms:
+                self.reload_pause_ms = o_pause
+            self._merged_compiles += o_compiles
+        return self
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -140,6 +197,8 @@ class ServingStats:
                 "circuit_rejects": self.circuit_rejects,
                 "batches": self.batches,
                 "max_queue_depth": self.max_queue_depth,
+                "reloads": self.reloads,
+                "reload_pause_ms": round(self.reload_pause_ms, 3),
                 "batch_occupancy": round(self._real / self._slots, 4)
                 if self._slots else None,
                 "padding_waste": round(
@@ -235,6 +294,10 @@ class DecodeStats:
         self.circuit_rejects = 0
         self.executor_failures = 0
         self.preemptions = 0
+        self.evacuations = 0        # requests pulled off this replica
+        #                             (scheduler death / weight roll)
+        self.reloads = 0            # hot weight swaps applied
+        self.reload_pause_ms = 0.0  # worst single swap pause
         self.prefills = 0           # prefill dispatches
         self.prefill_joins = 0      # requests admitted via those
         self.decode_dispatches = 0  # chunked decode dispatches
@@ -247,6 +310,7 @@ class DecodeStats:
         self.peak_pages_in_use = 0
         self.warmup: Dict[str, Any] = {}
         self._rt_base: Optional[Dict[str, Any]] = None
+        self._merged_compiles = 0
         self._emitted_at = 0
         self._compiles_reported = 0
 
@@ -289,6 +353,16 @@ class DecodeStats:
         with self._lock:
             self.preemptions += n
 
+    def record_evacuation(self, n: int = 1):
+        with self._lock:
+            self.evacuations += n
+
+    def record_reload(self, pause_ms: float):
+        with self._lock:
+            self.reloads += 1
+            if pause_ms > self.reload_pause_ms:
+                self.reload_pause_ms = float(pause_ms)
+
     def record_prefill(self, joins: int, ttfts_ms) -> None:
         with self._lock:
             self.prefills += 1
@@ -323,9 +397,56 @@ class DecodeStats:
 
     # -- reading --------------------------------------------------------
     def post_warmup_compiles(self) -> int:
-        if self._rt_base is None:
-            return 0
-        return runtime_stats.delta(self._rt_base)["compiles"]
+        base = 0 if self._rt_base is None \
+            else runtime_stats.delta(self._rt_base)["compiles"]
+        return base + self._merged_compiles
+
+    def reset_compile_base(self):
+        """Restart the post-warmup compile window NOW (see
+        ServingStats.reset_compile_base — the fleet start path)."""
+        with self._lock:
+            self._rt_base = runtime_stats.snapshot()
+            self._merged_compiles = 0
+            self._compiles_reported = 0
+
+    def merge(self, other: "DecodeStats") -> "DecodeStats":
+        """Fold another replica's decode telemetry into this one IN
+        PLACE (and return self): TTFT/TPOT histograms merge exactly,
+        counters sum, occupancy/utilization accumulators sum (the
+        merged ratios stay exact weighted means), peaks take the max.
+        Stats-class and histogram-bin config mismatches are rejected.
+        Caveat shared with ServingStats.merge: runtime_stats compile
+        counters are process-global, so N same-process replicas that
+        each saw a post-warmup compile report it N times in the merged
+        sum — an over-count in exactly the direction the zero-compile
+        contract wants (0 stays 0; any leak reads louder, not
+        quieter)."""
+        if type(other) is not type(self):
+            raise TypeError(
+                f"cannot merge {type(other).__name__} into "
+                f"{type(self).__name__} (config mismatch)")
+        self.ttft_ms.merge(other.ttft_ms)
+        self.tpot_ms.merge(other.tpot_ms)
+        with other._lock:
+            o = {f: getattr(other, f) for f in (
+                "submitted", "completed", "shed", "deadline_misses",
+                "bucket_misses", "circuit_rejects", "executor_failures",
+                "preemptions", "evacuations", "reloads", "prefills",
+                "prefill_joins", "decode_dispatches",
+                "decode_iterations", "tokens_generated", "_slot_steps",
+                "_cap_steps", "_util_sum", "_util_samples")}
+            o_peak = other.peak_pages_in_use
+            o_pause = other.reload_pause_ms
+        o_compiles = other.post_warmup_compiles()
+        with self._lock:
+            for f, v in o.items():
+                setattr(self, f, getattr(self, f) + v)
+            if o_peak > self.peak_pages_in_use:
+                self.peak_pages_in_use = o_peak
+            if o_pause > self.reload_pause_ms:
+                self.reload_pause_ms = o_pause
+            self._merged_compiles += o_compiles
+        return self
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -338,6 +459,9 @@ class DecodeStats:
                 "circuit_rejects": self.circuit_rejects,
                 "executor_failures": self.executor_failures,
                 "preemptions": self.preemptions,
+                "evacuations": self.evacuations,
+                "reloads": self.reloads,
+                "reload_pause_ms": round(self.reload_pause_ms, 3),
                 "prefills": self.prefills,
                 "prefill_joins": self.prefill_joins,
                 "decode_dispatches": self.decode_dispatches,

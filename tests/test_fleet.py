@@ -1,0 +1,393 @@
+"""Serving-fleet resilience suite (ISSUE 14) — the pinned chaos proofs.
+
+The load-bearing properties, each proven by injecting its fault:
+
+- **decode failover is invisible**: fault-inject one replica
+  mid-generation under offered load → every affected request completes
+  on a survivor with output TOKEN-IDENTICAL (greedy) to an
+  uninterrupted control engine, zero client-visible failures, zero
+  post-warmup compiles fleet-wide (the PR 12 preemption proof lifted
+  across replica boundaries).
+- **hot reload drops nothing**: `fleet.reload()` under sustained load
+  rejects zero requests, performs zero recompiles (same-shape assert),
+  and responses carry the new model version after the roll.
+- **every boundary crossing is structured**: evacuation descriptors,
+  retryable replica-failure errors, fleet saturation fast-rejects —
+  all ServingError subclasses with `as_dict()`, all evented with
+  replica_id stamps.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.core.executor import Executor, scope_guard
+from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.observe import read_events
+from paddle_tpu.observe.monitoring import LatencyHistogram
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import (BucketConfig, DecodeConfig, DecodeEngine,
+                                DecodeReplicaFailedError, DecodeStats,
+                                Fleet, FleetConfig, FleetSaturatedError,
+                                ServingEngine, ServingStats,
+                                WeightReloadError)
+
+VOCAB = 48
+PROMPTS = make_prompts(6, VOCAB, min_len=3, max_len=8, seed=21)
+BUDGETS = [14, 12, 16, 11, 14, 12]
+
+
+def _lm():
+    return DecoderLM(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=32,
+                     d_inner=64, kv_dtype="float32", seed=7)
+
+
+def _engine(**kw):
+    # one prefill bucket: each engine start is exactly two compiles
+    # (decode chunk + prefill), keeping the tier-1 wall cost low
+    cfg = DecodeConfig(num_slots=2, page_size=4, max_len=48,
+                       num_pages=24, prefill_buckets=(8,),
+                       decode_chunk=2, kv_dtype="float32")
+    return DecodeEngine(_lm(), cfg, memory_budget_bytes=False, **kw)
+
+
+@pytest.fixture(scope="module")
+def control_tokens():
+    """The uninterrupted control: the same requests through one
+    unkilled engine — greedy, so any fleet schedule must reproduce
+    these tokens exactly."""
+    eng = _engine().start()
+    outs = [eng.generate(p, max_new_tokens=b, timeout_s=300).tolist()
+            for p, b in zip(PROMPTS, BUDGETS)]
+    eng.close()
+    return outs
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    yield
+    chaos.clear()
+
+
+# -- the pinned chaos proof -------------------------------------------------
+
+def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
+    """Kill one replica mid-generation under offered load: zero
+    client-visible failures, every output token-identical to the
+    control, committed prefixes verified, zero post-warmup compiles
+    fleet-wide, the dead replica ejected."""
+    log_path = str(tmp_path / "fleet_events.jsonl")
+    engines = [_engine(), _engine()]
+    fleet = Fleet(engines, FleetConfig(), log_path=log_path).start()
+    futs = [fleet.submit(p, max_new_tokens=b)
+            for p, b in zip(PROMPTS, BUDGETS)]
+    # mid-generation: wait until replica 0 has COMMITTED tokens, so at
+    # least one failover carries a non-empty prefix to verify
+    deadline = time.monotonic() + 60
+    while (engines[0].stats.tokens_generated < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.002)
+    chaos.kill_replica(engines[0])
+    resps = [f.result(300) for f in futs]
+    outs = [r.tokens.tolist() for r in resps]
+    snap = fleet.snapshot()
+    assert outs == control_tokens, \
+        "failover changed generated tokens (greedy identity broke)"
+    assert snap["failed"] == 0
+    assert snap["failovers"] >= 1, snap
+    assert snap["parity_checked"] >= 1 and snap["parity_failed"] == 0
+    assert snap["ejects"] == 1
+    assert snap["post_warmup_compiles"] == 0, snap
+    assert fleet.replicas[0].dead and not fleet.replicas[1].dead
+    # requests that failed over say so in their provenance
+    assert any(r.failovers >= 1 for r in resps)
+    assert all(r.replica_id == 1 for r in resps if r.failovers)
+    fleet.close()
+
+    # satellite: replica_id stamps every engine event in the shared
+    # log; the fleet lifecycle + failover events are present
+    events = read_events(log_path)
+    kinds = [e["event"] for e in events]
+    assert "serving_fleet_start" in kinds
+    assert "serving_fleet_failover" in kinds
+    assert "serving_fleet_eject" in kinds
+    replica_events = [e for e in events
+                      if e["event"].startswith("serving_decode")]
+    assert replica_events, kinds
+    assert all("replica_id" in e for e in replica_events)
+    assert {e["replica_id"] for e in replica_events} == {0, 1}
+
+
+def test_hot_reload_under_load(control_tokens):
+    """fleet.reload() during sustained load: zero dropped requests,
+    zero recompiles, token parity before/after (same weights), and a
+    post-roll response tagged with the new model version."""
+    engines = [_engine(), _engine()]
+    fleet = Fleet(engines, FleetConfig()).start()
+    with tempfile.TemporaryDirectory() as d:
+        with scope_guard(engines[0].scope):
+            fluid.io.save_sharded(
+                Executor(), d,
+                main_program=engines[0].model.step["main"])
+        futs = [fleet.submit(p, max_new_tokens=b)
+                for p, b in zip(PROMPTS, BUDGETS)]
+        info = fleet.reload(d)
+        outs = [f.result(300).tokens.tolist() for f in futs]
+    assert outs == control_tokens, "reload perturbed in-flight tokens"
+    assert info["version"] == 1 and info["compiles"] == 0
+    assert info["pause_ms_max"] > 0
+    snap = fleet.snapshot()
+    assert snap["failed"] == 0
+    assert snap["reloads"] == 2 and snap["reload_pause_ms"] > 0
+    assert snap["post_warmup_compiles"] == 0, snap
+    post = fleet.generate(PROMPTS[0], max_new_tokens=4, timeout_s=300)
+    assert post.model_version == 1
+    assert post.tokens.tolist() == control_tokens[0][:4]
+    assert fleet.model_version == 1
+    assert all(e.model_version == 1 for e in engines)
+    fleet.close()
+
+
+# -- structured evacuation / failure surface --------------------------------
+
+@pytest.mark.slow
+def test_evacuate_returns_requeueable_descriptors(control_tokens):
+    eng = _engine().start()
+    futs = [eng.submit(p, max_new_tokens=b)
+            for p, b in zip(PROMPTS[:3], BUDGETS[:3])]
+    deadline = time.monotonic() + 60
+    while (eng.stats.tokens_generated < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.002)
+    descs = eng.evacuate()
+    assert len(descs) == 3
+    for f, d, p, b in zip(futs, descs, PROMPTS[:3], BUDGETS[:3]):
+        exc = f.exception(timeout=10)
+        assert isinstance(exc, DecodeReplicaFailedError)
+        wire = exc.as_dict()
+        assert wire["error"] == "decode_replica_failed"
+        assert wire["retryable"] is True
+        assert wire["reason"] == "evacuated"
+        assert wire["descriptor"]["prompt"] == [int(t) for t in p]
+        assert wire["descriptor"]["max_new_tokens"] == b
+        assert (wire["descriptor"]["committed_tokens"]
+                == len(wire["descriptor"]["generated"]))
+    assert eng.stats.snapshot()["evacuations"] == 3
+    # the engine keeps serving, and a requeued descriptor regenerates
+    # token-identically, reproducing the committed prefix
+    d0 = descs[0]
+    regen = eng.generate(np.asarray(d0["prompt"]),
+                         max_new_tokens=d0["max_new_tokens"],
+                         timeout_s=300).tolist()
+    assert regen == control_tokens[0]
+    assert regen[:d0["committed_tokens"]] == d0["generated"]
+    eng.close()
+
+
+@pytest.mark.slow
+def test_scheduler_death_resolves_futures_structured():
+    eng = _engine()
+    eng.set_replica_id(7)
+    eng.start()
+    futs = [eng.submit(p, max_new_tokens=b)
+            for p, b in zip(PROMPTS[:2], BUDGETS[:2])]
+    chaos.kill_replica(eng)
+    for f in futs:
+        exc = f.exception(timeout=60)
+        assert isinstance(exc, DecodeReplicaFailedError)
+        wire = exc.as_dict()
+        assert wire["retryable"] is True
+        assert wire["reason"] == "scheduler_failed"
+        assert "ChaosKilled" in wire["cause"]
+        assert wire["replica_id"] == 7
+        assert wire["descriptor"]["prompt"]
+    # a dead scheduler stops accepting with the structured closed error
+    from paddle_tpu.serving import ServingClosedError
+
+    with pytest.raises(ServingClosedError):
+        eng.submit(PROMPTS[0], max_new_tokens=2)
+    eng.close()
+
+
+@pytest.mark.slow
+def test_reload_shape_mismatch_rejected():
+    eng = _engine().start()
+    before = eng.generate(PROMPTS[0], max_new_tokens=3,
+                          timeout_s=300).tolist()
+    bad = {n: np.zeros((3, 3), np.float32) for n in eng._params}
+    with pytest.raises(WeightReloadError) as e:
+        eng.reload(bad)
+    wire = e.value.as_dict()
+    assert wire["error"] == "weight_reload" and wire["mismatched"]
+    assert eng.model_version == 0  # old weights keep serving
+    assert eng.generate(PROMPTS[0], max_new_tokens=3,
+                        timeout_s=300).tolist() == before
+    # refusing to swap under a live generation is also structured
+    fut = eng.submit(PROMPTS[2], max_new_tokens=30)
+    good = {n: np.asarray(v) for n, v in eng._params.items()}
+    with pytest.raises(WeightReloadError) as e2:
+        eng.reload(good)
+    assert "evacuate" in str(e2.value)
+    fut.result(300)
+    eng.close()
+
+
+# -- routing: saturation + hedging ------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_saturated_fast_reject_structured():
+    def tiny():
+        cfg = DecodeConfig(num_slots=1, page_size=4, max_len=48,
+                           num_pages=12, prefill_buckets=(8, 16),
+                           decode_chunk=2, kv_dtype="float32")
+        return DecodeEngine(_lm(), cfg, memory_budget_bytes=False,
+                            queue_capacity=1)
+
+    engines = [tiny(), tiny()]
+    fleet = Fleet(engines, FleetConfig()).start()
+    futs = [fleet.submit(p, max_new_tokens=20) for p in PROMPTS[:2]]
+    with pytest.raises(FleetSaturatedError) as e:
+        fleet.submit(PROMPTS[2], max_new_tokens=20)
+    wire = e.value.as_dict()
+    assert wire["error"] == "fleet_saturated"
+    assert {r["reject"] for r in wire["rejects"]} == {"queue_full"}
+    assert len(wire["replicas"]) == 2
+    assert fleet.stats.snapshot()["saturated"] == 1
+    for f in futs:  # accepted work still completes
+        assert len(f.result(300).tokens) == 20
+    fleet.close()
+
+
+@pytest.mark.slow
+def test_hedging_beats_straggler_replica(control_tokens):
+    engines = [_engine(), _engine()]
+    fleet = Fleet(engines, FleetConfig(hedge_after_ms=100)).start()
+    # replica 0 (first pick: least-loaded tie breaks on id) stalls for
+    # 2 s; the hedge duplicate on replica 1 must win long before that
+    chaos.delay_replica(engines[0], 2.0)
+    t0 = time.monotonic()
+    resp = fleet.generate(PROMPTS[0], max_new_tokens=4, timeout_s=300)
+    elapsed = time.monotonic() - t0
+    assert resp.tokens.tolist() == control_tokens[0][:4]
+    assert resp.replica_id == 1
+    assert elapsed < 1.9, f"hedge did not beat the straggler: {elapsed}"
+    snap = fleet.stats.snapshot()
+    assert snap["hedges"] >= 1 and snap["hedge_wins"] >= 1
+    fleet.close()
+
+
+# -- cross-replica stats aggregation ----------------------------------------
+
+def test_decode_stats_merge_sums_and_rejects_mismatch():
+    a, b = DecodeStats(), DecodeStats()
+    a.record_submit()
+    b.record_submit()
+    b.record_submit()
+    a.record_prefill(2, [1.0, 2.0])
+    b.record_prefill(1, [3.0])
+    a.record_decode(4, 2, 2, 6, 5, 10, 12.0)
+    b.record_decode(2, 1, 2, 2, 8, 10, 4.0)
+    a.record_preemption()
+    b.record_reload(7.5)
+    a.merge(b)
+    s = a.snapshot()
+    assert s["submitted"] == 3
+    assert s["prefill_joins"] == 3
+    assert s["tokens_generated"] == (2 + 6) + (1 + 2)
+    assert s["ttft_ms"]["count"] == 3
+    assert s["tpot_ms"]["count"] == 2
+    assert s["peak_pages_in_use"] == 8
+    assert s["reloads"] == 1 and s["reload_pause_ms"] == 7.5
+    # exact weighted occupancy: (2*4 + 1*2) / (2*4 + 2*2)
+    assert s["slot_occupancy"] == round(10 / 12, 4)
+    # config mismatches are rejected, not silently mis-merged
+    with pytest.raises(TypeError):
+        ServingStats().merge(DecodeStats())
+    odd = DecodeStats()
+    odd.ttft_ms = LatencyHistogram(bins_per_decade=10)
+    with pytest.raises(ValueError):
+        DecodeStats().merge(odd)
+
+
+def test_serving_stats_merge():
+    a, b = ServingStats(), ServingStats()
+    for s_ in (a, b):
+        s_.record_submit(3)
+        s_.record_batch(2, 4, 8.0, 16.0, 5.0)
+        s_.record_done(11.0)
+    b.record_shed()
+    b.record_reload(3.25)
+    a.merge(b)
+    s = a.snapshot()
+    assert s["submitted"] == 2 and s["completed"] == 2
+    assert s["shed"] == 1 and s["batches"] == 2
+    assert s["reloads"] == 1 and s["reload_pause_ms"] == 3.25
+    assert s["e2e_ms"]["count"] == 2 and s["exec_ms"]["count"] == 2
+    assert s["batch_occupancy"] == round(4 / 8, 4)
+
+
+# -- the serving (single-shot) fleet kind -----------------------------------
+
+@pytest.fixture(scope="module")
+def mlp_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleet_mlp"))
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", shape=[16], append_batch_size=True)
+        h = fluid.layers.fc(x, size=32, act="relu")
+        pred = fluid.layers.fc(h, size=4)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+    return d
+
+
+@pytest.mark.slow
+def test_serving_fleet_failover_and_reload(mlp_dir):
+    """The single-shot kind: a killed dispatch fails over to the other
+    replica (same answer), and a rolling reload swaps the live
+    predictor params with zero recompiles and a version tag."""
+    rng = np.random.RandomState(3)
+    xs = rng.rand(8, 16).astype(np.float32)
+    ref = fluid.Predictor(mlp_dir)
+    refs = [ref.run({"x": xs[i:i + 1]})[0][0] for i in range(8)]
+
+    def mk():
+        return ServingEngine(mlp_dir, {"x": np.zeros(16, np.float32)},
+                             buckets=BucketConfig((1, 2, 4)),
+                             max_wait_ms=2.0)
+
+    engines = [mk(), mk()]
+    fleet = Fleet(engines, FleetConfig()).start()
+    chaos.kill_replica(engines[0])  # next dispatch on 0 fails once
+    resps = [fleet.infer({"x": xs[i]}, timeout_s=120) for i in range(8)]
+    for i, r in enumerate(resps):
+        np.testing.assert_allclose(r.outputs[0], refs[i], rtol=1e-5,
+                                   atol=1e-6)
+    snap = fleet.snapshot()
+    assert snap["failed"] == 0 and snap["failovers"] >= 1
+    assert snap["post_warmup_compiles"] == 0, snap
+    # neither replica died (a failed dispatch is transient): both route
+    assert all(not h.dead for h in fleet.replicas)
+
+    info = fleet.reload(
+        {n: np.asarray(v)
+         for n, v in engines[0].predictor._params.items()})
+    assert info["version"] == 1 and info["compiles"] == 0
+    r = fleet.infer({"x": xs[0]}, timeout_s=120)
+    assert r.model_version == 1
+    np.testing.assert_allclose(r.outputs[0], refs[0], rtol=1e-5,
+                               atol=1e-6)
+    assert fleet.snapshot()["post_warmup_compiles"] == 0
+    fleet.close()

@@ -176,6 +176,30 @@ def check_schema(candidate):
                                   f"missing {field!r} (numerics "
                                   f"observability, docs/OBSERVE.md "
                                   f"pillar 6)")
+        if name.startswith("serving_fleet"):
+            # fleet contract (ISSUE 14, docs/SERVING.md §fleet): a
+            # replicated-serving entry must carry the offered-load
+            # throughput, the failover/hedge/retry evidence, the
+            # reload pause, and the fleet-wide zero-recompile proof —
+            # a req/s number that silently dropped requests or
+            # recompiled mid-roll is not a resilience number
+            for field in ("requests_per_sec", "failover_count",
+                          "hedged", "retried", "reload_pause_ms",
+                          "post_warmup_compiles"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: fleet entry "
+                                  f"missing {field!r} (fleet "
+                                  f"resilience contract)")
+            if entry.get("post_warmup_compiles"):
+                errors.append(
+                    f"detail.{name}: {entry['post_warmup_compiles']} "
+                    f"post-warmup compile(s) — a shape leaked or a "
+                    f"reload recompiled (the fleet-wide zero-recompile "
+                    f"contract)")
+            if entry.get("zero_client_failures") is False:
+                errors.append(
+                    f"detail.{name}: client-visible failures during "
+                    f"the chaos run (the zero-failure fleet contract)")
         if name.startswith("serving_decode"):
             # decode contract (ISSUE 12, docs/SERVING.md §decode): a
             # continuous-batching decode entry must carry the

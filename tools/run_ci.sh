@@ -347,6 +347,108 @@ print("decode bench smoke OK:",
 EOF
 python tools/perf_gate.py --schema --candidate /tmp/bench_decode_line.json
 
+echo "== serving fleet chaos smoke (cpu) =="
+# ISSUE 14 tentpole: kill one replica mid-stream under load -> zero
+# client-visible failures and every output token-identical to an
+# uninterrupted control engine (greedy failover identity, committed
+# prefixes verified); then fleet.reload() rolls the SAME weights
+# through the survivors under load -> zero drops, zero recompiles,
+# responses tagged with the new model version.  Fleet-wide
+# post_warmup_compiles stays 0 across both events.
+python - <<'EOF'
+import tempfile, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu.core.executor import Executor, scope_guard
+from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import DecodeConfig, DecodeEngine, Fleet, FleetConfig
+
+def mk():
+    lm = DecoderLM(vocab_size=96, n_layer=2, n_head=2, d_model=32,
+                   d_inner=64, kv_dtype="float32", seed=5)
+    cfg = DecodeConfig(num_slots=2, page_size=4, max_len=48,
+                       num_pages=24, prefill_buckets=(8, 16),
+                       decode_chunk=2, kv_dtype="float32")
+    return DecodeEngine(lm, cfg, memory_budget_bytes=False)
+
+prompts = make_prompts(6, 96, min_len=3, max_len=12, seed=9)
+budgets = [18, 16, 20, 14, 18, 16]
+
+ctrl = mk().start()
+control = [ctrl.generate(p, max_new_tokens=b, timeout_s=300).tolist()
+           for p, b in zip(prompts, budgets)]
+ctrl.close()
+
+engines = [mk(), mk()]
+fleet = Fleet(engines, FleetConfig()).start()
+futs = [fleet.submit(p, max_new_tokens=b)
+        for p, b in zip(prompts, budgets)]
+end = time.monotonic() + 60
+while engines[0].stats.tokens_generated < 2 and time.monotonic() < end:
+    time.sleep(0.002)
+chaos.kill_replica(engines[0])  # mid-generation replica death
+outs = [f.result(300).tokens.tolist() for f in futs]
+snap = fleet.snapshot()
+assert outs == control, "failover broke greedy token identity"
+assert snap["failed"] == 0 and snap["failovers"] >= 1, snap
+assert snap["parity_checked"] >= 1 and snap["parity_failed"] == 0, snap
+assert snap["ejects"] == 1 and snap["post_warmup_compiles"] == 0, snap
+
+with tempfile.TemporaryDirectory() as d:
+    with scope_guard(engines[1].scope):
+        fluid.io.save_sharded(Executor(), d,
+                              main_program=engines[1].model.step["main"])
+    futs = [fleet.submit(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    info = fleet.reload(d)          # rolling swap under load
+    outs2 = [f.result(300).tokens.tolist() for f in futs]
+    post = fleet.generate(prompts[0], max_new_tokens=4, timeout_s=300)
+assert outs2 == control, "reload perturbed in-flight tokens"
+assert info["compiles"] == 0 and info["version"] == 1, info
+assert post.model_version == 1, post.model_version
+snap = fleet.snapshot()
+assert snap["failed"] == 0 and snap["post_warmup_compiles"] == 0, snap
+fleet.close()
+print("fleet chaos smoke OK:",
+      {k: snap[k] for k in ("completed", "failovers", "parity_checked",
+                            "ejects", "reloads", "reload_pause_ms",
+                            "post_warmup_compiles")})
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+
+echo "== fleet bench line + schema gate (cpu) =="
+# the --model serving_fleet entry must print one JSON line carrying
+# the failover/hedge/retry counters, reload_pause_ms, and the
+# fleet-wide zero-recompile proof, and satisfy perf_gate --schema
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "serving_fleet",
+     "--probe-timeout", "0"],
+    capture_output=True, text=True, timeout=900)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["serving_fleet"]
+assert "error" not in d, d
+assert d["requests_per_sec"] > 0 and d["post_warmup_compiles"] == 0, d
+assert d["zero_client_failures"] and d["failover_count"] >= 1, d
+for k in ("hedged", "retried", "reload_pause_ms", "ejects",
+          "model_version"):
+    assert k in d, k
+with open("/tmp/bench_fleet_line.json", "w") as f:
+    f.write(lines[-1])
+print("fleet bench smoke OK:",
+      {k: d[k] for k in ("requests_per_sec", "failover_count",
+                         "retried", "reload_pause_ms",
+                         "post_warmup_compiles")})
+EOF
+python tools/perf_gate.py --schema --candidate /tmp/bench_fleet_line.json
+
 echo "== resilience chaos smoke (cpu) =="
 # the fault-tolerance contract end-to-end (docs/RESILIENCE.md): inject
 # NaN at step 3 -> the guard skips exactly that update; corrupt the
