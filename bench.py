@@ -1059,12 +1059,19 @@ def bench_serving_engine(batch_size: int, n_requests: int = 0,
         per_req_rps = m / (time.perf_counter() - t0)
 
         # engine on the SAME predictor (shares device weights): bucket
-        # ladder {1, batch_size} keeps warmup to two compiles
+        # ladder {1, batch_size} keeps warmup to two compiles.  The
+        # pillar-7 tracer rides at sample_rate=0: per-phase histograms
+        # are exact over every request regardless of sampling, and the
+        # guard-discipline tests pin that tracing adds zero device work
+        from paddle_tpu.observe import ReqTracer
+
+        tracer = ReqTracer(sample_rate=0.0)
         engine = ServingEngine(
             predictor.clone(), {"data": imgs[0]},
             buckets=BucketConfig((1, batch_size)
                                  if batch_size > 1 else (1,)),
-            max_wait_ms=max_wait_ms, queue_capacity=4 * batch_size)
+            max_wait_ms=max_wait_ms, queue_capacity=4 * batch_size,
+            tracer=tracer)
         engine.start()
         n_clients = min(2 * batch_size, n_requests)
         errors = []
@@ -1092,6 +1099,11 @@ def bench_serving_engine(batch_size: int, n_requests: int = 0,
 
     _, kind = _peak_flops()
     e2e = snap["e2e_ms"]
+    phases = tracer.phase_summary()
+
+    def _ph(name, p):
+        return phases.get(name, {}).get(f"p{p}_ms")
+
     return {
         "requests_per_sec": round(n_requests / elapsed, 1),
         "per_request_rps": round(per_req_rps, 1),
@@ -1099,6 +1111,13 @@ def bench_serving_engine(batch_size: int, n_requests: int = 0,
                                   3),
         "p50_ms": e2e["p50_ms"], "p95_ms": e2e["p95_ms"],
         "p99_ms": e2e["p99_ms"],
+        # span-derived phase breakdown (observe pillar 7): where a
+        # request's time went — queueing vs batch padding vs the
+        # executable — next to the e2e percentiles they compose into
+        "queue_wait_ms_p50": _ph("queue_wait", 50),
+        "queue_wait_ms_p99": _ph("queue_wait", 99),
+        "batch_form_ms_p50": _ph("batch_form", 50),
+        "dispatch_ms_p50": _ph("dispatch", 50),
         "exec_per_req_ms": snap["exec_per_req_ms"],
         "batch_occupancy": snap["batch_occupancy"],
         "padding_waste": snap["padding_waste"],
@@ -1164,7 +1183,11 @@ def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
                        max_len=max_len, num_pages=num_pages,
                        prefill_buckets=buckets, decode_chunk=chunk,
                        kv_dtype=kv_dtype)
-    engine = DecodeEngine(lm, cfg, queue_capacity=4 * n_requests)
+    from paddle_tpu.observe import ReqTracer
+
+    tracer = ReqTracer(sample_rate=0.0)  # exact phase histograms only
+    engine = DecodeEngine(lm, cfg, queue_capacity=4 * n_requests,
+                          tracer=tracer)
     engine.start()
     prompts = make_prompts(n_requests, arch["vocab_size"],
                            min_len=prompt_lo, max_len=prompt_hi,
@@ -1197,6 +1220,13 @@ def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
         "ttft_p50_ms": snap["ttft_ms"]["p50_ms"],
         "ttft_p95_ms": snap["ttft_ms"]["p95_ms"],
         "tpot_p50_ms": snap["tpot_ms"]["p50_ms"],
+        # span-derived phase breakdown (observe pillar 7): how long a
+        # request waited to JOIN an open slot vs the dispatches that
+        # served it — the continuous-batching decomposition of TTFT
+        "join_wait_ms_p50": tracer.phase_summary()
+        .get("join_wait", {}).get("p50_ms"),
+        "dispatch_ms_p50": tracer.phase_summary()
+        .get("dispatch", {}).get("p50_ms"),
         "slot_occupancy": snap["slot_occupancy"],
         "kv_page_utilization": snap["kv_page_utilization"],
         "peak_pages_in_use": snap["peak_pages_in_use"],
@@ -1287,8 +1317,11 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
         return DecodeEngine(lm, cfg, queue_capacity=4 * n_requests,
                             memory_budget_bytes=False)
 
+    from paddle_tpu.observe import ReqTracer
+
+    tracer = ReqTracer(sample_rate=0.0)  # tail (failovers) still kept
     engines = [mk_engine() for _ in range(n_replicas)]
-    fleet = Fleet(engines, FleetConfig()).start()
+    fleet = Fleet(engines, FleetConfig(), tracer=tracer).start()
     prompts = make_prompts(n_requests, arch["vocab_size"],
                            min_len=prompt_lo, max_len=prompt_hi, seed=0)
     rng = np.random.RandomState(1)
@@ -1313,6 +1346,7 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
     snap = fleet.snapshot()
     survivors = [h.engine for h in fleet.replicas if not h.dead]
     mem = _decode_mem(survivors[0]) if survivors else {}
+    phases = fleet.tracer.phase_summary()
     fleet.close()
     tokens_total = sum(len(r.tokens) for r in outs)
     assert snap["failed"] == 0, snap
@@ -1339,6 +1373,11 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
         "post_warmup_compiles": snap["post_warmup_compiles"],
         "e2e_p50_ms": snap["e2e_ms"]["p50_ms"],
         "e2e_p99_ms": snap["e2e_ms"]["p99_ms"],
+        # span-derived phase breakdown (observe pillar 7), fleet-wide
+        # across replicas and failover hops
+        "join_wait_ms_p50": phases.get("join_wait", {}).get("p50_ms"),
+        "dispatch_ms_p50": phases.get("dispatch", {}).get("p50_ms"),
+        "failover_ms_p50": phases.get("failover", {}).get("p50_ms"),
         "num_slots": num_slots, "page_size": page,
         "decode_chunk": chunk, "kv_dtype": "bfloat16",
         "device": kind,

@@ -188,6 +188,10 @@ class Trainer:
         self._resume_reader_state = None
         self.ckpt_stats = {"saves": 0, "blocking_ms": 0.0,
                            "write_ms": 0.0, "bytes": 0}
+        self.last_telemetry = None     # newest StepTelemetry window
+        #                                (the metrics-registry source)
+        self._metrics_registry = None
+        self._metrics_server = None
         self._event_log = None
         if self.telemetry_cfg is not None:
             from .. import observe
@@ -738,6 +742,62 @@ class Trainer:
                     worst_update_ratio=wr)
         return now
 
+    # -- unified metrics export (observe pillar 7) ------------------------
+    def metrics_registry(self):
+        """One MetricsRegistry over this trainer's surfaces: the
+        latest telemetry window (incl. per-group numerics when pillar
+        6 is on), checkpoint-cost gauges, and the process-wide
+        runtime/process/memory collectors.  Built once, cached."""
+        if self._metrics_registry is None:
+            from ..observe.registry import (MetricsRegistry, gauge,
+                                            standard_collectors,
+                                            telemetry_collector)
+
+            reg = standard_collectors(MetricsRegistry())
+            reg.register("training",
+                         telemetry_collector(
+                             lambda: self.last_telemetry))
+
+            def ckpt_collect():
+                s = self.ckpt_stats
+                return [
+                    gauge("ckpt_saves_total", "checkpoints saved",
+                          s["saves"]),
+                    gauge("ckpt_blocking_ms",
+                          "last blocking snapshot time",
+                          s["blocking_ms"]),
+                    gauge("ckpt_write_ms",
+                          "last background write time",
+                          s["write_ms"]),
+                    gauge("ckpt_bytes", "last checkpoint bytes",
+                          s["bytes"]),
+                ]
+
+            reg.register("checkpoint", ckpt_collect)
+            self._metrics_registry = reg
+        return self._metrics_registry
+
+    def start_metrics_server(self, host: str = "127.0.0.1",
+                             port: int = 0):
+        """Opt-in /metrics + /healthz endpoint for a training run
+        (binds localhost by default; port=0 = ephemeral).  Stopped by
+        stop()."""
+        if self._metrics_server is not None:
+            return self._metrics_server
+        from ..observe.registry import MetricsServer
+
+        def health():
+            return {"state": "training",
+                    "last_window_steps":
+                        (self.last_telemetry.steps
+                         if self.last_telemetry is not None else 0),
+                    "ckpt": dict(self.ckpt_stats)}
+
+        self._metrics_server = MetricsServer(
+            self.metrics_registry(), health_fn=health,
+            host=host, port=port).start()
+        return self._metrics_server
+
     def save_params(self, dirname: str):
         with scope_guard(self.scope):
             fluid_io.save_params(self.exe, dirname,
@@ -752,6 +812,9 @@ class Trainer:
                 main_program=self.train_program)
 
     def stop(self):
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         if self._ckpt_writer is not None:
             # flush the writer; a silently-dropped last checkpoint must
             # surface here, not on the next preemption

@@ -37,6 +37,21 @@ Three pillars (docs/OBSERVE.md):
    compiling the candidate.  serving.ServingEngine validates its
    bucket ladder with it; bench.py entries carry `mem_breakdown`.
 
+7. PER-REQUEST TRACING + METRICS EXPORT — `reqtrace.py` threads a
+   host-side `RequestTrace` (monotonic spans at queue boundaries
+   only: zero device round-trips) through the serving stack —
+   admission, batch formation, dispatch, decode joins, preemption,
+   failover/hedge hops — with head sampling plus tail-based keep
+   (slow/error/failover always survive), a bounded ring, and
+   `export_chrome_trace` (rows = replica, so one trace draws across
+   replica rows under chaos); `registry.py` is the pull-model
+   `MetricsRegistry` joining every subsystem's existing snapshot
+   surface (StepTelemetry, RuntimeStats, Serving/Decode/Fleet stats,
+   gang heartbeat skew, memory peaks) into `metrics_snapshot()`,
+   Prometheus text exposition (LatencyHistogram log bins mapped
+   exactly onto cumulative `le` buckets), and an opt-in localhost
+   `MetricsServer` (/metrics + /healthz) on Fleet/Trainer.
+
 6. NUMERICS — `numerics.py` (the production replacement for the
    reference's host-side per-op NaN scan, operator.cc:943): per-layer
    training dynamics (grad/param norms + update ratio per NAMED
@@ -54,10 +69,11 @@ from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    device_peaks, flash_boundary_layout,
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
-from .events import (FLEET_EVENTS, GANG_EVENTS,  # noqa: F401
-                     NUMERICS_EVENTS, RESILIENCE_EVENTS, SERVING_EVENTS,
-                     BoundEventLog, RunEventLog, git_sha, new_run_id,
-                     read_events)
+from .events import (DECODE_EVENTS, FLEET_EVENTS,  # noqa: F401
+                     GANG_EVENTS, NUMERICS_EVENTS, RESILIENCE_EVENTS,
+                     SERVING_EVENTS, BoundEventLog, RunEventLog,
+                     git_sha, new_run_id, read_events,
+                     register_event_kinds, set_strict_kinds)
 from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401
                      device_memory_budget, export_chrome_trace,
                      format_memory_table, memory_report, memory_table,
@@ -74,6 +90,15 @@ from .numerics import (GROUP_NAMES, enable_numerics,  # noqa: F401
                        join_first_nonfinite, numerics_enabled,
                        numerics_report, param_groups,
                        worst_update_ratio)
+from .registry import (MetricFamily, MetricsRegistry,  # noqa: F401
+                       MetricsServer, default_registry, fleet_collector,
+                       gang_collector, memory_collector,
+                       metrics_snapshot, process_collector,
+                       runtime_collector, serving_stats_collector,
+                       standard_collectors, telemetry_collector,
+                       tracer_collector)
+from .reqtrace import (TAIL_KEEP_MARKS, ReqTracer,  # noqa: F401
+                       RequestTrace, Span, new_trace_id)
 from .trace import fluid_op_of, format_op_table, op_time_table  # noqa: F401
 
 

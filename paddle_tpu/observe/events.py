@@ -15,6 +15,7 @@ import os
 import sys
 import time
 import uuid
+import warnings
 from typing import Any, Dict, List, Optional
 
 
@@ -31,6 +32,25 @@ SERVING_EVENTS = (
     "serving_breaker_open",         # LOUD: executor failure burst —
     #                                 admission flipped to DEGRADED
     "serving_breaker_close",        # half-open probe succeeded; RUNNING
+    "serving_reload",               # hot weight swap applied (version,
+    #                                 pause_ms) — ISSUE 15 straggler:
+    #                                 emitted since PR 14, unregistered
+)
+
+# continuous-batching decode event kinds (docs/SERVING.md §decode) —
+# the ISSUE 15 registry-enforcement sweep flushed these out: every one
+# had been emitted since PR 12 without a registry entry, exactly the
+# silent-typo rot the hang_kind collision (PR 9) showed
+DECODE_EVENTS = (
+    "serving_decode_start",        # engine geometry at start()
+    "serving_decode_memory_plan",  # plan_fit gate verdict pre-warmup
+    "serving_decode_warmup",       # executable precompile summary
+    "serving_decode_window",       # periodic DecodeStats snapshot
+    "serving_decode_drain",        # final snapshot at drain
+    "serving_decode_preempt",      # a slot was evicted (pool dry)
+    "serving_decode_evacuate",     # requests pulled off the replica
+    #                                (weight roll / scheduler death)
+    "serving_decode_reload",       # hot weight swap applied
 )
 
 # serving-fleet event kinds (docs/SERVING.md §fleet): the router layer
@@ -108,6 +128,55 @@ NUMERICS_EVENTS = (
     #                          loss scale, so a skipped update is
     #                          attributable without re-running anything
 )
+
+
+# ---------------------------------------------------------------------------
+# Event-kind validation (ISSUE 15 satellite): a dashboard's filter is a
+# string match, so a typo'd kind silently drops off every chart — the
+# PR 9 hang_kind-vs-kind collision class.  Kinds under the dashboard
+# prefixes are validated against the registries above: warn by default,
+# raise under tests (strict).
+# ---------------------------------------------------------------------------
+
+_VALIDATED_PREFIXES = ("serving_", "fleet_", "gang_")
+_KNOWN_KINDS = set(SERVING_EVENTS) | set(DECODE_EVENTS) \
+    | set(FLEET_EVENTS) | set(GANG_EVENTS) | set(RESILIENCE_EVENTS) \
+    | set(NUMERICS_EVENTS)
+_strict_kinds = [False]
+_warned_kinds: set = set()
+
+
+def set_strict_kinds(flag: bool) -> bool:
+    """Unknown validated-prefix kinds raise instead of warning.
+    Returns the previous setting (tests flip and restore); the
+    PADDLE_TPU_STRICT_EVENTS env var also enables it."""
+    prev = _strict_kinds[0]
+    _strict_kinds[0] = bool(flag)
+    return prev
+
+
+def register_event_kinds(*kinds: str) -> None:
+    """Extend the known-kind registry (a subsystem adding a new
+    dashboard event registers it here — or in the tuples above when it
+    ships in-tree)."""
+    _KNOWN_KINDS.update(kinds)
+
+
+def _validate_kind(kind: str) -> None:
+    if not kind.startswith(_VALIDATED_PREFIXES) \
+            or kind in _KNOWN_KINDS:
+        return
+    msg = (f"event kind {kind!r} matches a dashboard prefix "
+           f"{_VALIDATED_PREFIXES} but is not registered in "
+           f"observe.events (SERVING/DECODE/FLEET/GANG registries) — "
+           f"a typo here silently drops the event off every dashboard "
+           f"filter; register it with register_event_kinds() or fix "
+           f"the name")
+    if _strict_kinds[0] or os.environ.get("PADDLE_TPU_STRICT_EVENTS"):
+        raise ValueError(msg)
+    if kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        warnings.warn(msg, stacklevel=3)
 
 
 def new_run_id() -> str:
@@ -219,7 +288,11 @@ class RunEventLog:
         self._bytes += len(line)
 
     def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
-        """Append one event record (flushed immediately)."""
+        """Append one event record (flushed immediately).  Kinds under
+        the dashboard prefixes (serving_/fleet_/gang_) are validated
+        against the registries at the top of this module — warn by
+        default, raise under strict mode (tests)."""
+        _validate_kind(kind)
         rec = {"ts": round(time.time(), 3), "run_id": self.run_id,
                "event": kind}
         rec.update(fields)

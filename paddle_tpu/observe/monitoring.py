@@ -203,6 +203,23 @@ class LatencyHistogram:
                 self.max_ms = o_max
         return self
 
+    def cumulative_buckets(self):
+        """[(upper_edge_ms, cumulative_count), ...] over the non-empty
+        bins — the Prometheus `le` mapping: each log-spaced bin's upper
+        edge becomes an `le` value and the counts are exact prefix
+        sums, so a scraped histogram reproduces this histogram's
+        percentiles to bin resolution (the exposition contract of
+        observe.registry; pinned by tests)."""
+        with self._lock:
+            counts = list(self._counts)
+        out = []
+        acc = 0
+        for i, c in enumerate(counts):
+            if c:
+                acc += c
+                out.append((self._edge(i), acc))
+        return out
+
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100] → latency ms (bin upper edge), None if empty."""
         with self._lock:

@@ -41,16 +41,19 @@ from .admission import (AdmissionController, DeadlineExceededError,
 class Request:
     """One accepted request: normalized per-example feeds + routing."""
 
-    __slots__ = ("feeds", "future", "deadline", "t_submit", "max_len")
+    __slots__ = ("feeds", "future", "deadline", "t_submit", "max_len",
+                 "trace")
 
     def __init__(self, feeds: Dict[str, np.ndarray],
                  deadline: Optional[float] = None,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None, trace=None):
         self.feeds = feeds
         self.future: Future = Future()
         self.deadline = deadline          # absolute time.monotonic()
         self.t_submit = time.monotonic()
         self.max_len = max_len            # ragged length (None = dense)
+        self.trace = trace                # observe.reqtrace.RequestTrace
+        #                                   (None when tracing is off)
 
 
 class DynamicBatcher:

@@ -155,10 +155,11 @@ class DecodeRequest:
     """One accepted generation request."""
 
     __slots__ = ("prompt", "max_new_tokens", "priority", "future",
-                 "deadline", "t_submit", "preempted")
+                 "deadline", "t_submit", "preempted", "trace")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 priority: int = 0, deadline: Optional[float] = None):
+                 priority: int = 0, deadline: Optional[float] = None,
+                 trace=None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.priority = int(priority)
@@ -166,6 +167,7 @@ class DecodeRequest:
         self.deadline = deadline
         self.t_submit = time.monotonic()
         self.preempted = 0
+        self.trace = trace  # observe.reqtrace.RequestTrace (or None)
 
     def descriptor(self, generated: Optional[List[int]] = None
                    ) -> Dict[str, Any]:
@@ -260,8 +262,12 @@ class DecodeEngine:
                  stats_window: int = 64,
                  breaker: Union[CircuitBreaker, bool, None] = None,
                  memory_budget_bytes: Union[int, bool, None] = None,
-                 donate_pools: Optional[bool] = None):
+                 donate_pools: Optional[bool] = None, tracer=None):
         self.model = model
+        # observe pillar 7: per-request tracing (host spans only —
+        # join_wait, per-chunk dispatch, preempt/evacuated markers);
+        # None disables, fleet-passed traces ride through regardless
+        self.tracer = tracer
         self.config = config or DecodeConfig(kv_dtype=model.kv_dtype)
         if self.config.kv_dtype != model.kv_dtype:
             raise ValueError(
@@ -736,11 +742,16 @@ class DecodeEngine:
     # -- request path ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
                priority: int = 0,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               _trace=None) -> Future:
         """Accept one generation request; returns a Future of the
         generated token ids (np.int32, includes the eos token when one
         stopped it).  Raises DecodeBucketMissError / QueueFullError /
-        CircuitOpenError / ServingClosedError synchronously."""
+        CircuitOpenError / ServingClosedError synchronously.
+        `_trace`: a fleet router's RequestTrace to continue."""
+        trace = _trace
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.new_trace("decode")
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.size < 1:
             raise DecodeBucketMissError(
@@ -766,7 +777,8 @@ class DecodeEngine:
                 max_len=cfg.max_len)
         deadline = self.admission.deadline_for(deadline_ms)
         req = DecodeRequest(prompt.astype(np.int32), max_new_tokens,
-                            priority=priority, deadline=deadline)
+                            priority=priority, deadline=deadline,
+                            trace=trace)
         try:
             with self._cv:
                 self.admission.check(self._unresolved)
@@ -778,6 +790,11 @@ class DecodeEngine:
                 self.stats.record_shed()
             elif e.kind == "circuit_open":
                 self.stats.record_circuit_reject()
+            if trace is not None and not trace.fleet_owned \
+                    and self.tracer is not None:
+                trace.point("rejected", reject=e.kind,
+                            replica_id=self.replica_id)
+                self.tracer.finish(trace, error=e)
             raise
         self.stats.record_submit()
         return req.future
@@ -879,14 +896,23 @@ class DecodeEngine:
         for req, gen in victims:
             d = req.descriptor(gen)
             descs.append(d)
+            err = DecodeReplicaFailedError(
+                f"request pulled off replica "
+                f"{self.replica_id if self.replica_id is not None else '?'}"
+                f" ({reason}) after {len(gen)} committed token(s); "
+                f"requeue the descriptor on a surviving replica",
+                reason=reason, cause=cause,
+                replica_id=self.replica_id, descriptor=d)
+            if req.trace is not None:
+                # the failover hop itself is the ROUTER's span; the
+                # replica marks why the request left it
+                req.trace.point("evacuated", reason=reason,
+                                replica_id=self.replica_id,
+                                committed=len(gen))
+                if not req.trace.fleet_owned and self.tracer is not None:
+                    self.tracer.finish(req.trace, error=err)
             if not req.future.done():
-                req.future.set_exception(DecodeReplicaFailedError(
-                    f"request pulled off replica "
-                    f"{self.replica_id if self.replica_id is not None else '?'}"
-                    f" ({reason}) after {len(gen)} committed token(s); "
-                    f"requeue the descriptor on a surviving replica",
-                    reason=reason, cause=cause,
-                    replica_id=self.replica_id, descriptor=d))
+                req.future.set_exception(err)
         return descs
 
     def _fail_everything(self, exc: BaseException):
@@ -924,9 +950,14 @@ class DecodeEngine:
         with self._cv:
             self._unresolved -= 1
             self._cv.notify_all()
+        tr = slot.req.trace
+        own_trace = (tr is not None and not tr.fleet_owned
+                     and self.tracer is not None)
         if error is not None:
             if not slot.req.future.done():
                 slot.req.future.set_exception(error)
+            if own_trace:
+                self.tracer.finish(tr, error=error)
             return
         if not slot.req.future.done():
             # which weights produced this generation (a router's
@@ -935,6 +966,8 @@ class DecodeEngine:
             slot.req.future.set_result(
                 np.asarray(slot.generated, np.int32))
         self.stats.record_done()
+        if own_trace:
+            self.tracer.finish(tr)
 
     def _requeue(self, slot_id: int):
         """Preempt: pages returned, request re-enters the queue head
@@ -945,6 +978,10 @@ class DecodeEngine:
         self.page_pool.free(slot.pages)
         self._page_tables[slot_id, :] = 0
         slot.req.preempted += 1
+        if slot.req.trace is not None:
+            slot.req.trace.point(
+                "preempt", slot=slot_id, replica_id=self.replica_id,
+                committed=slot.committed, generated=len(slot.generated))
         with self._cv:
             self._queue.insert(0, slot.req)
         self.stats.record_preemption()
@@ -987,12 +1024,20 @@ class DecodeEngine:
                         self._queue.pop(0)
                         self._unresolved -= 1
                         self.stats.record_deadline_miss()
-                        cand.future.set_exception(
-                            DeadlineExceededError(
-                                "deadline expired before a slot "
-                                "opened",
-                                queued_ms=round(
-                                    (now - cand.t_submit) * 1e3, 3)))
+                        exc = DeadlineExceededError(
+                            "deadline expired before a slot opened",
+                            queued_ms=round(
+                                (now - cand.t_submit) * 1e3, 3))
+                        if cand.trace is not None:
+                            cand.trace.add(
+                                "join_wait", cand.t_submit, now,
+                                replica_id=self.replica_id,
+                                expired=True)
+                            if not cand.trace.fleet_owned \
+                                    and self.tracer is not None:
+                                self.tracer.finish(cand.trace,
+                                                   error=exc)
+                        cand.future.set_exception(exc)
                         continue
                     req = cand
                     break
@@ -1031,6 +1076,12 @@ class DecodeEngine:
             seq_len[i] = len(p)
             last_idx[i, 0] = len(p) - 1
         exec_ = self._prefill_execs[bucket]
+        t_p0 = time.monotonic()  # join_wait ends / prefill begins
+        for i in joiners:
+            tr = self._slots[i].req.trace
+            if tr is not None:
+                tr.add("join_wait", self._slots[i].req.t_submit, t_p0,
+                       replica_id=self.replica_id, slot=i)
         try:
             nxt, pools = exec_(self._params, jnp.asarray(tokens),
                                jnp.asarray(seq_len),
@@ -1044,9 +1095,23 @@ class DecodeEngine:
                 f"prefill dispatch failed for {len(joiners)} join(s): "
                 f"{type(e).__name__}: {e}",
                 error_type=type(e).__name__, joins=len(joiners))
+            t_p1 = time.monotonic()
+            for i in joiners:
+                tr = self._slots[i].req.trace
+                if tr is not None:
+                    tr.add("dispatch", t_p0, t_p1, kind="prefill",
+                           replica_id=self.replica_id, slot=i,
+                           error=type(e).__name__)
             for i in joiners:
                 self._resolve(i, error=err)
             return
+        t_p1 = time.monotonic()
+        for i in joiners:
+            tr = self._slots[i].req.trace
+            if tr is not None:
+                tr.add("dispatch", t_p0, t_p1, kind="prefill",
+                       bucket=bucket, replica_id=self.replica_id,
+                       slot=i)
         self._breaker_result(True, len(joiners))
         self._pools = pools
         nxt = np.asarray(nxt)
@@ -1125,6 +1190,7 @@ class DecodeEngine:
             active[i] = 1
             remaining[i] = slot.remaining
         t0 = time.perf_counter()
+        t_d0 = time.monotonic()
         try:
             (outbuf, steps, new_tok, new_wp, new_act, new_rem,
              pools) = self._decode_exec(
@@ -1139,10 +1205,24 @@ class DecodeEngine:
                 f"decode dispatch failed for {len(active_ids)} "
                 f"slot(s): {type(e).__name__}: {e}",
                 error_type=type(e).__name__, slots=len(active_ids))
+            t_d1 = time.monotonic()
+            for i in active_ids:
+                tr = self._slots[i].req.trace
+                if tr is not None:
+                    tr.add("dispatch", t_d0, t_d1, kind="decode",
+                           replica_id=self.replica_id, slot=i,
+                           error=type(e).__name__)
             for i in active_ids:
                 self._resolve(i, error=err)
             return
         elapsed_ms = (time.perf_counter() - t0) * 1e3
+        t_d1 = time.monotonic()
+        for i in active_ids:
+            tr = self._slots[i].req.trace
+            if tr is not None:
+                tr.add("dispatch", t_d0, t_d1, kind="decode",
+                       iterations=int(steps),
+                       replica_id=self.replica_id, slot=i)
         self._breaker_result(True, len(active_ids))
         self._pools = pools
         outbuf = np.asarray(outbuf)

@@ -149,6 +149,13 @@ class ServingEngine:
     warmup_deadline_s: wall-clock budget for the start() bucket-ladder
         warmup (resilience.Deadline): a hung XLA compile raises a
         structured WatchdogTimeout instead of stalling the rollout.
+    tracer: an observe.ReqTracer — per-request tracing (observe
+        pillar 7): every request carries a RequestTrace with host
+        spans at the queue boundaries (queue_wait / batch_form /
+        dispatch).  Purely host-side — zero extra device dispatches,
+        zero retraces, identical step lowering (pinned by tests).
+        None (default) disables tracing; a Fleet passes its own
+        traces through `submit(_trace=...)` regardless.
     memory_budget_bytes: device HBM budget the bucket ladder must fit.
         None (default) reads the live device budget
         (observe.memory.device_memory_budget(); None on backends that
@@ -172,7 +179,8 @@ class ServingEngine:
                  donate_feeds: Optional[bool] = None,
                  breaker: Union[CircuitBreaker, bool, None] = None,
                  warmup_deadline_s: Optional[float] = None,
-                 memory_budget_bytes: Union[int, bool, None] = None):
+                 memory_budget_bytes: Union[int, bool, None] = None,
+                 tracer=None):
         # duck-typed: anything with run()/compile_signature() serves
         # (a resilience.FlakyPredictor proxy in chaos tests, a custom
         # wrapper in production)
@@ -235,6 +243,7 @@ class ServingEngine:
             breaker = None
         self.warmup_deadline_s = warmup_deadline_s
         self.memory_budget_bytes = memory_budget_bytes
+        self.tracer = tracer
         self.fit_plan: Optional[Dict[str, Any]] = None
         self.admission = AdmissionController(
             queue_capacity, default_deadline_ms=default_deadline_ms,
@@ -243,8 +252,7 @@ class ServingEngine:
             self._dispatch, self.admission,
             max_batch_size=self.buckets.batch_sizes[-1],
             max_wait_ms=max_wait_ms,
-            on_deadline_miss=lambda _req:
-                self.stats.record_deadline_miss())
+            on_deadline_miss=self._on_deadline_miss)
         self._started = False
         self._lock = threading.Lock()
         # fleet surface: replica identity + live weight version
@@ -424,14 +432,21 @@ class ServingEngine:
 
     # -- request path ---------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               _trace=None) -> Future:
         """Accept one request (PER-EXAMPLE feeds, no batch dim) and
         return a Future of its fetch list.  Raises BucketMissError /
         QueueFullError / ServingClosedError synchronously — a rejected
-        request never occupies queue capacity."""
+        request never occupies queue capacity.  `_trace`: a fleet
+        router's RequestTrace to continue (the engine then only adds
+        spans; the router owns the trace lifecycle)."""
+        trace = _trace
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.new_trace("serving")
         feeds, max_len = self._normalize(feed)
         deadline = self.admission.deadline_for(deadline_ms)
-        req = Request(feeds, deadline=deadline, max_len=max_len)
+        req = Request(feeds, deadline=deadline, max_len=max_len,
+                      trace=trace)
         try:
             self.batcher.submit(req)
         except ServingError as e:
@@ -439,6 +454,11 @@ class ServingEngine:
                 self.stats.record_shed()
             elif e.kind == "circuit_open":
                 self.stats.record_circuit_reject()
+            if trace is not None and not trace.fleet_owned \
+                    and self.tracer is not None:
+                trace.point("rejected", reject=e.kind,
+                            replica_id=self.replica_id)
+                self.tracer.finish(trace, error=e)
             raise
         self.stats.record_submit(self.batcher.queue_depth)
         return req.future
@@ -451,6 +471,16 @@ class ServingEngine:
             timeout_s)
 
     # -- internals ------------------------------------------------------
+    def _on_deadline_miss(self, req: Request):
+        self.stats.record_deadline_miss()
+        tr = req.trace
+        if tr is not None and not tr.fleet_owned \
+                and self.tracer is not None:
+            tr.add("queue_wait", req.t_submit, time.monotonic(),
+                   replica_id=self.replica_id, expired=True)
+            self.tracer.finish(tr, error=RuntimeError(
+                "deadline expired while queued"))
+
     def _normalize(self, feed: Dict[str, np.ndarray]
                    ) -> Tuple[Dict[str, np.ndarray], Optional[int]]:
         unknown = set(feed) - set(self._data_names)
@@ -612,6 +642,7 @@ class ServingEngine:
     def _dispatch(self, requests: Sequence[Request]):
         """Batcher callback: pad to the smallest fitting bucket,
         dispatch ONE executable call, demux outputs to futures."""
+        t_form = time.monotonic()  # queue_wait ends / batch_form begins
         n = len(requests)
         bucket_b = BucketConfig.pick(self.buckets.batch_sizes, n)
         assert bucket_b is not None, (n, self.buckets.batch_sizes)
@@ -651,6 +682,14 @@ class ServingEngine:
                 elems_real += n * row
                 elems_padded += bucket_b * row
         version = self.model_version  # the weights this batch runs on
+        t_disp = time.monotonic()     # batch_form ends / dispatch begins
+        for r in requests:
+            if r.trace is not None:
+                r.trace.add("queue_wait", r.t_submit, t_form,
+                            replica_id=self.replica_id)
+                r.trace.add("batch_form", t_form, t_disp,
+                            replica_id=self.replica_id, batch=n,
+                            bucket=bucket_b)
         t0 = time.perf_counter()
         try:
             if self.replica_id is not None:
@@ -671,11 +710,26 @@ class ServingEngine:
             if self.admission.record_dispatch_result(False) == "opened":
                 self._breaker_event("serving_breaker_open",
                                     failed_batch_size=n)
-            raise ExecutorFailureError(
+            err = ExecutorFailureError(
                 f"executor dispatch failed for batch of {n}: "
                 f"{type(e).__name__}: {e}",
-                error_type=type(e).__name__, batch_size=n) from e
+                error_type=type(e).__name__, batch_size=n)
+            t_err = time.monotonic()
+            for r in requests:
+                if r.trace is not None:
+                    r.trace.add("dispatch", t_disp, t_err,
+                                replica_id=self.replica_id, batch=n,
+                                error=type(e).__name__)
+                    if not r.trace.fleet_owned \
+                            and self.tracer is not None:
+                        self.tracer.finish(r.trace, error=err)
+            raise err from e
         exec_ms = (time.perf_counter() - t0) * 1e3
+        t_done = time.monotonic()
+        for r in requests:
+            if r.trace is not None:
+                r.trace.add("dispatch", t_disp, t_done,
+                            replica_id=self.replica_id, batch=n)
         if self.admission.record_dispatch_result(True) == "closed":
             self._breaker_event("serving_breaker_close")
         self.stats.record_batch(n, bucket_b, elems_real, elems_padded,
@@ -690,4 +744,7 @@ class ServingEngine:
             r.future.model_version = version
             r.future.set_result(res)
             self.stats.record_done((now - r.t_submit) * 1e3)
+            if r.trace is not None and not r.trace.fleet_owned \
+                    and self.tracer is not None:
+                self.tracer.finish(r.trace)
         self.stats.maybe_emit()

@@ -97,20 +97,26 @@ class FailoverParityError(ServingError):
 class FleetResponse:
     """What a fleet future resolves to: the engine's result plus the
     routing provenance a caller needs to trust it — which replica
-    served it, under which weight version, and whether failover or
-    hedging was involved."""
+    served it, under which weight version, whether failover or hedging
+    was involved, and (with tracing on) the trace_id plus the ordered
+    replica hop chain, so "why was THIS request slow" is answerable
+    from the response alone."""
 
     __slots__ = ("value", "replica_id", "model_version", "failovers",
-                 "hedged", "attempts")
+                 "hedged", "attempts", "trace_id", "hops")
 
     def __init__(self, value, replica_id: int, model_version: int,
-                 failovers: int, hedged: bool, attempts: int):
+                 failovers: int, hedged: bool, attempts: int,
+                 trace_id: Optional[str] = None,
+                 hops: Sequence[int] = ()):
         self.value = value
         self.replica_id = replica_id
         self.model_version = model_version
         self.failovers = failovers
         self.hedged = hedged
         self.attempts = attempts
+        self.trace_id = trace_id
+        self.hops = list(hops)     # replica ids in attempt order
 
     @property
     def tokens(self):
@@ -299,10 +305,12 @@ class _FleetRequest:
 
     __slots__ = ("payload", "future", "deadline", "idempotent",
                  "t_submit", "lock", "resolved", "tried", "attempts",
-                 "failovers", "hedges", "prefix")
+                 "failovers", "hedges", "prefix", "trace", "hops",
+                 "pending_failover")
 
     def __init__(self, payload: Dict[str, Any],
-                 deadline: Optional[float], idempotent: bool):
+                 deadline: Optional[float], idempotent: bool,
+                 trace=None):
         self.payload = payload
         self.future: Future = Future()
         self.deadline = deadline        # absolute time.monotonic()
@@ -316,6 +324,11 @@ class _FleetRequest:
         self.hedges = 0
         self.prefix: List[int] = []     # committed tokens from a failed
         #                                 attempt (parity evidence)
+        self.trace = trace              # observe.reqtrace.RequestTrace
+        self.hops: List[int] = []       # replica ids in attempt order
+        # (t_detected, replica_id, reason) of a failover awaiting its
+        # landing replica — closed into a `failover` span on requeue
+        self.pending_failover: Optional[tuple] = None
 
     def remaining_ms(self) -> Optional[float]:
         if self.deadline is None:
@@ -343,10 +356,16 @@ class Fleet:
 
     def __init__(self, engines: Sequence, config: Optional[FleetConfig]
                  = None, event_log: Optional[RunEventLog] = None,
-                 log_path: Optional[str] = None):
+                 log_path: Optional[str] = None, tracer=None):
+        """tracer: an observe.ReqTracer — every submit() carries one
+        RequestTrace across routing, the replica's queue/dispatch
+        boundaries, and any failover/hedge hops (one trace_id per
+        logical request, observe pillar 7); responses then carry
+        `trace_id` + `hops`.  Host-side only; None disables."""
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         self.config = config or FleetConfig()
+        self.tracer = tracer
         decode = isinstance(engines[0], DecodeEngine)
         for e in engines:
             if isinstance(e, DecodeEngine) != decode:
@@ -373,6 +392,8 @@ class Fleet:
         self._closed = False
         self._started = False
         self._rolling = False
+        self._metrics_registry = None
+        self._metrics_server = None
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "Fleet":
@@ -400,6 +421,9 @@ class Fleet:
         if close_replicas:
             for h in self.replicas:
                 h.engine.close(timeout_s)
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         self._event("serving_fleet_close", **self.snapshot())
         if self._own_log is not None:
             self._own_log.close()
@@ -433,6 +457,48 @@ class Fleet:
         for h in self.replicas:
             agg.merge(h.engine.stats)
         return agg
+
+    def metrics_registry(self):
+        """The fleet's unified metrics surface (observe pillar 7): one
+        MetricsRegistry holding the router collector (per-replica
+        health/breaker gauges, failover/hedge counters), the
+        fleet-MERGED engine stats (pulled via merged_stats at scrape
+        time, so histograms aggregate exactly), the request tracer's
+        phase histograms when tracing is on, and the process-wide
+        runtime/process/memory collectors.  Built once, cached."""
+        if self._metrics_registry is None:
+            from ..observe.registry import (MetricsRegistry,
+                                            fleet_collector,
+                                            serving_stats_collector,
+                                            standard_collectors,
+                                            tracer_collector)
+
+            reg = standard_collectors(MetricsRegistry())
+            reg.register("fleet", fleet_collector(self))
+            reg.register("serving",
+                         serving_stats_collector(self.merged_stats,
+                                                 scope="fleet"))
+            if self.tracer is not None:
+                reg.register("reqtrace",
+                             tracer_collector(self.tracer))
+            self._metrics_registry = reg
+        return self._metrics_registry
+
+    def start_metrics_server(self, host: str = "127.0.0.1",
+                             port: int = 0):
+        """Opt-in /metrics + /healthz endpoint over this fleet's
+        registry (stdlib ThreadingHTTPServer; binds localhost unless
+        told otherwise — the exposition carries per-replica health
+        detail).  port=0 picks an ephemeral port; read `.port` / `.url`
+        off the returned MetricsServer.  Stopped by close()."""
+        if self._metrics_server is not None:
+            return self._metrics_server
+        from ..observe.registry import MetricsServer
+
+        self._metrics_server = MetricsServer(
+            self.metrics_registry(), health_fn=self.health,
+            host=host, port=port).start()
+        return self._metrics_server
 
     def snapshot(self) -> Dict[str, Any]:
         """Fleet counters + the merged per-replica engine telemetry
@@ -471,7 +537,12 @@ class Fleet:
                        "priority": int(priority)}
         else:
             payload = {"feed": request}
-        freq = _FleetRequest(payload, deadline, idempotent)
+        trace = None
+        if self.tracer is not None:
+            trace = self.tracer.new_trace(f"fleet_{self.kind}")
+            trace.fleet_owned = True  # engines add spans; WE finish it
+        freq = _FleetRequest(payload, deadline, idempotent,
+                             trace=trace)
         self.stats.record_submit()
         self._route_once(freq)
         if self.config.hedge_after_ms and freq.idempotent \
@@ -501,9 +572,11 @@ class Fleet:
         if self.kind == "decode":
             return handle.engine.submit(
                 p["prompt"], max_new_tokens=p["max_new_tokens"],
-                priority=p["priority"], deadline_ms=remaining_ms)
+                priority=p["priority"], deadline_ms=remaining_ms,
+                _trace=freq.trace)
         return handle.engine.submit(p["feed"],
-                                    deadline_ms=remaining_ms)
+                                    deadline_ms=remaining_ms,
+                                    _trace=freq.trace)
 
     def _route_once(self, freq: _FleetRequest,
                     hedge: bool = False) -> ReplicaHandle:
@@ -513,6 +586,7 @@ class Fleet:
         evidence otherwise."""
         if self._closed:
             raise FleetClosedError("fleet is closed", closed=True)
+        t_route = time.monotonic()
         remaining_ms = freq.remaining_ms()
         if remaining_ms is not None and remaining_ms <= 0:
             raise DeadlineExceededError(
@@ -554,6 +628,24 @@ class Fleet:
                 h.routed += 1
                 freq.tried.add(h.replica_id)
                 freq.attempts += 1
+                freq.hops.append(h.replica_id)
+            if freq.trace is not None:
+                now = time.monotonic()
+                freq.trace.add("route", t_route, now,
+                               replica_id=h.replica_id, hedge=hedge)
+                pf = freq.pending_failover
+                if pf is not None and not hedge:
+                    # the failover hop closes when the request LANDS
+                    # on its next replica: one span from detection to
+                    # requeue, naming the dead replica and the
+                    # survivor — the hop chain a chrome export renders
+                    # across replica rows
+                    freq.pending_failover = None
+                    t_det, dead_id, reason = pf
+                    freq.trace.add("failover", t_det, now,
+                                   from_replica=dead_id,
+                                   to_replica=h.replica_id,
+                                   reason=reason)
             fut.add_done_callback(
                 lambda f, h=h: self._on_attempt_done(freq, h, f, hedge))
             return h
@@ -575,6 +667,17 @@ class Fleet:
         with self._lock:
             h.inflight -= 1
         exc = fut.exception()
+        if freq.trace is not None:
+            with freq.lock:
+                already = freq.resolved
+            if already:
+                # a loser attempt (hedge or failover race) resolving
+                # after the request did: its work is abandoned — the
+                # marker tail-keeps the trace so a hedged request's
+                # timeline shows both attempts
+                freq.trace.point(
+                    "abandoned", replica_id=h.replica_id,
+                    error=None if exc is None else type(exc).__name__)
         if exc is None:
             h.breaker.record_success()
             h.last_ok_t = self.config.clock()
@@ -615,6 +718,9 @@ class Fleet:
             self._finish_err(freq, exc)
             return
         freq.failovers += 1
+        if freq.trace is not None and freq.pending_failover is None:
+            freq.pending_failover = (time.monotonic(), h.replica_id,
+                                     exc.kind)
         self.stats.record_failover()
         self._event("serving_fleet_failover",
                     replica_id=h.replica_id, reason=exc.kind,
@@ -676,16 +782,27 @@ class Fleet:
                             replica_id=h.replica_id, parity="FAILED",
                             **err.details)
                 self.stats.record_failed()
+                if freq.trace is not None and self.tracer is not None:
+                    self.tracer.finish(freq.trace, error=err)
                 freq.future.set_exception(err)
                 return
         if hedge:
             self.stats.record_hedge_win()
+        if freq.trace is not None:
+            freq.trace.point("complete", replica_id=h.replica_id,
+                             failovers=freq.failovers,
+                             hedged=freq.hedges > 0)
         resp = FleetResponse(
             value, replica_id=h.replica_id,
             model_version=getattr(fut, "model_version",
                                   h.engine.model_version),
             failovers=freq.failovers, hedged=freq.hedges > 0,
-            attempts=freq.attempts)
+            attempts=freq.attempts,
+            trace_id=(freq.trace.trace_id if freq.trace is not None
+                      else None),
+            hops=list(freq.hops))
+        if freq.trace is not None and self.tracer is not None:
+            self.tracer.finish(freq.trace)
         freq.future.set_result(resp)
         if self.stats.record_done(
                 (time.monotonic() - freq.t_submit) * 1e3):
@@ -697,6 +814,8 @@ class Fleet:
                 return
             freq.resolved = True
         self.stats.record_failed()
+        if freq.trace is not None and self.tracer is not None:
+            self.tracer.finish(freq.trace, error=exc)
         freq.future.set_exception(exc)
 
     # -- hedging --------------------------------------------------------
@@ -709,6 +828,9 @@ class Fleet:
             return  # hedging is opportunistic; the primary stands
         with freq.lock:
             freq.hedges += 1
+        if freq.trace is not None:
+            freq.trace.point("hedge", replica_id=h.replica_id,
+                             after_ms=self.config.hedge_after_ms)
         self.stats.record_hedge()
         self._event("serving_fleet_hedge", replica_id=h.replica_id,
                     after_ms=self.config.hedge_after_ms)

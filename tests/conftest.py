@@ -25,3 +25,11 @@ jax.config.update("jax_platforms", "cpu")
 # Numeric comparisons against float64 numpy references need full-precision
 # matmuls; the framework itself keeps the fast TPU default.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# Event-kind registry enforcement (ISSUE 15): under tests an
+# unregistered serving_/fleet_/gang_ event kind RAISES instead of
+# warning — a typo'd kind silently drops off every dashboard filter,
+# and warn-only rot is exactly what the registries exist to stop.
+from paddle_tpu.observe import events as _observe_events  # noqa: E402
+
+_observe_events.set_strict_kinds(True)

@@ -84,10 +84,16 @@ def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
     """Kill one replica mid-generation under offered load: zero
     client-visible failures, every output token-identical to the
     control, committed prefixes verified, zero post-warmup compiles
-    fleet-wide, the dead replica ejected."""
+    fleet-wide, the dead replica ejected.  With tracing on (ISSUE 15),
+    the killed request keeps ONE trace_id across both replicas with a
+    `failover` span naming the dead replica."""
+    from paddle_tpu.observe import ReqTracer
+
     log_path = str(tmp_path / "fleet_events.jsonl")
+    tracer = ReqTracer(sample_rate=1.0)
     engines = [_engine(), _engine()]
-    fleet = Fleet(engines, FleetConfig(), log_path=log_path).start()
+    fleet = Fleet(engines, FleetConfig(), log_path=log_path,
+                  tracer=tracer).start()
     futs = [fleet.submit(p, max_new_tokens=b)
             for p, b in zip(PROMPTS, BUDGETS)]
     # mid-generation: wait until replica 0 has COMMITTED tokens, so at
@@ -111,6 +117,31 @@ def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
     # requests that failed over say so in their provenance
     assert any(r.failovers >= 1 for r in resps)
     assert all(r.replica_id == 1 for r in resps if r.failovers)
+
+    # ISSUE 15 trace continuity: the killed request's SINGLE trace_id
+    # spans both replicas — its spans carry replica_id 0 AND 1, the
+    # failover span names the dead replica and the survivor, and the
+    # hop chain lands in the response
+    killed = next(r for r in resps if r.failovers >= 1)
+    assert killed.trace_id is not None
+    assert 0 in killed.hops and killed.hops[-1] == 1, killed.hops
+    traces = [t for t in tracer.traces()
+              if t.trace_id == killed.trace_id]
+    assert len(traces) == 1, "one trace_id per logical request"
+    t = traces[0]
+    assert set(t.replica_ids()) == {0, 1}, t.replica_ids()
+    fo = t.find("failover")
+    assert fo, t.span_names()
+    assert fo[0].attrs["from_replica"] == 0
+    assert fo[0].attrs["to_replica"] == 1
+    names = t.span_names()
+    for phase in ("join_wait", "dispatch", "evacuated", "complete"):
+        assert phase in names, (phase, names)
+    # chrome export renders the hop across replica rows (router + 2)
+    ct = tracer.export_chrome_trace()
+    rows = {e["pid"] for e in ct["traceEvents"] if e.get("ph") == "X"
+            and e["args"].get("trace_id") == killed.trace_id}
+    assert len(rows) >= 3, rows
     fleet.close()
 
     # satellite: replica_id stamps every engine event in the shared
@@ -269,8 +300,12 @@ def test_fleet_saturated_fast_reject_structured():
 
 @pytest.mark.slow
 def test_hedging_beats_straggler_replica(control_tokens):
+    from paddle_tpu.observe import ReqTracer
+
+    tracer = ReqTracer(sample_rate=1.0)
     engines = [_engine(), _engine()]
-    fleet = Fleet(engines, FleetConfig(hedge_after_ms=100)).start()
+    fleet = Fleet(engines, FleetConfig(hedge_after_ms=100),
+                  tracer=tracer).start()
     # replica 0 (first pick: least-loaded tie breaks on id) stalls for
     # 2 s; the hedge duplicate on replica 1 must win long before that
     chaos.delay_replica(engines[0], 2.0)
@@ -282,7 +317,19 @@ def test_hedging_beats_straggler_replica(control_tokens):
     assert elapsed < 1.9, f"hedge did not beat the straggler: {elapsed}"
     snap = fleet.stats.snapshot()
     assert snap["hedges"] >= 1 and snap["hedge_wins"] >= 1
-    fleet.close()
+    fleet.close()  # drains: the straggler attempt resolves before this
+    #                returns, landing the loser's `abandoned` marker
+    # ISSUE 15: the hedged request is ONE trace — the hedge fires, the
+    # winner completes on replica 1, and the loser (delayed replica 0)
+    # is marked abandoned when its late work surfaces
+    t = tracer.trace(resp.trace_id)
+    assert t is not None and resp.hedged
+    assert t.has("hedge"), t.span_names()
+    complete = t.find("complete")
+    assert complete and complete[0].attrs["replica_id"] == 1
+    abandoned = t.find("abandoned")
+    assert abandoned, t.span_names()
+    assert abandoned[0].attrs["replica_id"] == 0
 
 
 # -- cross-replica stats aggregation ----------------------------------------

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""One-shot scrape + pretty-print of a paddle_tpu /metrics endpoint.
+
+The operator's 10-second sanity check against a Fleet/Trainer
+exporter (observe pillar 7, docs/OBSERVE.md) without standing up a
+Prometheus: fetch the exposition, parse it, and print one line per
+family (counters/gauges with their samples, histograms as
+count/sum/p50-p99 reconstructed from the cumulative `le` buckets —
+exact to bin resolution, the same guarantee the exposition makes).
+
+Usage:
+    python tools/metrics_dump.py --url http://127.0.0.1:9464/metrics
+    python tools/metrics_dump.py --url ... --json      # raw families
+    python tools/metrics_dump.py --url ... --grep fleet_
+Exit codes: 0 ok, 1 scrape/parse failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import urllib.request
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """Prometheus text format -> {family: {"kind", "samples":
+    [{"labels", "value"}]}}.  Histogram series (_bucket/_sum/_count)
+    fold back under their family name."""
+    families = {}
+    kinds = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(None, 3)
+            kinds[name] = kind
+            families.setdefault(name, {"kind": kind, "samples": []})
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name = m.group("name")
+        labels = {k: v.replace(r'\"', '"').replace(r'\\', "\\")
+                  for k, v in _LABEL_RE.findall(m.group("labels") or "")}
+        value = float(m.group("value")) \
+            if m.group("value") != "+Inf" else float("inf")
+        base = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and name[:-len(sfx)] in kinds \
+                    and kinds[name[:-len(sfx)]] == "histogram":
+                base = name[:-len(sfx)]
+                labels["__series__"] = sfx[1:]
+                break
+        families.setdefault(base, {"kind": kinds.get(base, "untyped"),
+                                   "samples": []})
+        families[base]["samples"].append({"labels": labels,
+                                          "value": value})
+    return families
+
+
+def _hist_rows(samples):
+    """Group histogram series by their non-le labels; reconstruct
+    count/sum/p50/p99 per group from the cumulative buckets."""
+    groups = {}
+    for s in samples:
+        labels = {k: v for k, v in s["labels"].items()
+                  if k not in ("le", "__series__")}
+        key = tuple(sorted(labels.items()))
+        g = groups.setdefault(key, {"labels": labels, "buckets": [],
+                                    "count": 0, "sum": 0.0})
+        series = s["labels"].get("__series__")
+        if series == "bucket":
+            le = s["labels"].get("le")
+            if le != "+Inf":
+                g["buckets"].append((float(le), s["value"]))
+        elif series == "sum":
+            g["sum"] = s["value"]
+        elif series == "count":
+            g["count"] = s["value"]
+    for g in groups.values():
+        g["buckets"].sort()
+
+        def pct(p, g=g):
+            if not g["count"]:
+                return None
+            rank = p / 100.0 * g["count"]
+            for le, cum in g["buckets"]:
+                if cum >= rank:
+                    return le
+            return g["buckets"][-1][0] if g["buckets"] else None
+
+        g["p50"], g["p99"] = pct(50), pct(99)
+    return list(groups.values())
+
+
+def _fmt_labels(labels):
+    return ("{" + ",".join(f"{k}={v}"
+                           for k, v in sorted(labels.items())) + "}"
+            if labels else "")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", required=True,
+                    help="the /metrics URL (e.g. the MetricsServer "
+                         "a Fleet.start_metrics_server() printed)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the parsed families as JSON")
+    ap.add_argument("--grep", default=None,
+                    help="only families whose name contains this")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+
+    try:
+        with urllib.request.urlopen(args.url,
+                                    timeout=args.timeout) as r:
+            text = r.read().decode("utf-8")
+        families = parse_exposition(text)
+    except Exception as e:  # noqa: BLE001 — CLI surface
+        print(f"metrics_dump: scrape failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+
+    if args.grep:
+        families = {k: v for k, v in families.items()
+                    if args.grep in k}
+    if args.json:
+        json.dump(families, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+
+    for name in sorted(families):
+        fam = families[name]
+        if fam["kind"] == "histogram":
+            for g in _hist_rows(fam["samples"]):
+                print(f"{name}{_fmt_labels(g['labels'])}  "
+                      f"count={g['count']:g} sum={g['sum']:.3f} "
+                      f"p50<={g['p50']} p99<={g['p99']}")
+        else:
+            for s in fam["samples"]:
+                print(f"{name}{_fmt_labels(s['labels'])}  "
+                      f"{s['value']:g}  [{fam['kind']}]")
+    print(f"# {len(families)} families", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,6 +176,27 @@ def check_schema(candidate):
                                   f"missing {field!r} (numerics "
                                   f"observability, docs/OBSERVE.md "
                                   f"pillar 6)")
+        # span-derived phase breakdown (ISSUE 15, observe pillar 7): a
+        # serving latency number without its queue/form/dispatch
+        # decomposition cannot answer "where did the time go" — the
+        # offered-load entries must carry the tracer-derived keys next
+        # to their e2e/TTFT/TPOT numbers
+        _PHASE_KEYS = {
+            "serving_engine": ("queue_wait_ms_p50", "queue_wait_ms_p99",
+                               "batch_form_ms_p50", "dispatch_ms_p50"),
+            "serving_decode": ("join_wait_ms_p50", "dispatch_ms_p50"),
+            "serving_fleet": ("join_wait_ms_p50", "dispatch_ms_p50"),
+        }
+        for prefix, keys in _PHASE_KEYS.items():
+            if name == prefix or (name.startswith(prefix)
+                                  and prefix != "serving_engine"):
+                for field in keys:
+                    if field not in entry:
+                        errors.append(
+                            f"detail.{name}: missing {field!r} "
+                            f"(span-derived phase breakdown, observe "
+                            f"pillar 7)")
+                break
         if name.startswith("serving_fleet"):
             # fleet contract (ISSUE 14, docs/SERVING.md §fleet): a
             # replicated-serving entry must carry the offered-load

@@ -355,8 +355,15 @@ echo "== serving fleet chaos smoke (cpu) =="
 # through the survivors under load -> zero drops, zero recompiles,
 # responses tagged with the new model version.  Fleet-wide
 # post_warmup_compiles stays 0 across both events.
+#
+# ISSUE 15 rides the same fleet: (a) per-request tracing — the killed
+# request's SINGLE trace_id must export a chrome trace showing
+# queue -> dispatch -> failover-hop -> completion across two replica
+# rows; (b) the unified metrics exporter — /metrics must expose
+# families from >=4 subsystems with serving_post_warmup_compiles
+# readable as a 0 gauge, and tools/metrics_dump.py must scrape it.
 python - <<'EOF'
-import tempfile, time
+import json, subprocess, sys, tempfile, time, urllib.request, re
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
@@ -364,6 +371,7 @@ jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
 import paddle_tpu as fluid
 from paddle_tpu.core.executor import Executor, scope_guard
 from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.observe import ReqTracer
 from paddle_tpu.resilience import chaos
 from paddle_tpu.serving import DecodeConfig, DecodeEngine, Fleet, FleetConfig
 
@@ -384,19 +392,66 @@ control = [ctrl.generate(p, max_new_tokens=b, timeout_s=300).tolist()
 ctrl.close()
 
 engines = [mk(), mk()]
-fleet = Fleet(engines, FleetConfig()).start()
+tracer = ReqTracer(sample_rate=1.0)
+fleet = Fleet(engines, FleetConfig(), tracer=tracer).start()
 futs = [fleet.submit(p, max_new_tokens=b)
         for p, b in zip(prompts, budgets)]
 end = time.monotonic() + 60
 while engines[0].stats.tokens_generated < 2 and time.monotonic() < end:
     time.sleep(0.002)
 chaos.kill_replica(engines[0])  # mid-generation replica death
-outs = [f.result(300).tokens.tolist() for f in futs]
+resps = [f.result(300) for f in futs]
+outs = [r.tokens.tolist() for r in resps]
 snap = fleet.snapshot()
 assert outs == control, "failover broke greedy token identity"
 assert snap["failed"] == 0 and snap["failovers"] >= 1, snap
 assert snap["parity_checked"] >= 1 and snap["parity_failed"] == 0, snap
 assert snap["ejects"] == 1 and snap["post_warmup_compiles"] == 0, snap
+
+# -- ISSUE 15 chaos trace proof: ONE trace_id across both replicas ----
+killed = [r for r in resps if r.failovers >= 1][0]
+assert killed.trace_id and 0 in killed.hops and killed.hops[-1] == 1, \
+    (killed.trace_id, killed.hops)
+t = tracer.trace(killed.trace_id)
+names = t.span_names()
+assert "join_wait" in names and "dispatch" in names, names
+fo = t.find("failover")[0]
+assert fo.attrs["from_replica"] == 0 and fo.attrs["to_replica"] == 1, \
+    fo.attrs
+assert "complete" in names, names
+assert set(t.replica_ids()) == {0, 1}, t.replica_ids()
+ct = tracer.export_chrome_trace("/tmp/fleet_chaos_trace.json")
+rows = {e["pid"] for e in ct["traceEvents"] if e.get("ph") == "X"
+        and e["args"].get("trace_id") == killed.trace_id}
+assert len(rows) >= 3, rows  # router row + BOTH replica rows
+print("chaos trace proof OK:",
+      {"trace_id": killed.trace_id, "hops": killed.hops,
+       "rows": sorted(rows),
+       "exported": "/tmp/fleet_chaos_trace.json"})
+
+# -- ISSUE 15 metrics smoke: scrape the live fleet's exporter ---------
+srv = fleet.start_metrics_server()   # 127.0.0.1, ephemeral port
+body = urllib.request.urlopen(srv.url + "/metrics",
+                              timeout=10).read().decode()
+urllib.request.urlopen(srv.url + "/healthz", timeout=10).read()
+m = re.search(r'^serving_post_warmup_compiles\{[^}]*\} (\d+)$',
+              body, re.M)
+assert m and m.group(1) == "0", "serving_post_warmup_compiles gauge"
+subsystems = {ln.split("_")[0] for ln in body.splitlines()
+              if ln and not ln.startswith("#")}
+present = subsystems & {"serving", "fleet", "runtime", "reqtrace",
+                        "process", "memory"}
+assert len(present) >= 4, subsystems
+dump = subprocess.run(
+    [sys.executable, "tools/metrics_dump.py", "--url",
+     srv.url + "/metrics", "--grep", "fleet_"],
+    capture_output=True, text=True, timeout=60)
+assert dump.returncode == 0, dump.stderr
+assert "fleet_failovers_total" in dump.stdout, dump.stdout[:500]
+print("metrics export smoke OK:",
+      {"subsystems": sorted(present),
+       "families": len([ln for ln in body.splitlines()
+                        if ln.startswith("# TYPE")])})
 
 with tempfile.TemporaryDirectory() as d:
     with scope_guard(engines[1].scope):
