@@ -143,7 +143,41 @@ def _tel_fields(tel):
     return out
 
 
-def _timed_loop(exe, program, feed_dev, loss, steps, warmup, scope=None):
+def _new_ledger():
+    """A GoodputLedger with its wall window already open (observe
+    pillar 8): each training bench fn owns one so its entry can carry
+    the goodput decomposition next to the MFU headline."""
+    from paddle_tpu.observe import GoodputLedger
+
+    led = GoodputLedger()
+    led.open_window()
+    return led
+
+
+def _goodput_fields(ledger, mfu=None):
+    """Close the entry's ledger window and stamp the goodput fields
+    every training entry carries: `goodput` (step fraction of wall),
+    `effective_mfu` = headline MFU x goodput, and `badput_breakdown`
+    (every non-step category's wall fraction — compile, data_stall,
+    checkpoint, ... idle).  The bench wall here is the measurement
+    harness's own anatomy (warmup compiles, the throwaway ckpt save),
+    honest context for the headline, not a production goodput claim."""
+    if ledger is None:
+        return {}
+    from paddle_tpu.observe.goodput import GOODPUT_CATEGORY
+
+    ledger.close_window()
+    rep = ledger.report(mfu=mfu)
+    out = {"goodput": rep["goodput"],
+           "badput_breakdown": {c: f for c, f in rep["fractions"].items()
+                                if c != GOODPUT_CATEGORY}}
+    if mfu is not None:
+        out["effective_mfu"] = rep["effective_mfu"]
+    return out
+
+
+def _timed_loop(exe, program, feed_dev, loss, steps, warmup, scope=None,
+                ledger=None):
     """Device-resident data loop: feeds are placed on device once; the
     timed window is ONE host dispatch chaining `steps` training steps
     on-chip (the tunnel here has high host<->device latency); a final
@@ -153,9 +187,18 @@ def _timed_loop(exe, program, feed_dev, loss, steps, warmup, scope=None):
     Returns (elapsed_s, last_loss, telemetry-of-the-timed-window)."""
     import contextlib
 
-    for _ in range(warmup):
-        exe.run(program, feed=feed_dev, fetch_list=[loss])
-    exe.run(program, feed=feed_dev, fetch_list=[loss], iterations=steps)
+    def _phase(label, n):
+        # warmup/chain dispatches are step-shaped work too; their XLA
+        # compile wall is re-attributed to "compile" by the ledger
+        return (ledger.phase("step", label=label, steps=n)
+                if ledger is not None else contextlib.nullcontext())
+
+    with _phase("warmup", warmup):
+        for _ in range(warmup):
+            exe.run(program, feed=feed_dev, fetch_list=[loss])
+    with _phase("chain_warm", steps):
+        exe.run(program, feed=feed_dev, fetch_list=[loss],
+                iterations=steps)
     if scope is not None:
         # drop the warmup accumulation: the reported counters must
         # describe exactly the measured window
@@ -167,10 +210,11 @@ def _timed_loop(exe, program, feed_dev, loss, steps, warmup, scope=None):
     else:
         trace_cm = contextlib.nullcontext()
     with trace_cm:
-        t0 = time.perf_counter()
-        (lv,) = exe.run(program, feed=feed_dev, fetch_list=[loss],
-                        iterations=steps)
-        elapsed = time.perf_counter() - t0
+        with _phase("timed", steps):
+            t0 = time.perf_counter()
+            (lv,) = exe.run(program, feed=feed_dev, fetch_list=[loss],
+                            iterations=steps)
+            elapsed = time.perf_counter() - t0
     tel = _fetch_tel(program, scope) if scope is not None else None
     return elapsed, float(np.asarray(lv).reshape(-1)[0]), tel
 
@@ -195,7 +239,7 @@ def _mem_fields(exe, program, feed, loss, scope=None):
         return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
 
 
-def _ckpt_fields(exe, program, scope=None):
+def _ckpt_fields(exe, program, scope=None, ledger=None):
     """Async-checkpoint observability for one training entry (ISSUE 7
     satellite): one full sharded save of the measured program's state
     into a throwaway dir, split into its blocking (device→host
@@ -217,10 +261,17 @@ def _ckpt_fields(exe, program, scope=None):
         try:
             cm = scope_guard(scope) if scope is not None \
                 else contextlib.nullcontext()
-            with cm:
+            led_cm = (ledger.phase("checkpoint", label="throwaway_save")
+                      if ledger is not None else contextlib.nullcontext())
+            with cm, led_cm:
                 job = fluid_io.save_sharded(exe, d,
                                             main_program=program,
                                             async_=True).result(120)
+            if ledger is not None and job.write_ms:
+                # the async writer's overlapped work: background side
+                # channel, never a wall category
+                ledger.note_background("ckpt_write",
+                                       job.write_ms / 1000.0)
             return {"ckpt_blocking_ms": round(job.snapshot_ms, 3),
                     "ckpt_write_ms": round(job.write_ms or 0.0, 3),
                     "ckpt_bytes": job.bytes_total}
@@ -274,7 +325,8 @@ def _peak_mem_if_backend_up():
     return monitoring.peak_memory_bytes()
 
 
-def _mfu_result(step_flops, steps, elapsed, extra, n_devices=1):
+def _mfu_result(step_flops, steps, elapsed, extra, n_devices=1,
+                ledger=None):
     if step_flops <= 0:
         raise RuntimeError(
             "XLA cost_analysis returned no flops; refusing to report a "
@@ -285,6 +337,7 @@ def _mfu_result(step_flops, steps, elapsed, extra, n_devices=1):
     out = {"mfu": round((step_flops * steps / elapsed)
                         / (peak * n_devices), 4),
            "step_flops": step_flops, "device": kind, "steps": steps}
+    out.update(_goodput_fields(ledger, mfu=out["mfu"]))
     out.update(extra)
     return out
 
@@ -454,6 +507,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
+    ledger = _new_ledger()
     with fluid.program_guard(main, startup), fluid.scope_guard(scope):
         model = resnet.build_model(dataset="flowers", depth=50,
                                    class_dim=1000, learning_rate=0.1,
@@ -503,15 +557,19 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
             dev_feeder = DeviceFeeder(reader, capacity=3).start()
             try:
                 feeder = iter(dev_feeder)
-                for _ in range(warmup):
-                    exe.run(main, feed=next(feeder),
-                            fetch_list=[model["loss"]])
+                with ledger.phase("step", label="warmup", steps=warmup):
+                    for _ in range(warmup):
+                        exe.run(main, feed=next(feeder),
+                                fetch_list=[model["loss"]])
                 _fetch_tel(main, scope)  # drop warmup accumulation
                 t0 = time.perf_counter()
                 lv = None
                 for _ in range(steps):
-                    (lv,) = exe.run(main, feed=next(feeder),
-                                    fetch_list=[model["loss"]])
+                    with ledger.phase("data_stall", label="next"):
+                        batch = next(feeder)
+                    with ledger.phase("step", label="timed", steps=1):
+                        (lv,) = exe.run(main, feed=batch,
+                                        fetch_list=[model["loss"]])
                 elapsed = time.perf_counter() - t0
                 tel = _fetch_tel(main, scope)
                 last_loss = float(np.asarray(lv).reshape(-1)[0])
@@ -526,9 +584,9 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
                                      fetch_list=[model["loss"]])
             elapsed, last_loss, tel = _timed_loop(
                 exe, main, feed, model["loss"], steps, warmup,
-                scope=scope)
+                scope=scope, ledger=ledger)
             mem = _mem_fields(exe, main, feed, model["loss"])
-        ck = _ckpt_fields(exe, main, scope)
+        ck = _ckpt_fields(exe, main, scope, ledger=ledger)
         imgs_per_sec = batch_size * steps / elapsed
         dp = {}
         n_dev = 1
@@ -547,7 +605,7 @@ def bench_resnet50(batch_size: int, steps: int, warmup: int,
          "last_loss": last_loss,
          **_tel_fields(tel), **mem, **ck, **dp,
          "vs_cpu_baseline_81.69": round(imgs_per_sec / 81.69, 3)},
-        n_devices=n_dev)
+        n_devices=n_dev, ledger=ledger)
 
 
 def _layout_fields(exe, program, feed, loss):
@@ -666,6 +724,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
+    ledger = _new_ledger()
     with fluid.program_guard(main, startup), fluid.scope_guard(scope):
         model = build(use_flash)
         _enable_observability(main)
@@ -700,10 +759,11 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
             flop_src = "xla"
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
-                                              warmup, scope=scope)
+                                              warmup, scope=scope,
+                                              ledger=ledger)
         mem = _mem_fields(exe, main, feed, model["loss"])
         layout = _layout_fields(exe, main, feed, model["loss"])
-        ck = _ckpt_fields(exe, main, scope)
+        ck = _ckpt_fields(exe, main, scope, ledger=ledger)
         tokens_per_sec = round(batch_size * max_length * steps
                                / elapsed, 1)
         dp = {}
@@ -724,7 +784,7 @@ def bench_transformer(batch_size: int, steps: int, warmup: int,
          "flop_count": flop_src,
          "last_loss": last_loss,
          **_tel_fields(tel), **mem, **layout, **ck, **dp},
-        n_devices=n_dev)
+        n_devices=n_dev, ledger=ledger)
 
 
 def bench_bert(batch_size: int, steps: int, warmup: int,
@@ -743,6 +803,7 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
+    ledger = _new_ledger()
     with fluid.program_guard(main, startup), fluid.scope_guard(scope):
         model = build(use_flash)
         _enable_observability(main)
@@ -761,9 +822,10 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
             step_flops = float(cost.get("flops", 0.0))
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
-                                              warmup, scope=scope)
+                                              warmup, scope=scope,
+                                              ledger=ledger)
         mem = _mem_fields(exe, main, feed, model["loss"])
-        ck = _ckpt_fields(exe, main, scope)
+        ck = _ckpt_fields(exe, main, scope, ledger=ledger)
         tokens_per_sec = round(batch_size * max_len * steps / elapsed, 1)
         dp = {}
         n_dev = 1
@@ -780,7 +842,7 @@ def bench_bert(batch_size: int, steps: int, warmup: int,
          "flop_count": "dense-equivalent" if use_flash else "xla",
          "last_loss": last_loss,
          **_tel_fields(tel), **mem, **ck, **dp},
-        n_devices=n_dev)
+        n_devices=n_dev, ledger=ledger)
 
 
 def bench_lstm(batch_size: int, steps: int, warmup: int,
@@ -809,6 +871,7 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
 
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
+    ledger = _new_ledger()
     with fluid.program_guard(main, startup), fluid.scope_guard(scope):
         model = lstm.build_model(max_len=max_len, use_amp=False,
                                  pallas_rnn=pallas_rnn,
@@ -828,9 +891,10 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
             flop_src = "xla(loop-bodies-once)"
         elapsed, last_loss, tel = _timed_loop(exe, main, feed,
                                               model["loss"], steps,
-                                              warmup, scope=scope)
+                                              warmup, scope=scope,
+                                              ledger=ledger)
         mem = _mem_fields(exe, main, feed, model["loss"])
-        ck = _ckpt_fields(exe, main, scope)
+        ck = _ckpt_fields(exe, main, scope, ledger=ledger)
     return _mfu_result(
         step_flops, steps, elapsed,
         {"tokens_per_sec": round(batch_size * max_len * steps / elapsed,
@@ -840,7 +904,7 @@ def bench_lstm(batch_size: int, steps: int, warmup: int,
          "pallas_rnn": pallas_rnn, "rnn_unroll": rnn_unroll,
          "flop_count": flop_src,
          "last_loss": last_loss,
-         **_tel_fields(tel), **mem, **ck})
+         **_tel_fields(tel), **mem, **ck}, ledger=ledger)
 
 
 def bench_deepfm(batch_size: int, steps: int, warmup: int,
@@ -857,6 +921,7 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int,
 
     main_p, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
+    ledger = _new_ledger()
     with fluid.program_guard(main_p, startup), fluid.scope_guard(scope):
         model = deepfm.build_model()
         _enable_observability(main_p)
@@ -870,9 +935,10 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int,
                                  fetch_list=[model["loss"]])
         elapsed, last_loss, tel = _timed_loop(exe, main_p, feed,
                                               model["loss"], steps,
-                                              warmup, scope=scope)
+                                              warmup, scope=scope,
+                                              ledger=ledger)
         mem = _mem_fields(exe, main_p, feed, model["loss"])
-        ck = _ckpt_fields(exe, main_p, scope)
+        ck = _ckpt_fields(exe, main_p, scope, ledger=ledger)
         examples_per_sec = round(batch_size * steps / elapsed, 1)
         dp = {}
         if mesh_axes:
@@ -893,6 +959,9 @@ def bench_deepfm(batch_size: int, steps: int, warmup: int,
         "step_bytes_accessed": bytes_acc,
         "hbm_roofline_frac": round(hbm_frac, 4),
         "last_loss": last_loss,
+        # no MXU MFU here (bandwidth-bound entry), so effective_mfu
+        # scales the HBM roofline fraction instead
+        **_goodput_fields(ledger, mfu=round(hbm_frac, 4)),
         **_tel_fields(tel), **mem, **ck, **dp,
     }
 
@@ -1752,7 +1821,16 @@ def main():
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
             traceback.print_exc()
-            detail[name] = {"error": f"{type(e).__name__}: {e}"}
+            # which anatomy phase died (DispatchWatchdog's proxy): no
+            # completed dispatch inside the region = it never got past
+            # the first compile; otherwise steps were flowing and a
+            # mid-run step/fetch is what hung or threw
+            d = _obs.runtime_stats.delta(snap)
+            detail[name] = {
+                "error": f"{type(e).__name__}: {e}",
+                "hang_phase": ("first_compile" if d["dispatches"] == 0
+                               else "hung_step"),
+            }
             print(f"warning: {name} bench failed, continuing",
                   file=sys.stderr)
         # observability stamp (observe pillar 2): compile wall-time and

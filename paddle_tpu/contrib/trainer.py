@@ -186,6 +186,17 @@ class Trainer:
         self._gang_steps = 0           # heartbeat step counter (beat())
         self._active_reader = None
         self._resume_reader_state = None
+        # observe pillar 8: every second of train() wall clock lands in
+        # exactly one ledger category (step/replay/compile/data_stall/
+        # checkpoint/barrier_wait/idle) — pure host bookkeeping, the
+        # traced step is byte-identical with or without it
+        from ..observe.goodput import GoodputLedger
+
+        self.goodput_ledger = GoodputLedger()
+        # blocking_ms/write_ms are READS of the goodput ledger's
+        # checkpoint category / ckpt_write background channel — one
+        # source for the same milliseconds across train_end, bench and
+        # /metrics (the keys survive as aliases for perf_gate baselines)
         self.ckpt_stats = {"saves": 0, "blocking_ms": 0.0,
                            "write_ms": 0.0, "bytes": 0}
         self.last_telemetry = None     # newest StepTelemetry window
@@ -346,68 +357,75 @@ class Trainer:
     def _save_checkpoint(self, serial: int, epoch: int, step: int,
                          emergency: bool = False,
                          force_sync: bool = False):
-        import time as _time
-
         root = self._ckpt_root()
         path = os.path.join(root, f"ckpt_{serial}")
-        t0 = _time.perf_counter()
+        led = self.goodput_ledger
         use_async = (self.checkpoint_cfg.async_save and not force_sync)
-        if use_async:
-            # surface a PREVIOUS background write's failure before
-            # starting a new save (async errors are deferred, not lost)
-            self._writer().check()
-            # bounded queue: a save requested while one is in flight
-            # waits for it — two saves never interleave their files
-            self._await_pending(surface=True)
-        if os.path.isdir(path) and not os.path.exists(
-                os.path.join(path, "__trainer_state__.json")):
-            # leftover of a save that died mid-write (torn): clear it so
-            # stale shard files cannot mix with the fresh save
-            shutil.rmtree(path, ignore_errors=True)
-        os.makedirs(path, exist_ok=True)
-        trainer_state = {"epoch": epoch, "step": step, "serial": serial,
-                         "train_state":
-                         self._capture_train_state(epoch, step)}
-        with scope_guard(self.scope):
-            # sharded snapshot: each process copies only its own array
-            # shards device→host (io.py) — scales to mp/fsdp state that
-            # must never gather to one host
-            job = fluid_io.prepare_sharded_save(
-                self.exe, path, main_program=self.train_program)
+        # the whole blocking portion of a save — snapshot, any
+        # wait-for-previous, and (sync path) the write itself — is one
+        # ledger "checkpoint" phase; blocking_ms below READS it back
+        with led.phase("checkpoint", label=f"save:{serial}"):
+            if use_async:
+                # surface a PREVIOUS background write's failure before
+                # starting a new save (async errors are deferred, not
+                # lost)
+                self._writer().check()
+                # bounded queue: a save requested while one is in
+                # flight waits for it — two saves never interleave
+                # their files
+                self._await_pending(surface=True)
+            if os.path.isdir(path) and not os.path.exists(
+                    os.path.join(path, "__trainer_state__.json")):
+                # leftover of a save that died mid-write (torn): clear
+                # it so stale shard files cannot mix with the fresh save
+                shutil.rmtree(path, ignore_errors=True)
+            os.makedirs(path, exist_ok=True)
+            trainer_state = {"epoch": epoch, "step": step,
+                             "serial": serial,
+                             "train_state":
+                             self._capture_train_state(epoch, step)}
+            with scope_guard(self.scope):
+                # sharded snapshot: each process copies only its own
+                # array shards device→host (io.py) — scales to mp/fsdp
+                # state that must never gather to one host
+                job = fluid_io.prepare_sharded_save(
+                    self.exe, path, main_program=self.train_program)
 
-        def _finalize():
-            # ordering: shards → manifest (io.py, written LAST there) →
-            # trainer state.  The trainer-state file marks the serial
-            # visible to _list_checkpoints, so a death anywhere earlier
-            # leaves a torn — never a half-resumable — directory.
-            tmp = os.path.join(path, "__trainer_state__.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump(trainer_state, f)
-            os.replace(tmp,
-                       os.path.join(path, "__trainer_state__.json"))
-            self._rotate()
-            self.ckpt_stats["saves"] += 1
-            self.ckpt_stats["write_ms"] += job.write_ms or 0.0
-            self.ckpt_stats["bytes"] = job.bytes_total
-            self._emit("ckpt_save", serial=serial, epoch=epoch,
-                       step=step,
-                       snapshot_ms=round(job.snapshot_ms, 3),
-                       write_ms=round(job.write_ms or 0.0, 3),
-                       bytes=job.bytes_total, asynchronous=use_async,
-                       emergency=emergency)
+            def _finalize():
+                # ordering: shards → manifest (io.py, written LAST
+                # there) → trainer state.  The trainer-state file marks
+                # the serial visible to _list_checkpoints, so a death
+                # anywhere earlier leaves a torn — never a
+                # half-resumable — directory.
+                tmp = os.path.join(path, "__trainer_state__.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(trainer_state, f)
+                os.replace(tmp,
+                           os.path.join(path, "__trainer_state__.json"))
+                self._rotate()
+                led.note_background("ckpt_write",
+                                    (job.write_ms or 0.0) / 1000.0)
+                self.ckpt_stats["saves"] += 1
+                self.ckpt_stats["write_ms"] = round(
+                    led.background_ms("ckpt_write"), 3)
+                self.ckpt_stats["bytes"] = job.bytes_total
+                self._emit("ckpt_save", serial=serial, epoch=epoch,
+                           step=step,
+                           snapshot_ms=round(job.snapshot_ms, 3),
+                           write_ms=round(job.write_ms or 0.0, 3),
+                           bytes=job.bytes_total, asynchronous=use_async,
+                           emergency=emergency)
 
-        if use_async:
-            self._pending_save = self._writer().submit(
-                job, finalize=_finalize)
-            # blocking cost = snapshot + any wait-for-previous, i.e.
-            # exactly the time the step loop lost to this save
-            self.ckpt_stats["blocking_ms"] += (
-                (_time.perf_counter() - t0) * 1000.0)
-        else:
-            job.write()
-            _finalize()
-            self.ckpt_stats["blocking_ms"] += (
-                (_time.perf_counter() - t0) * 1000.0)
+            if use_async:
+                self._pending_save = self._writer().submit(
+                    job, finalize=_finalize)
+            else:
+                job.write()
+                _finalize()
+        # blocking cost = everything inside the phase above, i.e.
+        # exactly the time the step loop lost to saves so far
+        self.ckpt_stats["blocking_ms"] = round(
+            led.category_ms("checkpoint"), 3)
 
     def _writer(self):
         if self._ckpt_writer is None:
@@ -533,6 +551,19 @@ class Trainer:
         data.decorator.shuffle(seed=...)); a reader exposing
         state_dict()/load_state_dict() gets its state checkpointed and
         restored too."""
+        # pillar 8: the ledger window bounds this call's wall clock —
+        # every second in here lands in exactly one goodput category
+        self.goodput_ledger.open_window()
+        try:
+            return self._train_impl(num_epochs, event_handler, reader,
+                                    feed_order)
+        finally:
+            self.goodput_ledger.close_window()
+
+    def _train_impl(self, num_epochs: int,
+                    event_handler: Optional[Callable],
+                    reader: Optional[Callable],
+                    feed_order: Optional[Sequence[str]]):
         from ..resilience import health as gang_health
         from ..resilience import preempt
 
@@ -548,6 +579,10 @@ class Trainer:
         if plane is not None:
             if self._event_log:
                 plane.attach_event_log(self._event_log)
+            # gang waits outside train() (wait_gang_done) keep feeding
+            # the same ledger so the done-rendezvous shows up as
+            # barrier_wait, not as unaccounted time
+            plane.attach_ledger(self.goodput_ledger)
             plane.check()  # a poisoned gang must not start stepping
         if self.step_deadline_s and self._step_watchdog is None:
             from ..resilience.watchdog import DispatchWatchdog
@@ -573,6 +608,19 @@ class Trainer:
                   if self.checkpoint_cfg else 0)
         fetch = [o.name for o in self.train_outputs]
         skip = self._resume_step_in_epoch  # mid-epoch fast-forward
+        # restart-replay badput: the per-step progress cursor the DEAD
+        # process left behind marks how far it actually got; every step
+        # we execute before that point is work done twice (the resume
+        # checkpoint is older than the crash), accounted as "replay"
+        crash_cursor = self._read_progress()
+        if (crash_cursor is not None
+                and crash_cursor > (self._resume_epoch,
+                                    self._resume_step_in_epoch)):
+            self.goodput_ledger.note_replay(
+                (self._resume_epoch, self._resume_step_in_epoch),
+                crash_cursor)
+        else:
+            crash_cursor = None
         tel_snap = None
         if self.telemetry_cfg is not None:
             from ..observe import runtime_stats
@@ -587,7 +635,8 @@ class Trainer:
             handler(BeginEpochEvent(epoch))
             step = 0
             done = 0
-            for batch in (reader() if reader else iter(())):
+            for batch in self._goodput_batches(
+                    iter(reader()) if reader else iter(())):
                 # resume semantics: a mid-epoch checkpoint records how
                 # many batches of its epoch were consumed; with a
                 # deterministic reader, skipping them continues exactly
@@ -611,17 +660,24 @@ class Trainer:
                     import contextlib
 
                     guard = contextlib.nullcontext()
-                with scope_guard(self.scope), guard:
+                is_replay = (crash_cursor is not None
+                             and (epoch, step) < crash_cursor)
+                with scope_guard(self.scope), guard, \
+                        self.goodput_ledger.phase(
+                            "replay" if is_replay else "step", steps=1):
                     metrics = self.exe.run(
                         self.train_program, feed=batch,
                         fetch_list=fetch if begin.fetch_metrics else [])
                 handler(EndStepEvent(epoch, step, metrics))
                 step += 1
                 done += 1
+                if self.checkpoint_cfg:
+                    self._write_progress(epoch, step)
                 if plane is not None:
                     self._gang_steps += 1
-                    plane.beat(self._gang_steps)
-                    plane.check()  # raises PeerLost/Stalled/Poisoned
+                    with self.goodput_ledger.phase("barrier_wait"):
+                        plane.beat(self._gang_steps)
+                        plane.check()  # raises PeerLost/Stalled/Poisoned
                 if (self.telemetry_cfg is not None and
                         done % self.telemetry_cfg.interval == 0):
                     tel_snap = self._publish_telemetry(epoch, step,
@@ -658,15 +714,74 @@ class Trainer:
             # flush the partial final window so no steps go unreported
             self._publish_telemetry(num_epochs - 1, -1, tel_snap)
             if self._event_log:
+                rep = self.goodput()
                 self._event_log.event(
                     "train_end", num_epochs=num_epochs,
                     ckpt_saves=self.ckpt_stats["saves"],
                     # the async win, recorded: how long the step loop
-                    # actually stalled vs how long writes took
+                    # actually stalled vs how long writes took — both
+                    # are reads of the goodput ledger now
                     ckpt_blocking_ms=round(
                         self.ckpt_stats["blocking_ms"], 3),
                     ckpt_write_ms=round(
-                        self.ckpt_stats["write_ms"], 3))
+                        self.ckpt_stats["write_ms"], 3),
+                    goodput=rep["goodput"],
+                    replay_steps=rep["replay_steps"],
+                    wall_s=rep["wall_s"])
+                self._event_log.event("goodput_report", **rep)
+
+    def _goodput_batches(self, it):
+        """Wrap reader `next()` in the ledger's data_stall phase — the
+        input pipeline's blocking time, attributed without touching the
+        reader or the step."""
+        led = self.goodput_ledger
+        while True:
+            with led.phase("data_stall"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    # -- goodput (observe pillar 8) --------------------------------------
+    def _progress_path(self) -> str:
+        return os.path.join(self._ckpt_root(), "__progress__.json")
+
+    def _write_progress(self, epoch: int, step: int) -> None:
+        """Atomically record how many steps actually EXECUTED (the
+        crash cursor a relaunch reads to count replay badput — steps
+        between the resumed checkpoint and this high-water mark run
+        twice).  Accounting only: best-effort, never fails a step."""
+        try:
+            os.makedirs(self._ckpt_root(), exist_ok=True)
+            tmp = self._progress_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"epoch": epoch, "step": step}, f)
+            os.replace(tmp, self._progress_path())
+        except OSError:
+            pass
+
+    def _read_progress(self):
+        if not self.checkpoint_cfg:
+            return None
+        try:
+            with open(self._progress_path()) as f:
+                d = json.load(f)
+            return (int(d["epoch"]), int(d["step"]))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def goodput(self, mfu: Optional[float] = None):
+        """The pillar-8 wall-clock decomposition of this trainer's
+        train() time: GoodputLedger.report() — Σ categories == wall,
+        goodput fraction, replay badput, `effective_mfu` when a
+        headline MFU is passed, and the heartbeat-skew straggler
+        estimate when a health plane is active."""
+        from ..resilience import health as gang_health
+
+        plane = gang_health.get_health_plane()
+        skew = plane.skew() if plane is not None else None
+        return self.goodput_ledger.report(mfu=mfu, skew=skew)
 
     def _drain(self, serial: int, epoch: int, step: int):
         """Preemption drain (docs/RESILIENCE.md): called at a step
@@ -750,6 +865,7 @@ class Trainer:
         runtime/process/memory collectors.  Built once, cached."""
         if self._metrics_registry is None:
             from ..observe.registry import (MetricsRegistry, gauge,
+                                            goodput_collector,
                                             standard_collectors,
                                             telemetry_collector)
 
@@ -757,6 +873,8 @@ class Trainer:
             reg.register("training",
                          telemetry_collector(
                              lambda: self.last_telemetry))
+            reg.register("goodput",
+                         goodput_collector(lambda: self.goodput()))
 
             def ckpt_collect():
                 s = self.ckpt_stats

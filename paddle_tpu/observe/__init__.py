@@ -62,6 +62,16 @@ Three pillars (docs/OBSERVE.md):
    (`numerics_report`/`format_numerics_table`;
    `StepTelemetry.groups`/`.first_nonfinite_op`).  All device-side,
    zero extra dispatches, byte-identical step when disabled.
+
+8. GOODPUT — `goodput.py` accounts every second of a training run's
+   WALL clock into exclusive categories (step / replay / compile /
+   data_stall / checkpoint / barrier_wait / idle, Σ == wall):
+   host-monotonic timestamps at phase boundaries only, zero device
+   dispatches, byte-identical step lowering.  `GoodputLedger.report`
+   yields the goodput fraction and `effective_mfu` = headline MFU x
+   goodput; `export_chrome_trace` draws the step-anatomy timeline on
+   rows aligned with reqtrace's exporter; `goodput_collector` feeds
+   /metrics.  contrib.Trainer threads it (`Trainer.goodput()`).
 """
 
 from . import cost  # noqa: F401
@@ -70,10 +80,13 @@ from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
 from .events import (DECODE_EVENTS, FLEET_EVENTS,  # noqa: F401
-                     GANG_EVENTS, NUMERICS_EVENTS, RESILIENCE_EVENTS,
-                     SERVING_EVENTS, BoundEventLog, RunEventLog,
-                     git_sha, new_run_id, read_events,
+                     GANG_EVENTS, GOODPUT_EVENTS, NUMERICS_EVENTS,
+                     RESILIENCE_EVENTS, SERVING_EVENTS, BoundEventLog,
+                     RunEventLog, git_sha, new_run_id, read_events,
                      register_event_kinds, set_strict_kinds)
+from .goodput import (CATEGORIES as GOODPUT_CATEGORIES,  # noqa: F401
+                      GoodputLedger, format_goodput_table,
+                      goodput_report)
 from .memory import (DEVICE_HBM_BYTES, PLAN_FIT_REL_TOL,  # noqa: F401
                      device_memory_budget, export_chrome_trace,
                      format_memory_table, memory_report, memory_table,
@@ -92,11 +105,11 @@ from .numerics import (GROUP_NAMES, enable_numerics,  # noqa: F401
                        worst_update_ratio)
 from .registry import (MetricFamily, MetricsRegistry,  # noqa: F401
                        MetricsServer, default_registry, fleet_collector,
-                       gang_collector, memory_collector,
-                       metrics_snapshot, process_collector,
-                       runtime_collector, serving_stats_collector,
-                       standard_collectors, telemetry_collector,
-                       tracer_collector)
+                       gang_collector, goodput_collector,
+                       memory_collector, metrics_snapshot,
+                       process_collector, runtime_collector,
+                       serving_stats_collector, standard_collectors,
+                       telemetry_collector, tracer_collector)
 from .reqtrace import (TAIL_KEEP_MARKS, ReqTracer,  # noqa: F401
                        RequestTrace, Span, new_trace_id)
 from .trace import fluid_op_of, format_op_table, op_time_table  # noqa: F401

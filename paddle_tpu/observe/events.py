@@ -118,6 +118,15 @@ GANG_EVENTS = (
 )
 
 
+# goodput observability event kinds (docs/OBSERVE.md pillar 8):
+# the wall-clock decomposition contrib.Trainer emits at train_end
+GOODPUT_EVENTS = (
+    "goodput_report",  # the full GoodputLedger.report() dict: wall_s,
+    #                    per-category seconds/fractions (Σ == wall),
+    #                    goodput fraction, replay badput, effective_mfu
+)
+
+
 # numerics observability event kinds (docs/OBSERVE.md pillar 6):
 # emitted by contrib.Trainer next to its telemetry windows
 NUMERICS_EVENTS = (
@@ -141,7 +150,7 @@ NUMERICS_EVENTS = (
 _VALIDATED_PREFIXES = ("serving_", "fleet_", "gang_")
 _KNOWN_KINDS = set(SERVING_EVENTS) | set(DECODE_EVENTS) \
     | set(FLEET_EVENTS) | set(GANG_EVENTS) | set(RESILIENCE_EVENTS) \
-    | set(NUMERICS_EVENTS)
+    | set(NUMERICS_EVENTS) | set(GOODPUT_EVENTS)
 _strict_kinds = [False]
 _warned_kinds: set = set()
 

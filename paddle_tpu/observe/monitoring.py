@@ -24,9 +24,15 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 # older jax emitted the `_sec`-suffixed name; accept both
 _COMPILE_EVENT_ALIASES = (_COMPILE_EVENT, _COMPILE_EVENT + "_sec",
                           "/jax/core/compile/backend_compile_duration_sec")
+# jaxpr tracing + mlir lowering: the host-side compilation work a cold
+# dispatch pays BEFORE the backend compile — the goodput ledger folds
+# it into the "compile" category so a first/replayed step's own time
+# stays dispatch-sized
+_TRACE_EVENT_PREFIXES = ("/jax/core/compile/jaxpr_trace_duration",
+                         "/jax/core/compile/jaxpr_to_mlir_module_duration")
 
-_FIELDS = ("compiles", "compile_time_s", "builds", "retraces",
-           "dispatches", "dispatch_time_s")
+_FIELDS = ("compiles", "compile_time_s", "trace_time_s", "builds",
+           "retraces", "dispatches", "dispatch_time_s")
 
 
 class RuntimeStats:
@@ -37,6 +43,7 @@ class RuntimeStats:
         self._lock = threading.Lock()
         self.compiles = 0           # XLA backend compiles (jax.monitoring)
         self.compile_time_s = 0.0   # total backend-compile wall time
+        self.trace_time_s = 0.0     # jaxpr trace + mlir lowering wall
         self.builds = 0             # Executor step fns traced (cache miss)
         self.retraces = 0           # re-compiles of an existing step fn
         #                             caused by a feed signature change
@@ -49,6 +56,10 @@ class RuntimeStats:
         with self._lock:
             self.compiles += 1
             self.compile_time_s += float(duration_s)
+
+    def record_trace(self, duration_s: float):
+        with self._lock:
+            self.trace_time_s += float(duration_s)
 
     def record_build(self):
         with self._lock:
@@ -90,6 +101,8 @@ def install():
     def _on_duration(event, duration, **_kw):
         if event in _COMPILE_EVENT_ALIASES:
             runtime_stats.record_compile(duration)
+        elif event.startswith(_TRACE_EVENT_PREFIXES):
+            runtime_stats.record_trace(duration)
 
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
     _installed[0] = True

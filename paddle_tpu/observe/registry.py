@@ -514,6 +514,62 @@ def tracer_collector(tracer, **labels: Any
     return collect
 
 
+def goodput_collector(fetch: Callable[[], Any], **labels: Any
+                      ) -> Callable[[], List[MetricFamily]]:
+    """Pillar-8 adapter: `fetch` returns a GoodputLedger.report()
+    dict (or None before a ledger exists) — contrib.Trainer passes
+    `lambda: trainer.goodput()`.  Fractions become
+    `goodput_fraction{category=...}` gauges, badput seconds become
+    per-category counters, and effective_mfu rides when the report
+    carries an MFU."""
+
+    def collect() -> List[MetricFamily]:
+        rep = fetch()
+        if rep is None:
+            return [gauge("goodput_available",
+                          "1 when a goodput ledger is reporting", 0,
+                          **labels)]
+        fams = [
+            gauge("goodput_available",
+                  "1 when a goodput ledger is reporting", 1, **labels),
+            counter("goodput_wall_seconds_total",
+                    "ledger-accounted wall clock", rep["wall_s"],
+                    **labels),
+            gauge("goodput_fraction_good",
+                  "useful-step share of wall clock (the goodput)",
+                  rep["goodput"], **labels),
+            counter("goodput_steps_total", "useful steps accounted",
+                    rep["steps"], **labels),
+            counter("goodput_replay_steps_total",
+                    "steps re-executed after restarts (badput)",
+                    rep["replay_steps"], **labels),
+        ]
+        frac = gauge("goodput_fraction",
+                     "wall-clock share per exclusive category "
+                     "(observe pillar 8)")
+        badput = counter("goodput_badput_seconds_total",
+                         "non-step wall seconds per category")
+        for cat, v in sorted(rep["fractions"].items()):
+            frac.add(v, category=cat, **labels)
+        for cat, v in sorted(rep["categories_s"].items()):
+            if cat != "step":
+                badput.add(v, category=cat, **labels)
+        fams += [frac, badput]
+        fams.append(gauge("goodput_mean_step_seconds",
+                          "mean accounted step time",
+                          rep.get("mean_step_s"), **labels))
+        fams.append(gauge("goodput_effective_mfu",
+                          "headline MFU x goodput fraction",
+                          rep.get("effective_mfu"), **labels))
+        fams.append(gauge("goodput_straggler_est_seconds",
+                          "heartbeat-skew straggler estimate "
+                          "(informational, overlaps steps)",
+                          rep.get("straggler_est_s"), **labels))
+        return fams
+
+    return collect
+
+
 def process_collector() -> Callable[[], List[MetricFamily]]:
     """Process-level basics (stdlib only)."""
 

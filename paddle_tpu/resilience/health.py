@@ -522,6 +522,7 @@ class HealthPlane:
                                      clock=clock, event_log=event_log)
         self._consumed_poison: set = set()
         self._started = False
+        self._ledger = None  # observe.GoodputLedger (attach_ledger)
 
     def start(self) -> "HealthPlane":
         if not self._started:
@@ -539,6 +540,14 @@ class HealthPlane:
         """Late-bind a RunEventLog (init_distributed starts the plane
         before any Trainer exists; the Trainer re-points events here)."""
         self.monitor.event_log = event_log
+
+    def attach_ledger(self, ledger) -> None:
+        """Late-bind a goodput ledger (observe pillar 8, same pattern
+        as attach_event_log): the plane's genuinely BLOCKING wait —
+        wait_gang_done's done-rendezvous — records as barrier_wait so
+        a finished rank's wait for laggards is accounted wall clock,
+        not unexplained idle."""
+        self._ledger = ledger
 
     # -- step-boundary surface (NO RPC on this path) ----------------------
     def beat(self, step: int) -> None:
@@ -606,6 +615,13 @@ class HealthPlane:
         clean-exit rendezvous: callers exit 0 either way — their own
         work is complete — but waiting keeps a finished rank's
         heartbeat alive until the laggards arrive."""
+        if self._ledger is not None:
+            with self._ledger.phase("barrier_wait",
+                                    label="wait_gang_done"):
+                return self._wait_gang_done(timeout_s, poll_s)
+        return self._wait_gang_done(timeout_s, poll_s)
+
+    def _wait_gang_done(self, timeout_s: float, poll_s: float) -> bool:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if self.monitor.alarm() is not None:

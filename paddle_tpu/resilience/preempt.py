@@ -103,10 +103,16 @@ class SnapshotWriter:
     two saves never interleave, and the step loop is back to training
     the moment the new snapshot is taken).  A latched writer failure
     is raised on the next submit/wait_idle/close as
-    CheckpointWriteError — use `check()` to poll it explicitly."""
+    CheckpointWriteError — use `check()` to poll it explicitly.
 
-    def __init__(self, name: str = "ckpt-writer"):
+    `ledger`: an observe GoodputLedger — each completed write phase is
+    recorded on its `ckpt_write` BACKGROUND channel (overlapped work,
+    deliberately not a wall category; the blocking snapshot the step
+    loop waited out is the caller's "checkpoint" phase)."""
+
+    def __init__(self, name: str = "ckpt-writer", ledger=None):
         self._name = name
+        self._ledger = ledger
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._inflight: Optional[PendingSave] = None
@@ -150,6 +156,9 @@ class SnapshotWriter:
                 pending._error = e
             finally:
                 pending.write_ms = (time.perf_counter() - t0) * 1000.0
+                if self._ledger is not None:
+                    self._ledger.note_background(
+                        "ckpt_write", pending.write_ms / 1000.0)
                 pending._done.set()
 
         self._inflight = pending

@@ -308,6 +308,7 @@ class Supervisor:
         procs: Dict[int, subprocess.Popen] = {}
         try:
             for attempt in range(self.max_restarts + 1):
+                attempt_t0 = time.monotonic()
                 procs = self._spawn_gang(attempt)
                 try:
                     codes = self._wait_gang(procs)
@@ -318,7 +319,13 @@ class Supervisor:
                        "exit_codes": dict(sorted(codes.items())),
                        "classified": {r: classify_exit(rc)
                                       for r, rc in sorted(codes.items())},
-                       "reason": reason}
+                       "reason": reason,
+                       # attempt wall clock: a broken attempt's whole
+                       # duration is restart badput from the job's
+                       # point of view (the goodput ledger inside each
+                       # worker decomposes the useful part)
+                       "duration_s": round(
+                           time.monotonic() - attempt_t0, 3)}
                 if self.elastic and reason != "ok":
                     # signal deaths = lost capacity (preempted machine);
                     # the next attempt runs with the survivors only and

@@ -22,7 +22,12 @@ Protocol:
   attached) and exit `PEER_LOST_EXIT_CODE`,
 - on SIGTERM: the Trainer drain path exits `PREEMPT_EXIT_CODE`,
 - on clean completion: final persistables land in
-  `<out-root>/rank<k>.npz` and the worker prints "DONE".
+  `<out-root>/rank<k>.npz`, the goodput ledger report (observe pillar
+  8) in `<out-root>/rank<k>.goodput.json`, and the worker prints
+  "DONE" — unless the done-rendezvous finds the gang broken (a peer
+  died AFTER this rank finished), in which case the same structured
+  "PEER_LOST <json>" + `PEER_LOST_EXIT_CODE` exit as the mid-train
+  path, so the supervisor classifies the attempt correctly.
 
 mode=barrier_poison: rank 1 writes the poison key and dies; rank 0
 enters a sharded-save barrier and must get a
@@ -184,11 +189,39 @@ def main():
               if v.persistable}
     os.makedirs(args.out_root, exist_ok=True)
     np.savez(os.path.join(args.out_root, f"rank{rank}.npz"), **params)
+    def dump_goodput():
+        # pillar-8 artifact: this process's wall-clock decomposition
+        # (instrumented waits land via the attached ledger); a
+        # relaunched rank's report carries the restart-replay badput
+        # the chaos test asserts on
+        with open(os.path.join(args.out_root,
+                               f"rank{rank}.goodput.json"), "w") as f:
+            json.dump(trainer.goodput(), f)
+
     # orderly leave: announce done and wait for the laggards so a
     # finished rank's silence is never mistaken for death (resumed
     # ranks run different numbers of remaining steps)
     plane.leave()
-    plane.wait_gang_done(timeout_s=60.0)
+    if not plane.wait_gang_done(timeout_s=60.0):
+        # the gang broke while we waited for the laggards (a peer died
+        # after we finished — ranks drift apart, so a mid-train kill
+        # for the victim can be post-train for us): surface the SAME
+        # structured detection the mid-train path prints, so the
+        # supervisor classifies the attempt as peer_lost, not a bare
+        # crash.  A plain done-wait timeout still falls through to
+        # DONE — our own work is complete either way.
+        try:
+            plane.check()
+        except (GangError, CheckpointBarrierPoisonedError) as e:
+            payload = e.as_dict()
+            payload["detected_at_train_s"] = round(
+                time.monotonic() - t0, 3)
+            payload["rank"] = rank
+            payload["at"] = "done_wait"
+            dump_goodput()
+            print("PEER_LOST " + json.dumps(payload), flush=True)
+            os._exit(PEER_LOST_EXIT_CODE)
+    dump_goodput()
     print("DONE", flush=True)
     sys.stdout.flush()
     os._exit(0)  # skip distributed teardown (peer may already be gone)

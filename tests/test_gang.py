@@ -612,12 +612,18 @@ def _assert_no_orphans(tag):
 def run_gang_sigkill_chaos(tmp_path):
     """SIGKILL a random rank at a random mid-train step; assert
     bounded structured detection, one supervisor restart, bit-exact
-    final params vs control, and no orphans."""
+    final params vs control, restart-replay badput in the relaunched
+    ranks' goodput ledgers (observe pillar 8), and no orphans."""
     import random
 
     rng = random.Random(os.urandom(8))
     victim = rng.randrange(2)  # the COORDINATOR rank is fair game too
     kill_at = rng.randrange(3, (EPOCHS * STEPS_PER_EPOCH * 3) // 4)
+    # keep the kill off the save boundary (crash cursor == resume
+    # cursor -> zero replay): the goodput assertions below want the
+    # victim's relaunch to re-execute at least one step
+    if kill_at % 3 == 0:
+        kill_at += 1
 
     dc = os.path.join(tmp_path, "ctl")
     sup_c = Supervisor(_worker_cmd(dc), 2, max_restarts=0, grace_s=8.0,
@@ -669,10 +675,63 @@ def run_gang_sigkill_chaos(tmp_path):
             assert a[k].dtype == b[k].dtype
             assert np.array_equal(a[k], b[k]), \
                 f"rank{rank} {k} NOT bit-identical after gang restart"
+
+    # pillar-8 acceptance: every rank that completed dumped its
+    # goodput ledger, and the relaunched ranks' reports carry the
+    # restart-replay badput matching the crash cursors the attempt-0
+    # STEP lines recorded
+    def _goodput(d, rank):
+        p = os.path.join(d, "out", f"rank{rank}.goodput.json")
+        with open(p) as f:
+            return json.load(f)
+
+    def _last_step(out_path):
+        steps = [ln.split() for ln in open(out_path).read().splitlines()
+                 if ln.startswith("STEP ")]
+        return int(steps[-1][1]), int(steps[-1][2])
+
+    def _g(cursor):  # (epoch, step) cursor -> global step count
+        return cursor[0] * STEPS_PER_EPOCH + cursor[1]
+
+    replayed = {}
+    for rank in (0, 1):
+        ctl = _goodput(dc, rank)
+        assert ctl["replay_steps"] == 0 and "replay" not in ctl, ctl
+        rep = _goodput(dv, rank)
+        cats = rep["categories_s"]
+        assert abs(sum(cats.values()) - rep["wall_s"]) < 1e-3, rep
+        # per-step health beats + the done-rendezvous are accounted
+        assert cats["barrier_wait"] > 0.0, rep
+        le, ls = _last_step(os.path.join(
+            dv, "sup", f"attempt0_rank{rank}.out"))
+        # the victim died INSIDE its last STEP's handler — that step's
+        # progress write never landed; the survivor reached the next
+        # step boundary before detection raised
+        crash_cursor = (le, ls) if rank == victim else (le, ls + 1)
+        if rank == victim:
+            assert rep["replay_steps"] >= 1, rep  # kill_at % 3 != 0
+        if rep["replay_steps"]:
+            assert _g(rep["replay"]["to"]) == _g(crash_cursor), \
+                (rank, rep["replay"], crash_cursor)
+            # every step between resume and crash cursor ran twice
+            assert rep["replay_steps"] == \
+                _g(rep["replay"]["to"]) - _g(rep["replay"]["from"]), rep
+            # replay badput ~ replayed-step count x mean step time;
+            # the first resumed dispatch pays a residual cold cost
+            # beyond the re-attributed trace/compile wall (buffer
+            # setup, executable caching) — allowed as absolute slack
+            est = rep["replay_steps"] * rep["mean_step_s"]
+            assert 0.1 * est < cats["replay"] < 10 * est + 0.1, \
+                (rank, rep)
+        else:
+            assert "replay" not in rep, rep
+        replayed[rank] = rep["replay_steps"]
+
     _assert_no_orphans(tmp_path)
     assert elapsed < 180, f"chaos run took {elapsed:.0f}s"
     return {"victim": victim, "kill_at": kill_at,
-            "detect_age_s": age, "wall_s": round(elapsed, 1)}
+            "detect_age_s": age, "replay_steps": replayed,
+            "wall_s": round(elapsed, 1)}
 
 
 ELASTIC_WORKER = os.path.join(HERE, "elastic_worker.py")

@@ -363,7 +363,11 @@ def _dp_entry(**over):
          "last_loss": 1.0, "ckpt_blocking_ms": 1.0,
          # numerics observability contract (ISSUE 11): training
          # entries carry the window's grad norm + worst update ratio
-         "grad_norm_last": 0.5, "update_ratio_worst": 1e-3}
+         "grad_norm_last": 0.5, "update_ratio_worst": 1e-3,
+         # goodput-ledger contract (observe pillar 8): training
+         # entries decompose their harness wall next to the headline
+         "goodput": 0.9, "effective_mfu": 0.27,
+         "badput_breakdown": {"compile": 0.08, "idle": 0.02}}
     e.update(over)
     return e
 

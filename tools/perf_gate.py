@@ -16,10 +16,11 @@ Two modes, matching what each environment can actually verify:
   metric/value/unit/vs_baseline/detail plus compile_s/retraces/
   peak_mem_bytes/run_id/git_sha (docs/OBSERVE.md), and per training
   entry the checkpoint-cost fields (ckpt_blocking_ms/ckpt_write_ms,
-  docs/RESILIENCE.md) plus the numerics-observability fields
-  (grad_norm_last / update_ratio_worst, docs/OBSERVE.md pillar 6) —
-  so a chip-less CI still catches a broken artifact shape before it
-  burns a chip run.
+  docs/RESILIENCE.md), the numerics-observability fields
+  (grad_norm_last / update_ratio_worst, docs/OBSERVE.md pillar 6) and
+  the goodput-ledger fields (goodput / effective_mfu /
+  badput_breakdown, pillar 8) — so a chip-less CI still catches a
+  broken artifact shape before it burns a chip run.
 
 Baselines load from either a raw bench JSON line/file or a driver
 wrapper ({"tail": ..., "parsed": ...}); a truncated wrapper tail (the
@@ -176,6 +177,16 @@ def check_schema(candidate):
                                   f"missing {field!r} (numerics "
                                   f"observability, docs/OBSERVE.md "
                                   f"pillar 6)")
+            # wall-clock goodput (observe pillar 8): a training entry
+            # must decompose its harness wall next to the headline —
+            # goodput (step fraction), effective_mfu (headline x
+            # goodput) and the badput_breakdown category fractions
+            for field in ("goodput", "effective_mfu",
+                          "badput_breakdown"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: training entry "
+                                  f"missing {field!r} (goodput "
+                                  f"ledger, docs/OBSERVE.md pillar 8)")
         # span-derived phase breakdown (ISSUE 15, observe pillar 7): a
         # serving latency number without its queue/form/dispatch
         # decomposition cannot answer "where did the time go" — the
@@ -266,7 +277,7 @@ def check_schema(candidate):
 
 def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
                    regressions, report, tol_mem=0.10, tol_ls=0.02,
-                   tol_comm=0.10):
+                   tol_comm=0.10, tol_gp=0.05):
     if "error" in cand and "error" not in base:
         regressions.append(f"{name}: candidate errored: "
                            f"{cand['error']}")
@@ -357,6 +368,22 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
         report.append(line)
         if rise > tol_comm:
             regressions.append(line + f" exceeds tol {tol_comm:.0%}")
+    # wall-clock goodput (observe pillar 8): the step share of the
+    # harness wall.  ABSOLUTE share-point drop gates, and ONLY between
+    # same-shaped runs (same measured step count) — the warmup/compile
+    # split scales with steps, so cross-shape goodput fractions are
+    # apples-to-oranges (the same-source rule, like mem_breakdown's
+    # source match above)
+    bgp, cgp = base.get("goodput"), cand.get("goodput")
+    if isinstance(bgp, (int, float)) and isinstance(cgp, (int, float)) \
+            and base.get("steps") == cand.get("steps"):
+        fall = bgp - cgp
+        line = (f"{name}.goodput: {bgp:.4f} -> {cgp:.4f} "
+                f"({-fall:+.4f})")
+        report.append(line)
+        if fall > tol_gp:
+            regressions.append(
+                line + f" exceeds tol -{tol_gp:.2f} share points")
     # ZeRO opt-state footprint: per-device resident accumulator bytes
     # of the sharded step (same mesh + grad_sync guaranteed above) —
     # creeping back up means the fsdp sharding quietly stopped applying
@@ -373,7 +400,7 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
 
 
 def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
-         tol_mem=0.10, tol_ls=0.02, tol_comm=0.10,
+         tol_mem=0.10, tol_ls=0.02, tol_comm=0.10, tol_gp=0.05,
          allow_missing=False):
     """(regressions, report_lines, compared_count).  Only entries whose
     device kind matches are compared — a CPU smoke candidate never
@@ -401,7 +428,7 @@ def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
         compared += 1
         _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
                        regressions, report, tol_mem=tol_mem,
-                       tol_ls=tol_ls, tol_comm=tol_comm)
+                       tol_ls=tol_ls, tol_comm=tol_comm, tol_gp=tol_gp)
         if "int8" in base and isinstance(cand.get("int8"), dict) \
                 and "error" not in base["int8"]:
             if "error" in cand["int8"]:
@@ -451,6 +478,14 @@ def main() -> int:
                         "regression even when throughput noise hides "
                         "it.  Compared only between entries with the "
                         "same mesh AND grad_sync mode")
+    p.add_argument("--tol-goodput", type=float, default=0.05,
+                   help="tolerated ABSOLUTE drop in a training entry's "
+                        "goodput fraction (observe pillar 8 wall-clock "
+                        "ledger).  Compared only between entries that "
+                        "measured the SAME step count — the harness "
+                        "warmup/compile split scales with steps, so "
+                        "cross-shape goodput is not comparable (the "
+                        "same-source rule)")
     p.add_argument("--allow-missing", action="store_true",
                    help="baseline entries absent from the candidate "
                         "are not regressions (partial --model runs)")
@@ -501,7 +536,7 @@ def main() -> int:
         baseline, candidate, tol_mfu=args.tol_mfu,
         tol_tp=args.tol_throughput, tol_lat=args.tol_latency,
         tol_mem=args.tol_peak_mem, tol_ls=args.tol_layout_share,
-        tol_comm=args.tol_comm_bytes,
+        tol_comm=args.tol_comm_bytes, tol_gp=args.tol_goodput,
         allow_missing=args.allow_missing)
     for line in report:
         print("  " + line)

@@ -3,10 +3,13 @@ perf-feature configuration on the real chip and write a combined
 AB artifact with the winners, so every bench default reflects a
 measured win.
 
-Usage: python tools/run_ab.py [--steps N] [--out AB_r07.json]
+Usage: python tools/run_ab.py [--steps N] [--out AB_r11.json]
 Each variant is a separate bench.py subprocess (fresh backend, no cache
 cross-talk); the probe inside bench.py keeps a dead backend from
-burning the timeout.
+burning the timeout.  r11: every pair's summary carries goodput
+context (`<name>_goodput` — each side's harness-wall step fraction +
+effective_mfu, observe pillar 8) so a throughput verdict bought with
+badput is visible in the artifact itself.
 
 r06 added the scan-bound lstm variants (unroll sweep + the Pallas fused
 recurrence kernel vs the scan base).  r08 adds the dp-mesh pair
@@ -337,6 +340,28 @@ def opt_state_measure(results, k):
     return None
 
 
+def goodput_measure(results, k):
+    """The variant's (goodput, effective_mfu) pair from the expected
+    model entry (observe pillar 8: the harness-wall step fraction and
+    the headline scaled by it), or None for NO DATA.  Context only —
+    a variant whose throughput "win" came with a goodput collapse
+    (e.g. a compile-storm per run) is visible in the same artifact;
+    throughput still decides, as everywhere."""
+    d = results.get(k, {})
+    if "error" in d or "failed" in d or \
+            d.get("metric") == "bench_failed":
+        return None
+    detail = d.get("detail") or {}
+    model = _VARIANT_MODEL.get(k)
+    subs = (_model_entries(detail, model) if model is not None
+            else [sub for sub in detail.values() if isinstance(sub, dict)])
+    for sub in subs:
+        if isinstance(sub.get("goodput"), (int, float)):
+            return {"goodput": sub["goodput"],
+                    "effective_mfu": sub.get("effective_mfu")}
+    return None
+
+
 def wins(results, a, b):
     # a missing side must yield "no data", never a vacuous win —
     # AB wins gate bench defaults (CLAUDE.md measured-wins-only).
@@ -416,6 +441,14 @@ def compute_summary(results):
             # the fsdp pairs' point: per-device resident opt-state
             # bytes — the ZeRO ~1/N claim in the artifact itself
             out[f"{name}_opt_state_bytes"] = {a: oa, b: ob}
+        ga, gb = (goodput_measure(results, a),
+                  goodput_measure(results, b))
+        if ga is not None and gb is not None:
+            # goodput context (observe pillar 8) next to the verdict:
+            # each side's harness-wall step fraction + effective_mfu,
+            # so a throughput win bought with badput (compile storms,
+            # ckpt stalls) is visible in the same artifact
+            out[f"{name}_goodput"] = {a: ga, b: gb}
     # the ZeRO scaling record (ISSUE 13 acceptance): opt-state bytes
     # per device across the fsdp ladder vs the dp=8 replicated
     # baseline — drop >=1.7x at fsdp=2, ~N/1 at fsdp=4/8 (the pinned
@@ -442,7 +475,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r10.json")
+    p.add_argument("--out", default="AB_r11.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     p.add_argument("--bench-args", default=None,

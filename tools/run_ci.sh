@@ -689,6 +689,67 @@ print("numerics provenance smoke OK:",
        "groups": sorted(tel.groups)})
 EOF
 
+echo "== goodput ledger smoke (cpu) =="
+# ISSUE 16 tentpole (docs/OBSERVE.md pillar 8): a short Trainer run with
+# a deliberately slow reader + periodic checkpoint saves must yield a
+# ledger whose categories sum EXACTLY to the wall clock (idle is the
+# residual), attribute the reader sleeps to data_stall and the save
+# blocking to checkpoint, print the human table, and scale the headline
+# MFU down to effective_mfu — never up.
+python - <<'EOF'
+import os, tempfile, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import CheckpointConfig, Trainer
+from paddle_tpu.observe import format_goodput_table
+from paddle_tpu.observe.goodput import CATEGORIES
+
+d = tempfile.mkdtemp()
+
+def train_func():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+def reader():
+    r = np.random.RandomState(0)
+    for _ in range(6):
+        time.sleep(0.02)            # the input-pipeline stall
+        yield {"x": r.rand(8, 4).astype(np.float32),
+               "y": r.rand(8, 1).astype(np.float32)}
+
+t = Trainer(train_func,
+            lambda: fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+            checkpoint_config=CheckpointConfig(os.path.join(d, "ck"),
+                                               step_interval=2))
+t.train(num_epochs=1, reader=reader)
+rep = t.goodput(mfu=0.3254)
+cats = rep["categories_s"]
+assert set(cats) == set(CATEGORIES), cats
+assert abs(sum(cats.values()) - rep["wall_s"]) < 1e-3, \
+    (sum(cats.values()), rep["wall_s"])
+assert abs(sum(rep["fractions"].values()) - 1.0) < 1e-4, rep["fractions"]
+assert rep["steps"] == 6 and rep["replay_steps"] == 0, rep
+assert cats["data_stall"] >= 0.05, cats        # 6 x 20ms reader sleeps
+assert cats["checkpoint"] > 0, cats            # blocking snapshot phases
+assert rep["effective_mfu"] <= rep["mfu"], rep # goodput never scales UP
+# effective_mfu is computed from the UNROUNDED step fraction inside
+# report(); recomputing from the rounded goodput can differ by 1e-6
+assert abs(rep["effective_mfu"] - 0.3254 * rep["goodput"]) < 2e-6
+print(format_goodput_table(rep))
+t.stop()
+print("goodput smoke OK:",
+      {"wall_s": rep["wall_s"], "goodput": rep["goodput"],
+       "effective_mfu": rep["effective_mfu"],
+       "data_stall_s": cats["data_stall"],
+       "checkpoint_s": cats["checkpoint"]})
+EOF
+
 echo "== gang-chaos smoke (cpu) =="
 # ISSUE 9 (docs/RESILIENCE.md, distributed failure model): a REAL
 # 2-worker gang under the self-healing supervisor — SIGKILL a random
