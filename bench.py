@@ -1640,6 +1640,12 @@ def main():
                    help="per-model wall-clock budget; a hung model "
                         "records an error instead of burning the run "
                         "(0 disables)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="observe pillar 9: run an AlertEngine "
+                        "(compile-storm/nonfinite tripwires) for the "
+                        "bench and write a diagnostic flight bundle "
+                        "there on every model failure/hang — failed "
+                        "entries carry alerts_fired + flight_bundle")
     args = p.parse_args()
     amp = not args.no_amp
 
@@ -1766,6 +1772,34 @@ def main():
 
     run_snap = _obs_monitoring.runtime_stats.snapshot()
 
+    # observe pillar 9 (opt-in): a host-only AlertEngine watching the
+    # run's own runtime counters, and a FlightRecorder that captures
+    # the evidence bundle the moment a model fails or hangs — instead
+    # of reconstructing a 3 a.m. tunnel-session failure from stderr
+    _alert_eng = None
+    _flight_rec = None
+    if args.flight_dir:
+        from paddle_tpu.observe.alerts import AlertEngine, ThresholdRule
+        from paddle_tpu.observe.flightrec import FlightRecorder
+        from paddle_tpu.observe.registry import (MetricsRegistry,
+                                                 standard_collectors)
+
+        _areg = standard_collectors(MetricsRegistry())
+        _alert_eng = AlertEngine(_areg, rules=[
+            ThresholdRule(
+                "bench_compile_storm", "runtime_retraces_total",
+                op=">", threshold=0.05, window_s=120.0,
+                description="retrace storm during bench"),
+        ], interval_s=10.0)
+        _areg.register("alerts", _alert_eng.collector())
+        # every failing model gets its own bundle: the per-model
+        # SIGALRM deadline means failures can be ~15 min apart, but a
+        # cascade (dead backend) must not be rate-limited away
+        _flight_rec = FlightRecorder(args.flight_dir, registry=_areg,
+                                     min_interval_s=0.0)
+        _flight_rec.attach_engine(_alert_eng)
+        _alert_eng.start()
+
     detail = {}
 
     # a stale snapshot from a PREVIOUS run must not masquerade as this
@@ -1831,6 +1865,14 @@ def main():
                 "hang_phase": ("first_compile" if d["dispatches"] == 0
                                else "hung_step"),
             }
+            if _alert_eng is not None:
+                # pillar 9: the failure line carries what was firing
+                # at the moment of death plus the evidence bundle
+                _alert_eng.evaluate()
+                detail[name]["alerts_fired"] = _alert_eng.firing()
+                detail[name]["flight_bundle"] = _flight_rec.record(
+                    f"bench_{name}_{detail[name]['hang_phase']}",
+                    context=dict(detail[name]), force=True)
             print(f"warning: {name} bench failed, continuing",
                   file=sys.stderr)
         # observability stamp (observe pillar 2): compile wall-time and
@@ -2101,6 +2143,13 @@ def main():
         # profiler-inflated numbers must be distinguishable from clean
         # runs (bench-honesty gate)
         result["profiled"] = args.profile
+    if _alert_eng is not None:
+        # pillar 9 rides the one JSON line: what fired over the whole
+        # run and where the evidence bundles landed
+        _alert_eng.evaluate()
+        _alert_eng.close()
+        result["alerts_fired"] = _alert_eng.firing()
+        result["flight_bundles"] = _flight_rec.snapshot()["bundles"]
     if not failed and result["metric"] != "bench_failed":
         # the incremental snapshot is crash evidence only — it must
         # never outlive a clean run (a grep for "mfu" should find the

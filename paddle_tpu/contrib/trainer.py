@@ -203,6 +203,8 @@ class Trainer:
         #                                (the metrics-registry source)
         self._metrics_registry = None
         self._metrics_server = None
+        self.alert_engine = None       # observe pillar 9 (opt-in)
+        self.flight_recorder = None
         self._event_log = None
         if self.telemetry_cfg is not None:
             from .. import observe
@@ -597,9 +599,16 @@ class Trainer:
                         f"{fields.get('what')}", kind="step_hang",
                         hang=fields)
 
+            on_hang = _on_hang
+            if self.flight_recorder is not None:
+                # capture the diagnostic bundle BEFORE the gang
+                # poison: the abort path may end the process
+                on_hang = self.flight_recorder.watchdog_hook(_on_hang)
             self._step_watchdog = DispatchWatchdog(
                 self.step_deadline_s, event_log=self._event_log,
-                on_hang=_on_hang)
+                on_hang=on_hang)
+        if self.flight_recorder is not None:
+            self.flight_recorder.watchdog = self._step_watchdog
         self._active_reader = reader
         if (self._resume_reader_state is not None and reader is not None
                 and hasattr(reader, "load_state_dict")):
@@ -913,8 +922,59 @@ class Trainer:
 
         self._metrics_server = MetricsServer(
             self.metrics_registry(), health_fn=health,
-            host=host, port=port).start()
+            host=host, port=port,
+            alerts_fn=(self.alert_engine.state
+                       if self.alert_engine is not None
+                       else None)).start()
         return self._metrics_server
+
+    def enable_alerts(self, rules=None, interval_s: float = 5.0,
+                      flight_dir: Optional[str] = None,
+                      recorder_config: Optional[dict] = None,
+                      start: bool = True, **pack_kw):
+        """Opt into observe pillar 9 on this trainer: an AlertEngine
+        evaluating the training-health pack
+        (`observe.trainer_rule_pack` — goodput drop, throughput
+        regression, loss-spike/grad-norm z-scores, nonfinite steps,
+        compile storm, gang skew; or explicit `rules`) over
+        `metrics_registry()` every `interval_s` on a background
+        thread.  With `flight_dir`, a FlightRecorder bundles
+        diagnostics (event tail, metrics, goodput table, latched
+        nonfinite provenance, watchdog state, thread stacks) on every
+        firing alert AND on the step watchdog's hang verdict — the
+        recorder's capture chains BEFORE the gang-poison on_hang.
+        Pure host: zero device dispatches from the alert thread, no
+        step-path hooks, step lowering byte-identical on vs off
+        (tests/test_alerts.py pins it).  Stopped by stop()."""
+        if self.alert_engine is not None:
+            return self.alert_engine
+        from ..observe.alerts import AlertEngine, trainer_rule_pack
+        from ..observe.flightrec import FlightRecorder
+
+        if rules is None:
+            rules = trainer_rule_pack(**pack_kw)
+        elif pack_kw:
+            raise ValueError("pack_kw only applies to the default "
+                             "rule pack")
+        engine = AlertEngine(self.metrics_registry(), rules=rules,
+                             interval_s=interval_s,
+                             event_log=self._event_log)
+        self.metrics_registry().register("alerts", engine.collector())
+        if flight_dir is not None:
+            self.flight_recorder = FlightRecorder(
+                flight_dir, registry=self.metrics_registry(),
+                event_log=self._event_log,
+                goodput=self.goodput_ledger,
+                telemetry_fetch=lambda: self.last_telemetry,
+                watchdog=self._step_watchdog,
+                **(recorder_config or {}))
+            self.flight_recorder.attach_engine(engine)
+        self.alert_engine = engine
+        if self._metrics_server is not None:
+            self._metrics_server.alerts_fn = engine.state
+        if start:
+            engine.start()
+        return engine
 
     def save_params(self, dirname: str):
         with scope_guard(self.scope):
@@ -930,6 +990,10 @@ class Trainer:
                 main_program=self.train_program)
 
     def stop(self):
+        if self.alert_engine is not None:
+            self.alert_engine.close()
+        if self.flight_recorder is not None:
+            self.flight_recorder.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None

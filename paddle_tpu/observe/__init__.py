@@ -72,18 +72,37 @@ Three pillars (docs/OBSERVE.md):
    goodput; `export_chrome_trace` draws the step-anatomy timeline on
    rows aligned with reqtrace's exporter; `goodput_collector` feeds
    /metrics.  contrib.Trainer threads it (`Trainer.goodput()`).
+
+9. ALERTING + FLIGHT RECORDING — `alerts.py` is the layer that
+   *watches* pillars 1-8: declarative rules (threshold, multi-window
+   burn-rate, z-score anomaly) evaluated on a background thread over
+   `MetricsRegistry` snapshots, each walking a pending→firing→resolved
+   state machine with `for_duration`/hysteresis, emitting registered
+   `alert_*` events, exporting an `alerts` metric family + `/alerts`
+   route, and exposing `signals()` for the future autoscaler;
+   `flightrec.py` writes rate-limited, size-bounded diagnostic
+   bundles (event tail, metrics snapshot, reqtrace export, goodput
+   table, numerics provenance, thread stacks) on firing alerts,
+   watchdog hangs, and unhandled crashes.  Pure host, zero device
+   dispatches, byte-identical step lowering on vs off.
 """
 
 from . import cost  # noqa: F401
+from .alerts import (AlertEngine, AlertRule, AnomalyRule,  # noqa: F401
+                     BurnRateRule, MetricSelector, ThresholdRule,
+                     fleet_rule_pack, serving_rule_pack,
+                     snapshot_value, trainer_rule_pack)
 from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    device_peaks, flash_boundary_layout,
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
-from .events import (DECODE_EVENTS, FLEET_EVENTS,  # noqa: F401
-                     GANG_EVENTS, GOODPUT_EVENTS, NUMERICS_EVENTS,
+from .events import (ALERT_EVENTS, DECODE_EVENTS,  # noqa: F401
+                     FLEET_EVENTS, FLIGHT_EVENTS, GANG_EVENTS,
+                     GOODPUT_EVENTS, NUMERICS_EVENTS,
                      RESILIENCE_EVENTS, SERVING_EVENTS, BoundEventLog,
                      RunEventLog, git_sha, new_run_id, read_events,
                      register_event_kinds, set_strict_kinds)
+from .flightrec import FlightRecorder  # noqa: F401
 from .goodput import (CATEGORIES as GOODPUT_CATEGORIES,  # noqa: F401
                       GoodputLedger, format_goodput_table,
                       goodput_report)

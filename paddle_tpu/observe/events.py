@@ -127,6 +127,26 @@ GOODPUT_EVENTS = (
 )
 
 
+# alerting event kinds (docs/OBSERVE.md pillar 9): the AlertEngine's
+# rule state-machine transitions — the records a pager/dashboard keys
+# off, so the kinds are registered AND prefix-validated (an unknown
+# alert_* kind is exactly the typo class this registry exists for)
+ALERT_EVENTS = (
+    "alert_pending",   # a rule breached; for_duration gating running
+    "alert_firing",    # LOUD: the breach persisted — the rule fired
+    #                    (value/target/severity attached; the
+    #                    FlightRecorder bundles on this transition)
+    "alert_resolved",  # the firing rule cleared (hysteresis +
+    #                    resolve_duration satisfied)
+)
+
+# flight-recorder event kinds (docs/OBSERVE.md pillar 9)
+FLIGHT_EVENTS = (
+    "flight_record",   # one diagnostic bundle written: reason, path,
+    #                    truncation flag, per-section errors
+)
+
+
 # numerics observability event kinds (docs/OBSERVE.md pillar 6):
 # emitted by contrib.Trainer next to its telemetry windows
 NUMERICS_EVENTS = (
@@ -147,10 +167,12 @@ NUMERICS_EVENTS = (
 # raise under tests (strict).
 # ---------------------------------------------------------------------------
 
-_VALIDATED_PREFIXES = ("serving_", "fleet_", "gang_")
+_VALIDATED_PREFIXES = ("serving_", "fleet_", "gang_", "alert_",
+                       "flight_")
 _KNOWN_KINDS = set(SERVING_EVENTS) | set(DECODE_EVENTS) \
     | set(FLEET_EVENTS) | set(GANG_EVENTS) | set(RESILIENCE_EVENTS) \
-    | set(NUMERICS_EVENTS) | set(GOODPUT_EVENTS)
+    | set(NUMERICS_EVENTS) | set(GOODPUT_EVENTS) | set(ALERT_EVENTS) \
+    | set(FLIGHT_EVENTS)
 _strict_kinds = [False]
 _warned_kinds: set = set()
 

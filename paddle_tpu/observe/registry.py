@@ -621,20 +621,27 @@ def metrics_snapshot(registry: Optional[MetricsRegistry] = None
 # ---------------------------------------------------------------------------
 
 class MetricsServer:
-    """stdlib ThreadingHTTPServer exposing /metrics + /healthz.
+    """stdlib ThreadingHTTPServer exposing /metrics + /healthz (+
+    /alerts when an alerts_fn is attached).
 
         srv = MetricsServer(registry, health_fn=fleet.health).start()
         ...  # scrape http://127.0.0.1:{srv.port}/metrics
         srv.close()
 
-    Binds 127.0.0.1 by default (`host=` to override deliberately —
-    the exposition carries operational detail).  port=0 picks an
-    ephemeral port, read back from `.port`.
+    `alerts_fn` (observe pillar 9) returns the AlertEngine.state()
+    JSON served on /alerts; it is read per-request, so attaching an
+    engine AFTER the server started (`srv.alerts_fn = engine.state`)
+    works — /alerts answers 404 until then.  Binds 127.0.0.1 by
+    default (`host=` to override deliberately — the exposition carries
+    operational detail).  port=0 picks an ephemeral port, read back
+    from `.port`.
     """
 
     def __init__(self, registry: MetricsRegistry,
                  health_fn: Optional[Callable[[], Dict[str, Any]]]
-                 = None, host: str = "127.0.0.1", port: int = 0):
+                 = None, host: str = "127.0.0.1", port: int = 0,
+                 alerts_fn: Optional[Callable[[], Dict[str, Any]]]
+                 = None):
         from http.server import (BaseHTTPRequestHandler,
                                  ThreadingHTTPServer)
 
@@ -642,17 +649,24 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib API
-                if self.path.split("?")[0] == "/metrics":
+                route = self.path.split("?")[0]
+                if route == "/metrics":
                     body = server_ref.registry.prometheus_text() \
                         .encode("utf-8")
                     ctype = ("text/plain; version=0.0.4; "
                              "charset=utf-8")
-                elif self.path.split("?")[0] == "/healthz":
+                elif route == "/healthz":
                     health = ({"ok": True}
                               if server_ref.health_fn is None
                               else server_ref.health_fn())
                     body = json.dumps(
                         health, default=str).encode("utf-8")
+                    ctype = "application/json"
+                elif route == "/alerts" \
+                        and server_ref.alerts_fn is not None:
+                    body = json.dumps(
+                        server_ref.alerts_fn(),
+                        default=str).encode("utf-8")
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -668,6 +682,7 @@ class MetricsServer:
 
         self.registry = registry
         self.health_fn = health_fn
+        self.alerts_fn = alerts_fn
         self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
         self._httpd.daemon_threads = True
         self.host = host

@@ -394,6 +394,8 @@ class Fleet:
         self._rolling = False
         self._metrics_registry = None
         self._metrics_server = None
+        self.alert_engine = None       # observe pillar 9 (opt-in)
+        self.flight_recorder = None
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "Fleet":
@@ -421,6 +423,10 @@ class Fleet:
         if close_replicas:
             for h in self.replicas:
                 h.engine.close(timeout_s)
+        if self.alert_engine is not None:
+            self.alert_engine.close()
+        if self.flight_recorder is not None:
+            self.flight_recorder.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
@@ -489,16 +495,65 @@ class Fleet:
         """Opt-in /metrics + /healthz endpoint over this fleet's
         registry (stdlib ThreadingHTTPServer; binds localhost unless
         told otherwise — the exposition carries per-replica health
-        detail).  port=0 picks an ephemeral port; read `.port` / `.url`
-        off the returned MetricsServer.  Stopped by close()."""
+        detail).  With `enable_alerts()` active the same server also
+        answers /alerts.  port=0 picks an ephemeral port; read `.port`
+        / `.url` off the returned MetricsServer.  Stopped by close()."""
         if self._metrics_server is not None:
             return self._metrics_server
         from ..observe.registry import MetricsServer
 
         self._metrics_server = MetricsServer(
             self.metrics_registry(), health_fn=self.health,
-            host=host, port=port).start()
+            host=host, port=port,
+            alerts_fn=(self.alert_engine.state
+                       if self.alert_engine is not None
+                       else None)).start()
         return self._metrics_server
+
+    def enable_alerts(self, rules=None, interval_s: float = 5.0,
+                      flight_dir: Optional[str] = None,
+                      recorder_config: Optional[Dict[str, Any]] = None,
+                      start: bool = True, **pack_kw):
+        """Opt into observe pillar 9 on this fleet: an AlertEngine
+        evaluating the serving-SLO pack (`observe.fleet_rule_pack` —
+        error/failover/saturation burn + TTFT/TPOT/queue_wait p99; or
+        explicit `rules`) over `metrics_registry()` every `interval_s`
+        on a background thread.  `pack_kw` forwards to the pack
+        (thresholds/windows).  With `flight_dir` a FlightRecorder
+        writes a diagnostic bundle on every firing alert
+        (`recorder_config` forwards rate/size bounds).  The `alerts`
+        metric family joins /metrics and the /alerts route activates
+        on the metrics server.  `start=False` skips the background
+        thread (callers drive `alert_engine.evaluate()` — tests, and
+        in-process `tools/metrics_dump.py --alerts`).  Pure host: the
+        engine thread only reads registry snapshots — zero device
+        dispatches.  Stopped by close()."""
+        if self.alert_engine is not None:
+            return self.alert_engine
+        from ..observe.alerts import AlertEngine, fleet_rule_pack
+        from ..observe.flightrec import FlightRecorder
+
+        if rules is None:
+            rules = fleet_rule_pack(self, **pack_kw)
+        elif pack_kw:
+            raise ValueError("pack_kw only applies to the default "
+                             "rule pack")
+        engine = AlertEngine(self.metrics_registry(), rules=rules,
+                             interval_s=interval_s,
+                             event_log=self._event_log)
+        self.metrics_registry().register("alerts", engine.collector())
+        if flight_dir is not None:
+            self.flight_recorder = FlightRecorder(
+                flight_dir, registry=self.metrics_registry(),
+                event_log=self._event_log, tracer=self.tracer,
+                **(recorder_config or {}))
+            self.flight_recorder.attach_engine(engine)
+        self.alert_engine = engine
+        if self._metrics_server is not None:
+            self._metrics_server.alerts_fn = engine.state
+        if start:
+            engine.start()
+        return engine
 
     def snapshot(self) -> Dict[str, Any]:
         """Fleet counters + the merged per-replica engine telemetry

@@ -1,0 +1,769 @@
+"""Observe pillar 9: SLO alert engine + diagnostic flight recorder.
+
+Locks in the ISSUE 17 acceptance criteria:
+- rule mechanics under a fake clock and synthetic snapshots: threshold
+  firing with hysteresis, counter→rate windows, multiwindow burn-rate
+  firing and short-window resolve, anomaly z-scores with a baseline
+  that freezes while firing, `for_duration_s` pending gating and
+  `resolve_duration_s` clear gating, "no data" holding state,
+- engine surfaces: transition events into a strict-mode RunEventLog
+  (the alert_*/flight_* kinds are registered), the `alerts` collector
+  in the prometheus exposition, `signals()` shaped for the autoscaler,
+  the `/alerts` HTTP route (404 until an engine attaches — late attach
+  works), rule-error isolation, background thread start/close,
+- flight recorder: bundle contents per attached source, rate limiting
+  + count cap (`force` bypasses only the former), byte-budget
+  truncation recorded in the manifest, crash-hook capture + chaining,
+  watchdog on_hang chaining (capture BEFORE the prior hook),
+  firing-alert auto-capture via `attach_engine`,
+- the guard discipline: an AlertEngine evaluating on its background
+  thread during training adds zero dispatches, zero retraces, and the
+  step lowering is byte-identical with or without it,
+- the metrics_dump.py `--alerts` CLI against a live server.
+"""
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.observe.alerts import (AlertEngine, AlertRule,
+                                       AnomalyRule, BurnRateRule,
+                                       MetricSelector, ThresholdRule,
+                                       fleet_rule_pack,
+                                       serving_rule_pack,
+                                       snapshot_value,
+                                       trainer_rule_pack)
+from paddle_tpu.observe.events import RunEventLog, read_events
+from paddle_tpu.observe.flightrec import FlightRecorder
+from paddle_tpu.observe.registry import (MetricsRegistry, MetricsServer,
+                                         counter, gauge,
+                                         standard_collectors)
+
+
+# ---------------------------------------------------------------------------
+# Synthetic snapshot helpers
+# ---------------------------------------------------------------------------
+
+def _fam(kind, *samples):
+    return {"kind": kind, "help": "",
+            "samples": [{"labels": l, "value": v} for l, v in samples]}
+
+
+def _hist_fam(*samples):
+    """samples: (labels, buckets[(le, cum)...]) — count = last cum."""
+    return {"kind": "histogram", "help": "",
+            "samples": [{"labels": l,
+                         "count": (b[-1][1] if b else 0),
+                         "sum_ms": 0.0,
+                         "buckets": [list(x) for x in b]}
+                        for l, b in samples]}
+
+
+def _gauge_snap(name, value):
+    return {name: _fam("gauge", ({}, value))}
+
+
+def _counter_snap(name, value):
+    return {name: _fam("counter", ({}, value))}
+
+
+# ---------------------------------------------------------------------------
+# snapshot_value
+# ---------------------------------------------------------------------------
+
+def test_snapshot_value_counter_sums_gauge_averages():
+    snap = {"c": _fam("counter", ({"k": "a"}, 3.0), ({"k": "b"}, 4.0)),
+            "g": _fam("gauge", ({"k": "a"}, 2.0), ({"k": "b"}, 6.0))}
+    assert snapshot_value(snap, "c") == 7.0
+    assert snapshot_value(snap, "g") == 4.0
+    assert snapshot_value(snap, "c", labels={"k": "a"}) == 3.0
+    assert snapshot_value(snap, "missing") is None
+    assert snapshot_value(snap, "c", labels={"k": "zzz"}) is None
+
+
+def test_snapshot_value_histogram_percentile():
+    # 10 obs: 5 under 1ms, 9 under 10ms, all under 100ms
+    snap = {"h": _hist_fam(({}, [(1.0, 5), (10.0, 9), (100.0, 10)]))}
+    assert snapshot_value(snap, "h", percentile=50) == 1.0
+    assert snapshot_value(snap, "h", percentile=90) == 10.0
+    assert snapshot_value(snap, "h", percentile=99) == 100.0
+    with pytest.raises(ValueError, match="percentile"):
+        snapshot_value(snap, "h")
+
+
+def test_snapshot_value_histogram_label_merge():
+    snap = {"h": _hist_fam(
+        ({"phase": "queue_wait"}, [(1.0, 1), (10.0, 2)]),
+        ({"phase": "decode"}, [(1.0, 100), (10.0, 100)]))}
+    # the label filter narrows before the cumulative merge
+    assert snapshot_value(snap, "h",
+                          labels={"phase": "queue_wait"},
+                          percentile=99) == 10.0
+    assert snapshot_value(snap, "h", percentile=99) == 1.0
+
+
+def test_metric_selector_repr_and_call():
+    sel = MetricSelector("h", labels={"phase": "x"}, percentile=99)
+    assert "h" in repr(sel) and "p99" in repr(sel)
+    assert sel({}) is None
+
+
+# ---------------------------------------------------------------------------
+# ThresholdRule: firing, hysteresis, for/resolve duration, no-data
+# ---------------------------------------------------------------------------
+
+def test_threshold_fires_and_resolves_with_hysteresis():
+    r = ThresholdRule("hot", "load", op=">", threshold=5.0, clear=3.0)
+    assert r.step(_gauge_snap("load", 1.0), now=0.0) is None
+    assert r.state == "inactive"
+    assert r.step(_gauge_snap("load", 10.0), now=1.0) == "alert_firing"
+    assert r.firing and r.fired_count == 1
+    # hysteresis: below threshold but above clear -> still firing
+    assert r.step(_gauge_snap("load", 4.0), now=2.0) is None
+    assert r.firing
+    assert r.step(_gauge_snap("load", 2.0), now=3.0) == \
+        "alert_resolved"
+    assert r.state == "inactive"
+
+
+def test_threshold_for_duration_gates_through_pending():
+    r = ThresholdRule("hot", "load", threshold=5.0,
+                      for_duration_s=2.0, resolve_duration_s=1.0)
+    assert r.step(_gauge_snap("load", 9.0), now=0.0) == "alert_pending"
+    assert r.state == "pending"
+    assert r.step(_gauge_snap("load", 9.0), now=1.0) is None
+    assert r.step(_gauge_snap("load", 9.0), now=2.5) == "alert_firing"
+    # resolve_duration: first clear sample only starts the clock
+    assert r.step(_gauge_snap("load", 1.0), now=3.0) is None
+    assert r.firing
+    assert r.step(_gauge_snap("load", 1.0), now=4.5) == \
+        "alert_resolved"
+
+
+def test_threshold_pending_unbreach_returns_to_inactive():
+    r = ThresholdRule("hot", "load", threshold=5.0, for_duration_s=10.0)
+    assert r.step(_gauge_snap("load", 9.0), now=0.0) == "alert_pending"
+    r.step(_gauge_snap("load", 1.0), now=1.0)
+    assert r.state == "inactive"
+    # a later breach restarts the for_duration clock from scratch
+    assert r.step(_gauge_snap("load", 9.0), now=2.0) == "alert_pending"
+    assert r.step(_gauge_snap("load", 9.0), now=5.0) is None
+    assert r.state == "pending"
+
+
+def test_no_data_holds_state():
+    r = ThresholdRule("hot", "load", threshold=5.0)
+    r.step(_gauge_snap("load", 10.0), now=0.0)
+    assert r.firing
+    # the family disappears (collector died): state must hold
+    assert r.step({}, now=1.0) is None
+    assert r.firing and r.value is None
+
+
+def test_threshold_window_turns_counter_into_rate():
+    r = ThresholdRule("failover", "fleet_failovers_total",
+                      op=">", threshold=0.0, window_s=60.0)
+    assert r.step(_counter_snap("fleet_failovers_total", 0), 0.0) \
+        is None  # one sample: no rate yet
+    assert r.step(_counter_snap("fleet_failovers_total", 0), 1.0) \
+        is None
+    assert r.state == "inactive"  # rate 0: not a breach
+    assert r.step(_counter_snap("fleet_failovers_total", 1), 2.0) == \
+        "alert_firing"
+    assert r.value == pytest.approx(0.5)  # 1 event / 2 s
+    # counter flat, window slides past the event -> rate 0 -> resolved
+    assert r.step(_counter_snap("fleet_failovers_total", 1), 63.0) == \
+        "alert_resolved"
+
+
+def test_threshold_rejects_bad_op_and_source():
+    with pytest.raises(ValueError, match="op"):
+        ThresholdRule("x", "load", op="!=", threshold=1.0)
+    with pytest.raises(TypeError, match="source"):
+        ThresholdRule("x", 123, threshold=1.0)
+    with pytest.raises(ValueError, match="rule_id"):
+        ThresholdRule("", "load", threshold=1.0)
+
+
+# ---------------------------------------------------------------------------
+# BurnRateRule
+# ---------------------------------------------------------------------------
+
+def _ratio_snap(bad, tot):
+    return {"bad": _fam("counter", ({}, bad)),
+            "tot": _fam("counter", ({}, tot))}
+
+
+def test_burn_rate_multiwindow_fire_and_short_window_resolve():
+    r = BurnRateRule("err", "bad", "tot", slo=0.01,
+                     long_window_s=300.0, short_window_s=30.0)
+    assert r.step(_ratio_snap(0, 0), 0.0) is None   # no traffic
+    assert r.step(_ratio_snap(0, 100), 10.0) is None
+    assert r.state == "inactive"                     # burn 0
+    assert r.step(_ratio_snap(5, 200), 20.0) == "alert_firing"
+    assert r.value == pytest.approx(2.5)             # (5/200)/0.01
+    # recovery: short window sees 200 clean requests -> resolve even
+    # though the long window is still over budget
+    assert r.step(_ratio_snap(5, 400), 55.0) == "alert_resolved"
+
+
+def test_burn_rate_one_spike_needs_both_windows():
+    r = BurnRateRule("err", "bad", "tot", slo=0.5,
+                     long_window_s=100.0, short_window_s=10.0)
+    r.step(_ratio_snap(0, 0), 0.0)
+    r.step(_ratio_snap(9, 10), 1.0)   # short+long both burn: fires
+    assert r.firing
+    r2 = BurnRateRule("err2", "bad", "tot", slo=0.5,
+                      long_window_s=100.0, short_window_s=10.0)
+    r2.step(_ratio_snap(0, 0), 0.0)
+    r2.step(_ratio_snap(9, 10), 1.0)
+    # 15s of clean traffic: short window burn drops under, long stays
+    # over -> must NOT fire again once resolved
+    r2.step(_ratio_snap(9, 1000), 16.0)
+    assert not r2.firing
+
+
+def test_burn_rate_rejects_nonpositive_slo():
+    with pytest.raises(ValueError, match="slo"):
+        BurnRateRule("x", "bad", "tot", slo=0.0)
+
+
+# ---------------------------------------------------------------------------
+# AnomalyRule
+# ---------------------------------------------------------------------------
+
+def test_anomaly_spike_fires_baseline_freezes_then_resolves():
+    r = AnomalyRule("loss", "training_loss_mean", z=4.0,
+                    direction="above", min_samples=3, min_std=0.01)
+    for i in range(3):
+        assert r.step(_gauge_snap("training_loss_mean", 1.0),
+                      float(i)) is None
+    assert r.step(_gauge_snap("training_loss_mean", 5.0), 3.0) == \
+        "alert_firing"
+    base_len = len(r._baseline)
+    # the spike keeps coming: baseline must NOT absorb it
+    r.step(_gauge_snap("training_loss_mean", 5.0), 4.0)
+    assert r.firing and len(r._baseline) == base_len
+    assert r.step(_gauge_snap("training_loss_mean", 1.0), 5.0) == \
+        "alert_resolved"
+
+
+def test_anomaly_below_direction_with_rate():
+    r = AnomalyRule("tput", "goodput_steps_total", z=3.0,
+                    direction="below", rate=True, window_s=100.0,
+                    min_samples=3, min_std=0.01)
+    # steady 10 steps/s
+    for i, v in enumerate([0, 10, 20, 30, 40]):
+        r.step(_counter_snap("goodput_steps_total", v), float(i))
+    assert r.state == "inactive"
+    # throughput collapses: counter stalls
+    r.step(_counter_snap("goodput_steps_total", 40), 5.0)
+    r.step(_counter_snap("goodput_steps_total", 40), 6.0)
+    assert r.firing
+
+
+def test_anomaly_rejects_bad_direction():
+    with pytest.raises(ValueError, match="direction"):
+        AnomalyRule("x", "v", direction="sideways")
+
+
+# ---------------------------------------------------------------------------
+# Engine: evaluation, events, collector, signals, thread
+# ---------------------------------------------------------------------------
+
+class _MutableRegistry:
+    """Registry stand-in: snapshot() returns whatever was last set."""
+
+    def __init__(self, snap=None):
+        self.snap = snap or {}
+
+    def snapshot(self):
+        if isinstance(self.snap, Exception):
+            raise self.snap
+        return self.snap
+
+
+def test_engine_transitions_emit_registered_events(tmp_path):
+    log = RunEventLog(str(tmp_path / "ev.jsonl"))
+    reg = _MutableRegistry(_gauge_snap("load", 1.0))
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0, clear=3.0)],
+        event_log=log)
+    assert eng.evaluate(now=0.0) == []
+    reg.snap = _gauge_snap("load", 9.0)
+    out = eng.evaluate(now=1.0)
+    assert [(r.id, k) for r, k in out] == [("hot", "alert_firing")]
+    reg.snap = _gauge_snap("load", 1.0)
+    eng.evaluate(now=2.0)
+    log.close()
+    kinds = [e["event"] for e in read_events(log.path)
+             if e["event"].startswith("alert_")]
+    # strict mode is on suite-wide (conftest): reaching here at all
+    # proves the alert_* kinds are registered
+    assert kinds == ["alert_firing", "alert_resolved"]
+    rec = [e for e in read_events(log.path)
+           if e["event"] == "alert_firing"][0]
+    assert rec["rule"] == "hot" and rec["value"] == 9.0
+    assert rec["target"] == 5.0 and rec["severity"] == "page"
+
+
+def test_engine_signals_and_state_shape():
+    reg = _MutableRegistry(_gauge_snap("load", 9.0))
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0),
+        ThresholdRule("cold", "load", op="<", threshold=0.0)])
+    eng.evaluate(now=0.0)
+    sig = eng.signals()
+    assert set(sig) == {"hot", "cold"}
+    assert sig["hot"] == {"firing": True, "state": "firing",
+                          "value": 9.0, "target": 5.0,
+                          "severity": "page"}
+    assert sig["cold"]["firing"] is False
+    st = eng.state()
+    assert st["firing"] == ["hot"]
+    assert st["evaluations"] == 1 and st["running"] is False
+    assert {r["id"] for r in st["rules"]} == {"hot", "cold"}
+    assert eng.firing() == ["hot"]
+    json.dumps(st)  # the /alerts body must be JSON-able
+
+
+def test_engine_collector_in_prometheus_exposition():
+    reg = MetricsRegistry()
+    val = [9.0]
+    reg.register("toy", lambda: [gauge("load", "", val[0])])
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0)])
+    reg.register("alerts", eng.collector())
+    eng.evaluate(now=0.0)
+    text = reg.prometheus_text()
+    assert 'alerts_firing{rule="hot",severity="page"} 1' in text
+    assert 'alerts_value{rule="hot",severity="page"} 9' in text
+    assert 'alerts_target{rule="hot",severity="page"} 5' in text
+    assert 'alerts_fired_total{rule="hot",severity="page"} 1' in text
+    assert "alerts_evaluations_total 1" in text
+    assert "alerts_rules 1" in text
+    # the collector only reads rule state: scraping must not advance
+    # the evaluation count
+    assert eng.evaluations == 1
+
+
+def test_engine_rule_error_isolated():
+    class Bomb(AlertRule):
+        def observe(self, snapshot, now):
+            raise RuntimeError("boom")
+
+    reg = _MutableRegistry(_gauge_snap("load", 9.0))
+    eng = AlertEngine(reg, rules=[
+        Bomb("bomb"), ThresholdRule("hot", "load", threshold=5.0)])
+    out = eng.evaluate(now=0.0)
+    assert [(r.id, k) for r, k in out] == [("hot", "alert_firing")]
+    assert eng.eval_errors == 1
+
+
+def test_engine_sick_registry_counted_not_fatal():
+    reg = _MutableRegistry(RuntimeError("scrape failed"))
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0)])
+    assert eng.evaluate(now=0.0) == []
+    assert eng.eval_errors == 1
+
+
+def test_engine_duplicate_rule_and_remove():
+    eng = AlertEngine(_MutableRegistry())
+    eng.add_rule(ThresholdRule("a", "x", threshold=1.0))
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.add_rule(ThresholdRule("a", "x", threshold=1.0))
+    eng.remove_rule("a")
+    eng.add_rule(ThresholdRule("a", "x", threshold=1.0))
+    assert [r.id for r in eng.rules] == ["a"]
+
+
+def test_engine_background_thread_start_close():
+    reg = _MutableRegistry(_gauge_snap("load", 9.0))
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0)],
+        interval_s=0.01)
+    with eng:
+        assert eng.running
+        deadline = time.monotonic() + 5.0
+        while eng.evaluations == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert not eng.running
+    assert eng.evaluations > 0
+    assert eng.firing() == ["hot"]
+
+
+# ---------------------------------------------------------------------------
+# /alerts HTTP route
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def test_alerts_route_404_then_late_attach():
+    reg = MetricsRegistry()
+    reg.register("toy", lambda: [gauge("load", "", 9.0)])
+    srv = MetricsServer(reg).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/alerts")
+        assert ei.value.code == 404
+        eng = AlertEngine(reg, rules=[
+            ThresholdRule("hot", "load", threshold=5.0)])
+        eng.evaluate(now=0.0)
+        srv.alerts_fn = eng.state  # late attach: read per-request
+        body = json.loads(_get(f"{srv.url}/alerts"))
+        assert body["firing"] == ["hot"]
+        assert body["rules"][0]["value"] == 9.0
+        # the other routes still answer
+        assert "load 9" in _get(f"{srv.url}/metrics")
+        assert json.loads(_get(f"{srv.url}/healthz"))["ok"] is True
+    finally:
+        srv.close()
+
+
+def test_metrics_dump_alerts_cli():
+    reg = MetricsRegistry()
+    reg.register("toy", lambda: [gauge("load", "", 9.0)])
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0)])
+    eng.evaluate(now=0.0)
+    srv = MetricsServer(reg, alerts_fn=eng.state).start()
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "metrics_dump.py")
+    try:
+        out = subprocess.run(
+            [sys.executable, tool, "--url",
+             f"{srv.url}/metrics", "--alerts"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "1 firing / 1 rules" in out.stdout
+        assert "hot" in out.stdout and "value=9" in out.stdout
+        out2 = subprocess.run(
+            [sys.executable, tool, "--url",
+             f"{srv.url}/metrics", "--alerts", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert json.loads(out2.stdout)["firing"] == ["hot"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Default rule packs
+# ---------------------------------------------------------------------------
+
+def test_rule_packs_have_unique_ids_and_install():
+    fake_fleet = types.SimpleNamespace(replicas=[1, 2])
+    for pack in (fleet_rule_pack(fake_fleet), serving_rule_pack(),
+                 trainer_rule_pack()):
+        ids = [r.id for r in pack]
+        assert len(ids) == len(set(ids))
+        AlertEngine(_MutableRegistry(), rules=pack)  # no collisions
+    assert "fleet_replicas_down" in \
+        {r.id for r in fleet_rule_pack(fake_fleet)}
+    assert "fleet_replicas_down" not in \
+        {r.id for r in fleet_rule_pack()}
+
+
+def test_fleet_pack_failover_rule_on_synthetic_counters():
+    rules = {r.id: r for r in fleet_rule_pack(
+        failover_window_s=10.0)}
+    r = rules["fleet_failover_rate"]
+
+    def snap(n):
+        return {"fleet_failovers_total": _fam(
+            "counter", ({"kind": "generate"}, float(n)))}
+
+    r.step(snap(0), 0.0)
+    assert r.step(snap(0), 1.0) is None and r.state == "inactive"
+    assert r.step(snap(1), 2.0) == "alert_firing"
+    assert r.step(snap(1), 13.0) == "alert_resolved"
+
+
+def test_trainer_pack_goodput_and_packs_silent_without_data():
+    rules = {r.id: r for r in trainer_rule_pack(goodput_floor=0.5)}
+    g = rules["train_goodput_drop"]
+    # packs stay silent on empty snapshots ("no data")
+    for r in rules.values():
+        assert r.step({}, 0.0) is None and r.state == "inactive"
+    assert g.step(_gauge_snap("goodput_fraction_good", 0.2), 1.0) == \
+        "alert_firing"
+    # hysteresis clear = floor * 1.2
+    assert g.step(_gauge_snap("goodput_fraction_good", 0.55), 2.0) \
+        is None and g.firing
+    assert g.step(_gauge_snap("goodput_fraction_good", 0.9), 3.0) == \
+        "alert_resolved"
+
+
+def test_serving_pack_compile_tripwire():
+    rules = {r.id: r for r in serving_rule_pack()}
+    r = rules["serving_post_warmup_compiles"]
+    assert r.step(_gauge_snap("serving_post_warmup_compiles", 0.0),
+                  0.0) is None
+    assert r.step(_gauge_snap("serving_post_warmup_compiles", 1.0),
+                  1.0) == "alert_firing"
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_bundle_contents_and_manifest(tmp_path):
+    log = RunEventLog(str(tmp_path / "ev.jsonl"))
+    for i in range(5):
+        log.event("run_note", i=i)
+    reg = MetricsRegistry()
+    reg.register("toy", lambda: [counter("toy_total", "", 3.0)])
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "toy_total", threshold=1.0)])
+    eng.evaluate(now=0.0)
+    rec = FlightRecorder(str(tmp_path / "fr"), registry=reg,
+                         event_log=log)
+    rec.alert_engine = eng
+    path = rec.record("test_reason", context={"k": "v"})
+    assert path is not None and os.path.isdir(path)
+    assert os.path.basename(path) == "bundle_001_test_reason"
+    man = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert man["reason"] == "test_reason"
+    assert man["context"] == {"k": "v"}
+    assert man["errors"] == {} and man["truncated"] is False
+    assert set(man["files"]) == {"events_tail.jsonl", "metrics.json",
+                                 "alerts.json", "stacks.txt"}
+    tail = open(os.path.join(path, "events_tail.jsonl")).read()
+    assert '"run_note"' in tail
+    metrics = json.load(open(os.path.join(path, "metrics.json")))
+    assert metrics["toy_total"]["samples"][0]["value"] == 3.0
+    alerts = json.load(open(os.path.join(path, "alerts.json")))
+    assert alerts["firing"] == ["hot"]
+    stacks = open(os.path.join(path, "stacks.txt")).read()
+    assert "test_bundle_contents_and_manifest" in stacks
+    # the flight_record event landed (strict mode: kind registered)
+    log.close()
+    fr = [e for e in read_events(log.path)
+          if e["event"] == "flight_record"]
+    assert len(fr) == 1 and fr[0]["reason"] == "test_reason"
+    assert fr[0]["path"] == path
+
+
+def test_rate_limit_count_cap_and_force(tmp_path):
+    clk = _FakeClock()
+    rec = FlightRecorder(str(tmp_path / "fr"), min_interval_s=60.0,
+                         max_bundles=3, clock=clk)
+    assert rec.record("a") is not None
+    assert rec.record("b") is None          # rate-limited
+    assert rec.suppressed == 1
+    assert rec.record("c", force=True) is not None  # force bypasses
+    clk.t = 120.0
+    assert rec.record("d") is not None
+    assert rec.record("e", force=True) is None  # count cap holds
+    assert rec.suppressed == 2
+    assert len(rec.bundles) == 3
+    snap = rec.snapshot()
+    assert snap["suppressed"] == 2 and len(snap["bundles"]) == 3
+
+
+def test_bundle_byte_budget_truncates_and_records_it(tmp_path):
+    reg = MetricsRegistry()
+    reg.register("big", lambda: [
+        gauge("big_gauge", "x" * 64, float(i), idx=i)
+        for i in range(200)])
+    rec = FlightRecorder(str(tmp_path / "fr"), registry=reg,
+                         max_bundle_bytes=512)
+    path = rec.record("big")
+    man = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert man["truncated"] is True
+    total = sum(man["files"].values())
+    assert total <= 512
+    assert "stacks.txt" in man["skipped"]  # budget spent before it
+
+
+def test_section_error_isolated_into_manifest(tmp_path):
+    class Sick:
+        def snapshot(self):
+            raise RuntimeError("scrape died")
+
+    rec = FlightRecorder(str(tmp_path / "fr"), registry=Sick())
+    path = rec.record("sick")
+    assert path is not None
+    man = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert "metrics.json" in man["errors"]
+    assert "scrape died" in man["errors"]["metrics.json"]
+    assert "stacks.txt" in man["files"]  # later sections still wrote
+
+
+def test_attach_engine_bundles_on_firing(tmp_path):
+    reg = _MutableRegistry(_gauge_snap("load", 1.0))
+    eng = AlertEngine(reg, rules=[
+        ThresholdRule("hot", "load", threshold=5.0, clear=3.0)])
+    rec = FlightRecorder(str(tmp_path / "fr"), min_interval_s=0.0)
+    rec.attach_engine(eng)
+    eng.evaluate(now=0.0)
+    assert rec.bundles == []
+    reg.snap = _gauge_snap("load", 9.0)
+    eng.evaluate(now=1.0)
+    assert len(rec.bundles) == 1
+    assert os.path.basename(rec.bundles[0]) == "bundle_001_alert_hot"
+    man = json.load(open(os.path.join(rec.bundles[0],
+                                      "MANIFEST.json")))
+    assert man["context"]["rule"] == "hot"
+    assert man["context"]["value"] == 9.0
+    alerts = json.load(open(os.path.join(rec.bundles[0],
+                                         "alerts.json")))
+    assert alerts["firing"] == ["hot"]  # state captured post-fire
+    # resolve does not bundle; re-fire does
+    reg.snap = _gauge_snap("load", 1.0)
+    eng.evaluate(now=2.0)
+    reg.snap = _gauge_snap("load", 9.0)
+    eng.evaluate(now=3.0)
+    assert len(rec.bundles) == 2
+
+
+def test_watchdog_hook_captures_before_prior(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "fr"))
+    calls = []
+
+    def prior(fields):
+        calls.append((len(rec.bundles), dict(fields)))
+
+    hook = rec.watchdog_hook(prior)
+    fields = {"what": "step 3", "kind": "hung_step", "budget_s": 1.0}
+    hook(fields)
+    # the bundle was already on disk when prior ran
+    assert calls == [(1, fields)]
+    assert os.path.basename(rec.bundles[0]) == \
+        "bundle_001_hang_hung_step"
+    man = json.load(open(os.path.join(rec.bundles[0],
+                                      "MANIFEST.json")))
+    assert man["context"]["what"] == "step 3"
+    # prior still runs when the record itself is suppressed
+    hook({"kind": "hung_step"})
+    assert len(calls) == 2 and rec.suppressed == 1
+
+
+def test_crash_hooks_capture_and_chain(tmp_path):
+    seen = []
+    orig_hook = sys.excepthook
+
+    def dummy(*a):
+        seen.append(a)
+
+    sys.excepthook = dummy
+    rec = FlightRecorder(str(tmp_path / "fr"), min_interval_s=0.0)
+    try:
+        rec.install_crash_hooks()
+        rec.install_crash_hooks()  # idempotent
+        assert sys.excepthook is not orig_hook
+        try:
+            raise ValueError("kaboom")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        assert len(rec.bundles) == 1
+        assert os.path.basename(rec.bundles[0]) == "bundle_001_crash"
+        man = json.load(open(os.path.join(rec.bundles[0],
+                                          "MANIFEST.json")))
+        assert man["context"]["exc_type"] == "ValueError"
+        assert "kaboom" in man["context"]["traceback"]
+        assert len(seen) == 1  # the previous hook was chained
+        assert rec._crash_pending is False  # write confirmed: the
+        #                                     atexit sweep won't re-fire
+        rec.uninstall_crash_hooks()
+        assert sys.excepthook is dummy  # the wrapper is gone
+    finally:
+        rec.uninstall_crash_hooks()
+        sys.excepthook = orig_hook
+
+
+def test_atexit_sweep_only_on_pending_crash(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "fr"))
+    rec._atexit_sweep()
+    assert rec.bundles == []
+    rec._crash_pending = True
+    rec._atexit_sweep()
+    assert len(rec.bundles) == 1
+    assert "crash_atexit" in rec.bundles[0]
+
+
+# ---------------------------------------------------------------------------
+# Guard discipline: zero overhead, byte-identical lowering
+# ---------------------------------------------------------------------------
+
+def _named_program(lr=0.1):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    return main, startup, scope, loss
+
+
+def test_engine_is_zero_overhead_and_lowering_identical():
+    """The ISSUE 4/8 guard discipline applied to pillar 9: a live
+    AlertEngine — trainer rule pack, background thread snapshotting
+    the real registry mid-training — adds zero dispatches and zero
+    retraces, and the step lowering is BYTE-IDENTICAL with or without
+    it.  The engine only ever reads host-side counters."""
+    rng_feed = {"x": np.random.RandomState(0)
+                .rand(8, 8).astype(np.float32),
+                "y": np.random.RandomState(1)
+                .rand(8, 1).astype(np.float32)}
+
+    def run_and_count(with_alerts):
+        main, startup, scope, loss = _named_program()
+        eng = None
+        if with_alerts:
+            reg = standard_collectors(MetricsRegistry())
+            eng = AlertEngine(reg, rules=trainer_rule_pack(),
+                              interval_s=0.005)
+            reg.register("alerts", eng.collector())
+            eng.start()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            snap = observe.runtime_stats.snapshot()
+            for _ in range(3):
+                exe.run(main, feed=rng_feed, fetch_list=[loss])
+            delta = observe.runtime_stats.delta(snap)
+            fn, state, feeds = exe._prepare(
+                main, rng_feed, [loss.name], scope, 1, True)
+            text = fn.lower(state, feeds).as_text()
+        if eng is not None:
+            deadline = time.monotonic() + 5.0
+            while eng.evaluations == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            eng.close()
+            assert eng.evaluations > 0  # it really ran mid-training
+        return delta, text
+
+    off, text_off = run_and_count(False)
+    on, text_on = run_and_count(True)
+    assert on["dispatches"] == off["dispatches"]
+    assert on["retraces"] == off["retraces"] == 0
+    assert "callback" not in text_on  # pure host: no round-trips
+    assert text_on == text_off  # byte-identical step lowering

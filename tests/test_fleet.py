@@ -86,7 +86,10 @@ def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
     control, committed prefixes verified, zero post-warmup compiles
     fleet-wide, the dead replica ejected.  With tracing on (ISSUE 15),
     the killed request keeps ONE trace_id across both replicas with a
-    `failover` span naming the dead replica."""
+    `failover` span naming the dead replica.  With alerts enabled
+    (ISSUE 17), the kill flips the fleet_failover_rate rule to firing,
+    the firing transition writes exactly ONE rate-limited flight
+    bundle, and the rule resolves once the rate window slides past."""
     from paddle_tpu.observe import ReqTracer
 
     log_path = str(tmp_path / "fleet_events.jsonl")
@@ -94,6 +97,16 @@ def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
     engines = [_engine(), _engine()]
     fleet = Fleet(engines, FleetConfig(), log_path=log_path,
                   tracer=tracer).start()
+    # pillar 9 rides the chaos proof: default SLO pack, no background
+    # thread — the test drives evaluate() with an injected clock so
+    # the rate windows are deterministic
+    alerts = fleet.enable_alerts(start=False,
+                                 flight_dir=str(tmp_path / "flight"),
+                                 failover_window_s=30.0)
+    assert alerts is fleet.alert_engine and not alerts.running
+    alerts.evaluate(now=0.0)
+    alerts.evaluate(now=1.0)
+    assert alerts.firing() == []  # healthy fleet: nothing fires
     futs = [fleet.submit(p, max_new_tokens=b)
             for p, b in zip(PROMPTS, BUDGETS)]
     # mid-generation: wait until replica 0 has COMMITTED tokens, so at
@@ -142,6 +155,46 @@ def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
     rows = {e["pid"] for e in ct["traceEvents"] if e.get("ph") == "X"
             and e["args"].get("trace_id") == killed.trace_id}
     assert len(rows) >= 3, rows
+
+    # ISSUE 17: the kill must flip the failover-rate rule to firing
+    # and write exactly one rate-limited diagnostic bundle
+    alerts.evaluate(now=2.0)
+    assert "fleet_failover_rate" in alerts.firing(), alerts.state()
+    sig = alerts.signals()["fleet_failover_rate"]
+    assert sig["firing"] is True and sig["value"] > 0.0
+    # the dead replica also trips fleet_replicas_down in the SAME
+    # pass — its bundle is rate-limited: exactly one hits disk
+    assert "fleet_replicas_down" in alerts.firing()
+    rec = fleet.flight_recorder
+    assert len(rec.bundles) == 1 and rec.suppressed == 1, \
+        rec.snapshot()
+    bundle = rec.bundles[0]
+    assert os.path.basename(bundle) == \
+        "bundle_001_alert_fleet_failover_rate"
+    import json as _json
+
+    man = _json.load(open(os.path.join(bundle, "MANIFEST.json")))
+    assert man["context"]["rule"] == "fleet_failover_rate"
+    assert man["errors"] == {}
+    for f_ in ("metrics.json", "alerts.json", "reqtrace.json",
+               "events_tail.jsonl", "stacks.txt"):
+        assert f_ in man["files"], man["files"]
+    cap = _json.load(open(os.path.join(bundle, "metrics.json")))
+    assert sum(s["value"] for s in
+               cap["fleet_failovers_total"]["samples"]) >= 1
+    # the alerts family is on the fleet's /metrics surface
+    text = fleet.metrics_registry().prometheus_text()
+    assert 'alerts_firing{rule="fleet_failover_rate"' in text
+    # still breaching inside the window: no flapping, no new bundle
+    alerts.evaluate(now=3.0)
+    assert "fleet_failover_rate" in alerts.firing()
+    assert len(rec.bundles) == 1
+    # recovery: the 30 s rate window slides past the kill → resolved
+    alerts.evaluate(now=40.0)
+    assert "fleet_failover_rate" not in alerts.firing(), \
+        alerts.state()
+    assert alerts.signals()["fleet_failover_rate"]["state"] == \
+        "inactive"
     fleet.close()
 
     # satellite: replica_id stamps every engine event in the shared
@@ -156,6 +209,17 @@ def test_replica_kill_failover_token_parity(control_tokens, tmp_path):
     assert replica_events, kinds
     assert all("replica_id" in e for e in replica_events)
     assert {e["replica_id"] for e in replica_events} == {0, 1}
+    # ISSUE 17: the alert lifecycle and the bundle write are evented
+    # into the SAME shared log (registered kinds, strict-mode clean)
+    fired = [e for e in events if e["event"] == "alert_firing"]
+    assert {e["rule"] for e in fired} >= {"fleet_failover_rate",
+                                          "fleet_replicas_down"}
+    resolved = [e for e in events if e["event"] == "alert_resolved"]
+    assert "fleet_failover_rate" in {e["rule"] for e in resolved}
+    flights = [e for e in events if e["event"] == "flight_record"]
+    assert len(flights) == 1
+    assert flights[0]["reason"] == "alert_fleet_failover_rate"
+    assert flights[0]["path"] == bundle
 
 
 def test_hot_reload_under_load(control_tokens):

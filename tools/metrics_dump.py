@@ -8,11 +8,19 @@ family (counters/gauges with their samples, histograms as
 count/sum/p50-p99 reconstructed from the cumulative `le` buckets —
 exact to bin resolution, the same guarantee the exposition makes).
 
+Pillar 9 additions: `--alerts` reads the sibling `/alerts` route
+(AlertEngine.state() JSON) and prints one line per rule — state,
+value vs target, fire count — firing rules first; `--watch N`
+re-scrapes every N seconds with a timestamp separator, so a terminal
+can tail firing rules through a bench/chip session.
+
 Usage:
     python tools/metrics_dump.py --url http://127.0.0.1:9464/metrics
     python tools/metrics_dump.py --url ... --json      # raw families
     python tools/metrics_dump.py --url ... --grep fleet_
-Exit codes: 0 ok, 1 scrape/parse failure.
+    python tools/metrics_dump.py --url ... --alerts    # /alerts view
+    python tools/metrics_dump.py --url ... --alerts --watch 5
+Exit codes: 0 ok (incl. Ctrl-C out of --watch), 1 scrape/parse failure.
 """
 
 from __future__ import annotations
@@ -21,6 +29,7 @@ import argparse
 import json
 import re
 import sys
+import time
 import urllib.request
 
 _SAMPLE_RE = re.compile(
@@ -109,23 +118,65 @@ def _fmt_labels(labels):
             if labels else "")
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--url", required=True,
-                    help="the /metrics URL (e.g. the MetricsServer "
-                         "a Fleet.start_metrics_server() printed)")
-    ap.add_argument("--json", action="store_true",
-                    help="dump the parsed families as JSON")
-    ap.add_argument("--grep", default=None,
-                    help="only families whose name contains this")
-    ap.add_argument("--timeout", type=float, default=10.0)
-    args = ap.parse_args()
+def alerts_url(metrics_url: str) -> str:
+    """Derive the sibling /alerts route from whatever URL was given
+    (the MetricsServer serves /metrics, /healthz and /alerts off one
+    port)."""
+    base = metrics_url
+    for route in ("/metrics", "/healthz", "/alerts"):
+        if base.rstrip("/").endswith(route):
+            base = base.rstrip("/")[: -len(route)]
+            break
+    return base.rstrip("/") + "/alerts"
+
+
+def print_alerts(state, as_json: bool = False) -> None:
+    """Render an AlertEngine.state() dict: firing rules first, then
+    pending, then quiet; one line each."""
+    if as_json:
+        json.dump(state, sys.stdout, indent=2, default=str)
+        print()
+        return
+    rules = state.get("rules", [])
+    order = {"firing": 0, "pending": 1, "inactive": 2}
+    rules = sorted(rules, key=lambda r: (order.get(r["state"], 3),
+                                         r["id"]))
+    firing = state.get("firing", [])
+    print(f"# {len(firing)} firing / {len(rules)} rules  "
+          f"(evaluations={state.get('evaluations')}, "
+          f"running={state.get('running')})")
+    for r in rules:
+        mark = {"firing": "!!", "pending": "..",
+                "inactive": "  "}.get(r["state"], "??")
+        val = ("-" if r.get("value") is None
+               else f"{r['value']:.4g}")
+        tgt = ("-" if r.get("target") is None
+               else f"{r['target']:.4g}")
+        print(f"{mark} {r['id']:<32} {r['state']:<8} "
+              f"value={val} target={tgt} "
+              f"fired={r.get('fired_count', 0)} "
+              f"[{r.get('severity', '')}]")
+
+
+def _scrape(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def dump_once(args) -> int:
+    if args.alerts:
+        try:
+            state = json.loads(
+                _scrape(alerts_url(args.url), args.timeout))
+        except Exception as e:  # noqa: BLE001 — CLI surface
+            print(f"metrics_dump: /alerts scrape failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        print_alerts(state, as_json=args.json)
+        return 0
 
     try:
-        with urllib.request.urlopen(args.url,
-                                    timeout=args.timeout) as r:
-            text = r.read().decode("utf-8")
-        families = parse_exposition(text)
+        families = parse_exposition(_scrape(args.url, args.timeout))
     except Exception as e:  # noqa: BLE001 — CLI surface
         print(f"metrics_dump: scrape failed: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -152,6 +203,44 @@ def main() -> int:
                       f"{s['value']:g}  [{fam['kind']}]")
     print(f"# {len(families)} families", file=sys.stderr)
     return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", required=True,
+                    help="the /metrics URL (e.g. the MetricsServer "
+                         "a Fleet.start_metrics_server() printed)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the parsed families (or alert state) "
+                         "as JSON")
+    ap.add_argument("--grep", default=None,
+                    help="only families whose name contains this")
+    ap.add_argument("--alerts", action="store_true",
+                    help="read the sibling /alerts route instead: "
+                         "one line per rule, firing first "
+                         "(observe pillar 9)")
+    ap.add_argument("--watch", type=float, default=None,
+                    metavar="SECONDS",
+                    help="re-scrape every N seconds until Ctrl-C")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+
+    if args.watch is None:
+        return dump_once(args)
+    if args.watch <= 0:
+        print("metrics_dump: --watch must be positive",
+              file=sys.stderr)
+        return 1
+    try:
+        while True:
+            print(f"=== {time.strftime('%H:%M:%S')} ===")
+            rc = dump_once(args)
+            if rc != 0:
+                return rc
+            sys.stdout.flush()
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

@@ -475,6 +475,74 @@ print("fleet chaos smoke OK:",
 EOF
 JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
 
+echo "== SLO alert + flight recorder smoke (cpu) =="
+# ISSUE 17 (observe pillar 9): a synthetic SLO breach against a toy
+# registry must walk the rule to firing, expose it on the /alerts
+# route AND as the `alerts` family on /metrics, write exactly one
+# rate-limited diagnostic bundle with a readable manifest, and
+# tools/metrics_dump.py --alerts must render it.  Pure host — the
+# engine only reads registry snapshots.
+python - <<'EOF'
+import json, os, subprocess, sys, tempfile, urllib.request
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+from paddle_tpu.observe.alerts import AlertEngine, ThresholdRule
+from paddle_tpu.observe.flightrec import FlightRecorder
+from paddle_tpu.observe.registry import (MetricsRegistry, MetricsServer,
+                                         gauge)
+
+reg = MetricsRegistry()
+ttft = [120.0]                              # the mutable toy SLI
+reg.register("toy", lambda: [gauge("toy_ttft_p99_ms", "", ttft[0])])
+eng = AlertEngine(reg, rules=[
+    ThresholdRule("toy_ttft_slo", "toy_ttft_p99_ms", op=">",
+                  threshold=500.0, clear=400.0)], event_log=None)
+reg.register("alerts", eng.collector())
+d = tempfile.mkdtemp(prefix="alert_smoke_")
+rec = FlightRecorder(d, registry=reg, min_interval_s=3600.0)
+rec.attach_engine(eng)
+
+eng.evaluate(now=0.0)
+assert eng.firing() == [] and rec.bundles == []
+ttft[0] = 900.0                             # synthetic SLO breach
+eng.evaluate(now=1.0)
+assert eng.firing() == ["toy_ttft_slo"], eng.state()
+assert len(rec.bundles) == 1, rec.snapshot()
+man = json.load(open(os.path.join(rec.bundles[0], "MANIFEST.json")))
+assert man["context"]["rule"] == "toy_ttft_slo" and not man["errors"]
+assert json.load(open(os.path.join(
+    rec.bundles[0], "metrics.json")))["toy_ttft_p99_ms"]
+# flap guard: a second breach pass inside the rate window writes no
+# second bundle (already firing -> no transition; and rate-limited)
+eng.evaluate(now=2.0)
+assert len(rec.bundles) == 1
+
+srv = MetricsServer(reg, alerts_fn=eng.state).start()
+alerts = json.loads(urllib.request.urlopen(
+    srv.url + "/alerts", timeout=10).read().decode())
+assert alerts["firing"] == ["toy_ttft_slo"], alerts
+text = urllib.request.urlopen(
+    srv.url + "/metrics", timeout=10).read().decode()
+assert 'alerts_firing{rule="toy_ttft_slo",severity="page"} 1' in text
+dump = subprocess.run(
+    [sys.executable, "tools/metrics_dump.py", "--url",
+     srv.url + "/metrics", "--alerts"],
+    capture_output=True, text=True, timeout=60)
+assert dump.returncode == 0, dump.stderr
+assert "toy_ttft_slo" in dump.stdout and "firing" in dump.stdout
+# hysteresis resolve: back under the CLEAR threshold
+ttft[0] = 100.0
+eng.evaluate(now=3.0)
+assert eng.firing() == [], eng.state()
+srv.close(); eng.close()
+print("alerts smoke OK:",
+      {"bundle": os.path.basename(rec.bundles[0]),
+       "files": sorted(man["files"]),
+       "fired": alerts["rules"][0]["fired_count"]})
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_alerts.py -q
+
 echo "== fleet bench line + schema gate (cpu) =="
 # the --model serving_fleet entry must print one JSON line carrying
 # the failover/hedge/retry counters, reload_pause_ms, and the
