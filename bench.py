@@ -1454,6 +1454,156 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
     }
 
 
+def bench_serving_disagg(n_requests: int = 0):
+    """Disaggregated prefill/decode serving vs the unified fleet at
+    the SAME replica count — the phase-specialization proof line
+    (ISSUE 18, docs/SERVING.md §disagg).
+
+    Two closed-loop runs over the SAME prompt stream and budgets:
+
+    - control: a unified 2-replica Fleet (every replica prefills AND
+      decodes; a slot is held for the whole generation, so queued
+      prompts wait for completions before they see a first token);
+    - disagg: 1 prefill worker + 1 decode worker behind the
+      DisaggFleet phase router.  Prefill slots recycle per dispatch
+      (the ladder never waits on a generation), pages hand off to the
+      decode worker via the fixed-shape import scatter.  Geometry
+      convention: the decode worker's slot count equals the unified
+      fleet's TOTAL (it holds every in-flight generation; affordable
+      at equal memory because it compiles no prefill ladder — the
+      prefill worker holds no steady-state KV).
+
+    Headline = joint client TTFT p99 (disagg: submit → handoff first
+    token at the router; unified: the engine TTFT clocked from
+    submit, so both include queue wait) + steady tokens/s, plus the
+    handoff tax (handoff_ms_p50, pages/bytes transferred) and the
+    fleet-wide post_warmup_compiles == 0 proof — the import path must
+    never recompile the decode executable."""
+    import jax
+
+    from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+    from paddle_tpu.serving.disagg import DisaggFleet
+    from paddle_tpu.serving.fleet import Fleet, FleetConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        arch = dict(vocab_size=8192, n_layer=4, n_head=8, d_model=512,
+                    d_inner=1024)
+        num_slots, page, max_len, chunk = 8, 16, 256, 8
+        buckets = (32, 64)
+        max_new = 48
+        n_requests = n_requests or 48
+        prompt_lo, prompt_hi = 8, 64
+    else:
+        # CPU smoke: the contract (token parity, zero failures, zero
+        # compiles, the TTFT win mechanism), not absolute throughput
+        arch = dict(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                    d_inner=128)
+        num_slots, page, max_len, chunk = 2, 8, 96, 4
+        buckets = (16, 32)
+        max_new = 16
+        n_requests = n_requests or 16
+        prompt_lo, prompt_hi = 4, 30
+
+    from paddle_tpu.observe import ReqTracer
+
+    def mk_engine(role="unified", slots=num_slots):
+        lm = DecoderLM(kv_dtype="bfloat16", seed=0, **arch)
+        cfg = DecodeConfig(num_slots=slots, page_size=page,
+                           max_len=max_len,
+                           prefill_buckets=buckets,
+                           decode_chunk=chunk, kv_dtype="bfloat16")
+        return DecodeEngine(lm, cfg, role=role,
+                            queue_capacity=4 * n_requests,
+                            memory_budget_bytes=False)
+
+    prompts = make_prompts(n_requests, arch["vocab_size"],
+                           min_len=prompt_lo, max_len=prompt_hi, seed=0)
+    rng = np.random.RandomState(1)
+    budgets = rng.randint(max(2, max_new // 2), max_new + 1,
+                          n_requests)
+
+    def run(fleet):
+        t0 = time.perf_counter()
+        futs = [fleet.submit(p, max_new_tokens=int(b))
+                for p, b in zip(prompts, budgets)]
+        outs = [f.result(1200) for f in futs]
+        elapsed = time.perf_counter() - t0
+        tokens = sum(len(r.tokens) for r in outs)
+        return outs, tokens, elapsed
+
+    # -- control: unified 2-replica fleet over the same stream ----------
+    ufleet = Fleet([mk_engine(), mk_engine()], FleetConfig()).start()
+    u_outs, u_tokens, u_elapsed = run(ufleet)
+    u_ttft = ufleet.merged_stats().ttft_ms.summary()
+    usnap = ufleet.snapshot()
+    ufleet.close()
+    assert usnap["failed"] == 0, usnap
+    assert u_tokens == int(np.sum(budgets)), (u_tokens,
+                                              int(np.sum(budgets)))
+
+    # -- disagg: 1 prefill + 1 decode at the same replica count ---------
+    tracer = ReqTracer(sample_rate=0.0)  # tail keeps still live
+    dfleet = DisaggFleet([mk_engine("prefill")],
+                         [mk_engine("decode", slots=2 * num_slots)],
+                         FleetConfig(), tracer=tracer).start()
+    d_outs, d_tokens, d_elapsed = run(dfleet)
+    dsnap = dfleet.snapshot()
+    mem = _decode_mem(dfleet.decode[0].engine)
+    dfleet.close()
+    assert dsnap["failed"] == 0, dsnap
+    assert dsnap["parity_failed"] == 0, dsnap
+    assert d_tokens == int(np.sum(budgets)), (d_tokens,
+                                              int(np.sum(budgets)))
+    # greedy decode ⇒ the disagg path must be BIT-IDENTICAL to the
+    # unified fleet on every request (same weights, same prompts)
+    parity = all(list(u.tokens) == list(d.tokens)
+                 for u, d in zip(u_outs, d_outs))
+    assert parity, "disagg tokens diverged from the unified fleet"
+    assert dsnap["post_warmup_compiles"] == 0, dsnap
+
+    ttft_p99 = dsnap["ttft_ms"]["p99_ms"]
+    u_ttft_p99 = u_ttft["p99_ms"]
+    toks_s = round(d_tokens / d_elapsed, 1)
+    u_toks_s = round(u_tokens / u_elapsed, 1)
+    _, kind = _peak_flops()
+    return {
+        # joint (cross-phase) client metrics — the comparison keys
+        "ttft_p99_ms": ttft_p99,
+        "ttft_p50_ms": dsnap["ttft_ms"]["p50_ms"],
+        "tokens_per_sec": toks_s,
+        "requests_per_sec": round(n_requests / d_elapsed, 2),
+        "e2e_p50_ms": dsnap["e2e_ms"]["p50_ms"],
+        "e2e_p99_ms": dsnap["e2e_ms"]["p99_ms"],
+        # the handoff tax, measured
+        "handoff_ms_p50": dsnap["handoff_ms"]["p50_ms"],
+        "handoff_ms_p99": dsnap["handoff_ms"]["p99_ms"],
+        "handoffs": dsnap["handoffs"],
+        "pages_transferred": dsnap["pages_transferred"],
+        "kv_bytes_transferred": dsnap["bytes_transferred"],
+        # unified control at the same replica count / stream
+        "unified_ttft_p99_ms": u_ttft_p99,
+        "unified_tokens_per_sec": u_toks_s,
+        "unified_e2e_p99_ms": usnap["e2e_ms"]["p99_ms"],
+        "unified_post_warmup_compiles": usnap["post_warmup_compiles"],
+        "wins_ttft": bool(ttft_p99 < u_ttft_p99),
+        "wins_tokens": bool(toks_s > u_toks_s),
+        "token_parity_vs_unified": parity,
+        "zero_client_failures": dsnap["failed"] == 0
+                                and usnap["failed"] == 0,
+        "post_warmup_compiles": dsnap["post_warmup_compiles"],
+        "n_requests": n_requests,
+        "tokens_generated": d_tokens,
+        "n_prefill_workers": 1, "n_decode_workers": 1,
+        "prefill_slots": num_slots, "decode_slots": 2 * num_slots,
+        "page_size": page, "decode_chunk": chunk,
+        "kv_dtype": "bfloat16",
+        "device": kind,
+        **mem,
+    }
+
+
 def _probe_hazard(repo_dir: str, flag_fresh_s: float = 7200.0):
     """Machine-enforce the CLAUDE.md attach hazard: a second JAX client
     merely ATTACHING to the tunneled chip mid-bench degrades it ~5x
@@ -1509,7 +1659,8 @@ def main():
                    choices=["all", "resnet50", "transformer", "bert",
                             "lstm", "deepfm", "serving",
                             "serving_engine", "serving_decode",
-                            "serving_fleet", "longctx"])
+                            "serving_fleet", "serving_disagg",
+                            "longctx"])
     p.add_argument("--batch", type=int, default=0)
     p.add_argument("--mesh", default=None, metavar="dp=N[,mp=M]",
                    help="bench the training models (resnet50/"
@@ -1955,6 +2106,14 @@ def main():
         # compiles by contract (perf_gate --schema enforces the keys)
         _run("serving_fleet", bench_serving_fleet,
              n_requests=args.batch or 0)
+    if args.model in ("all", "serving_disagg"):
+        # phase-disaggregation proof line (ISSUE 18): prefill/decode
+        # workers + KV-page handoff vs the unified fleet at the same
+        # replica count — joint TTFT p99 + steady tokens/s + the
+        # handoff tax, zero post-warmup compiles fleet-wide (the
+        # import scatter never recompiles the decode executable)
+        _run("serving_disagg", bench_serving_disagg,
+             n_requests=args.batch or 0)
     if args.model in ("all", "longctx"):
         # long-context proof point (VERDICT r4 item 7): seq 8k with the
         # O(T)-memory stack — Pallas flash for self AND cross
@@ -2083,6 +2242,22 @@ def main():
                      % (d["failover_count"], d["reload_pause_ms"],
                         d["post_warmup_compiles"])),
             "vs_baseline": 0.0,  # first recorded fleet line
+            "detail": detail,
+        }
+    elif ("serving_disagg" in detail
+          and "tokens_per_sec" in detail["serving_disagg"]):
+        d = detail["serving_disagg"]
+        result = {
+            "metric": "decoder_serving_disagg_tokens_per_sec",
+            "value": d["tokens_per_sec"],
+            "unit": ("tok/s 1P+1D disagg vs unified %.1f (TTFT p99 "
+                     "%.1fms vs %.1fms, handoff p50 %.2fms, %d pages, "
+                     "%d post-warmup compiles)"
+                     % (d["unified_tokens_per_sec"], d["ttft_p99_ms"],
+                        d["unified_ttft_p99_ms"], d["handoff_ms_p50"],
+                        d["pages_transferred"],
+                        d["post_warmup_compiles"])),
+            "vs_baseline": 0.0,  # first recorded disagg line
             "detail": detail,
         }
     elif "examples_per_sec" in detail.get("deepfm", {}):

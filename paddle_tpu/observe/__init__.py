@@ -90,15 +90,16 @@ Three pillars (docs/OBSERVE.md):
 from . import cost  # noqa: F401
 from .alerts import (AlertEngine, AlertRule, AnomalyRule,  # noqa: F401
                      BurnRateRule, MetricSelector, ThresholdRule,
-                     fleet_rule_pack, serving_rule_pack,
-                     snapshot_value, trainer_rule_pack)
+                     disagg_rule_pack, fleet_rule_pack,
+                     serving_rule_pack, snapshot_value,
+                     trainer_rule_pack)
 from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    device_peaks, flash_boundary_layout,
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
 from .events import (ALERT_EVENTS, DECODE_EVENTS,  # noqa: F401
-                     FLEET_EVENTS, FLIGHT_EVENTS, GANG_EVENTS,
-                     GOODPUT_EVENTS, NUMERICS_EVENTS,
+                     DISAGG_EVENTS, FLEET_EVENTS, FLIGHT_EVENTS,
+                     GANG_EVENTS, GOODPUT_EVENTS, NUMERICS_EVENTS,
                      RESILIENCE_EVENTS, SERVING_EVENTS, BoundEventLog,
                      RunEventLog, git_sha, new_run_id, read_events,
                      register_event_kinds, set_strict_kinds)
@@ -123,7 +124,8 @@ from .numerics import (GROUP_NAMES, enable_numerics,  # noqa: F401
                        numerics_report, param_groups,
                        worst_update_ratio)
 from .registry import (MetricFamily, MetricsRegistry,  # noqa: F401
-                       MetricsServer, default_registry, fleet_collector,
+                       MetricsServer, default_registry,
+                       disagg_collector, fleet_collector,
                        gang_collector, goodput_collector,
                        memory_collector, metrics_snapshot,
                        process_collector, runtime_collector,

@@ -766,6 +766,75 @@ def serving_rule_pack(*, e2e_p99_ms: float = 1000.0,
     ]
 
 
+def disagg_rule_pack(fleet=None, *,
+                     prefill_wait_p99_ms: float = 1000.0,
+                     tpot_p99_ms: float = 200.0,
+                     handoff_p99_ms: float = 250.0,
+                     error_slo: float = 0.01,
+                     window_s: float = 60.0,
+                     for_duration_s: float = 0.0,
+                     resolve_duration_s: float = 0.0
+                     ) -> List[AlertRule]:
+    """The phase-split SLO pack `DisaggFleet.enable_alerts()` installs
+    — and the Autoscaler's signal source (serving/disagg.py): each
+    phase scales on ITS rule, which is exactly why the pack is split
+    by phase instead of reusing the joint fleet pack.
+
+    - `disagg_prefill_wait_p99`: the prefill workers' merged TTFT
+      histogram (queue wait + bucketed prefill dispatch) — the
+      scale-UP-prefill signal.
+    - `disagg_decode_tpot_p99`: the decode workers' merged
+      time-per-output-token — the scale-UP-decode signal.
+    - `disagg_handoff_p99`: export gather + router relay + import
+      admission per KV hop (a slow transfer plane is its own
+      pathology, not a capacity one — severity ticket).
+    - `disagg_error_rate`: client-visible failure budget burn.
+    - `serving_post_warmup_compiles`: ANY recompile after warmup
+      anywhere in the fleet (the zero-compile contract as an alert).
+    """
+    kw = {"for_duration_s": for_duration_s,
+          "resolve_duration_s": resolve_duration_s}
+    return [
+        ThresholdRule(
+            "disagg_prefill_wait_p99",
+            MetricSelector("disagg_prefill_wait_ms", percentile=99),
+            op=">", threshold=prefill_wait_p99_ms,
+            clear=prefill_wait_p99_ms * 0.8,
+            description="prefill-side wait p99 over SLO (the "
+                        "autoscaler's scale-up-prefill signal)", **kw),
+        ThresholdRule(
+            "disagg_decode_tpot_p99",
+            MetricSelector("disagg_decode_tpot_ms", percentile=99),
+            op=">", threshold=tpot_p99_ms,
+            clear=tpot_p99_ms * 0.8,
+            description="decode-side TPOT p99 over SLO (the "
+                        "autoscaler's scale-up-decode signal)", **kw),
+        ThresholdRule(
+            "disagg_handoff_p99",
+            MetricSelector("disagg_handoff_ms", percentile=99),
+            op=">", threshold=handoff_p99_ms,
+            clear=handoff_p99_ms * 0.8, severity="ticket",
+            description="KV-page handoff latency p99 over SLO",
+            **kw),
+        BurnRateRule(
+            "disagg_error_rate",
+            MetricSelector("disagg_failed_total"),
+            MetricSelector("disagg_submitted_total"),
+            slo=error_slo, burn_factor=1.0,
+            long_window_s=max(window_s * 5, 300.0),
+            short_window_s=window_s,
+            description="client-visible failure budget burning",
+            **kw),
+        ThresholdRule(
+            "serving_post_warmup_compiles",
+            MetricSelector("serving_post_warmup_compiles"),
+            op=">", threshold=0.0,
+            description="a recompile leaked past warmup somewhere in "
+                        "the fleet (zero-compile contract broken)",
+            **kw),
+    ]
+
+
 def trainer_rule_pack(*, goodput_floor: float = 0.5,
                       loss_spike_z: float = 6.0,
                       grad_norm_z: float = 6.0,

@@ -76,6 +76,29 @@ FLEET_EVENTS = (
     "serving_fleet_close",     # final merged snapshot at close
 )
 
+# disaggregated prefill/decode serving event kinds (docs/SERVING.md
+# §disagg): the phase router, the KV-page handoff, and the
+# SLO-driven autoscaler's decisions
+DISAGG_EVENTS = (
+    "serving_disagg_start",       # fleet topology at start()
+    "serving_disagg_handoff",     # one KV-page hop: from_replica ->
+    #                               to_replica, pages, bytes, handoff_ms
+    "serving_disagg_failover",    # LOUD: a worker died mid-request —
+    #                               the raw prompt re-prefills on a
+    #                               survivor (phase + committed tokens)
+    "serving_disagg_eject",       # LOUD: a worker removed from routing
+    "serving_disagg_saturated",   # LOUD: one phase's workers all shed
+    "serving_disagg_worker_join",  # zero-reject scale-up landed
+    "serving_disagg_worker_leave", # zero-reject scale-down retired one
+    "serving_disagg_window",      # periodic merged stats snapshot
+    "serving_disagg_close",       # final snapshot at close
+    "kv_transfer",                # also the router-row reqtrace span
+    #                               name (registered for grep parity)
+    "autoscale_up",               # Autoscaler added a worker: phase,
+    #                               rule, observed value
+    "autoscale_down",             # Autoscaler removed one after quiet_s
+)
+
 # resilience event kinds (docs/RESILIENCE.md): checkpoint fallback,
 # save telemetry, and preemption-drain lifecycle, emitted by
 # contrib.Trainer / the chaos CI smoke
@@ -168,11 +191,11 @@ NUMERICS_EVENTS = (
 # ---------------------------------------------------------------------------
 
 _VALIDATED_PREFIXES = ("serving_", "fleet_", "gang_", "alert_",
-                       "flight_")
+                       "flight_", "autoscale_")
 _KNOWN_KINDS = set(SERVING_EVENTS) | set(DECODE_EVENTS) \
     | set(FLEET_EVENTS) | set(GANG_EVENTS) | set(RESILIENCE_EVENTS) \
     | set(NUMERICS_EVENTS) | set(GOODPUT_EVENTS) | set(ALERT_EVENTS) \
-    | set(FLIGHT_EVENTS)
+    | set(FLIGHT_EVENTS) | set(DISAGG_EVENTS)
 _strict_kinds = [False]
 _warned_kinds: set = set()
 

@@ -344,6 +344,71 @@ def fleet_collector(fleet) -> Callable[[], List[MetricFamily]]:
     return collect
 
 
+def disagg_collector(dfleet) -> Callable[[], List[MetricFamily]]:
+    """Disaggregated-fleet adapter (serving/disagg.py): phase-router
+    counters, the KV-transfer accounting, per-worker health gauges,
+    and the PER-PHASE merged latency histograms
+    (`disagg_prefill_wait_ms` / `disagg_decode_tpot_ms`) the
+    disagg_rule_pack — and through it the Autoscaler — keys on."""
+
+    def collect() -> List[MetricFamily]:
+        snap = dfleet.stats.snapshot()
+        fams: List[MetricFamily] = []
+        for key in ("submitted", "completed", "failed", "handoffs",
+                    "pages_transferred", "bytes_transferred",
+                    "retries", "saturated", "ejects",
+                    "parity_checked", "parity_failed", "scale_ups",
+                    "scale_downs"):
+            fams.append(counter(f"disagg_{key}_total",
+                                f"disagg router counter {key}",
+                                snap[key]))
+        failovers = counter("disagg_failovers_total",
+                            "worker deaths failed over, per phase")
+        failovers.add(snap["prefill_failovers"], phase="prefill")
+        failovers.add(snap["decode_failovers"], phase="decode")
+        fams.append(failovers)
+        workers = gauge("disagg_workers", "live workers per phase")
+        healthy = gauge("disagg_healthy_workers",
+                        "routable workers per phase")
+        for phase in ("prefill", "decode"):
+            pool = dfleet.prefill if phase == "prefill" \
+                else dfleet.decode
+            workers.add(sum(not h.dead for h in pool), phase=phase)
+            healthy.add(sum(h.routable() for h in pool), phase=phase)
+        fams += [workers, healthy]
+        up = gauge("disagg_worker_up", "1 when the worker is routable")
+        inflight = gauge("disagg_worker_inflight",
+                         "router-outstanding requests per worker")
+        for h in dfleet.workers():
+            lbl = {"replica_id": h.replica_id, "phase": h.phase}
+            up.add(1 if h.routable() else 0, **lbl)
+            inflight.add(h.inflight, **lbl)
+        fams += [up, inflight]
+        fams.append(gauge("disagg_model_version", "live weight version",
+                          dfleet.model_version))
+        fams.append(histogram("disagg_e2e_ms",
+                              "disagg end-to-end request latency",
+                              dfleet.stats.e2e_ms))
+        fams.append(histogram("disagg_ttft_ms",
+                              "joint client-observed time to first "
+                              "token (submit -> handoff package)",
+                              dfleet.stats.ttft_ms))
+        fams.append(histogram("disagg_handoff_ms",
+                              "KV-page hop: export + relay + import "
+                              "admission", dfleet.stats.handoff_ms))
+        fams.append(histogram("disagg_prefill_wait_ms",
+                              "prefill workers' merged TTFT (queue "
+                              "wait + prefill dispatch)",
+                              dfleet.merged_stats("prefill").ttft_ms))
+        fams.append(histogram("disagg_decode_tpot_ms",
+                              "decode workers' merged time per output "
+                              "token",
+                              dfleet.merged_stats("decode").tpot_ms))
+        return fams
+
+    return collect
+
+
 def runtime_collector() -> Callable[[], List[MetricFamily]]:
     """observe.runtime_stats: XLA compiles / retraces / dispatches."""
 

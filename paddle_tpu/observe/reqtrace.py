@@ -368,7 +368,13 @@ class ReqTracer:
         queue/dispatch spans on replica A's row, the failover hop, and
         the completion spans on replica B's row — one timeline for a
         ragged stream under chaos.  Timestamps are µs relative to the
-        oldest exported trace."""
+        oldest exported trace.
+
+        Disagg handoffs additionally render as chrome FLOW events: a
+        `kv_transfer` span (router row; from_replica/to_replica attrs)
+        emits an `s`/`f` arrow pair from the prefill worker's row to
+        the decode worker's row, so one trace_id draws
+        prefill-row → transfer arrow → decode-row."""
         traces = self.traces(window_s)
         events: List[Dict[str, Any]] = []
         if not traces:
@@ -386,6 +392,7 @@ class ReqTracer:
                 pids[replica_id] = int(replica_id) + 1
             return pids[replica_id]
 
+        flow_id = 0
         for tid, t in enumerate(traces, start=1):
             with t._lock:
                 spans = list(t.spans)
@@ -401,6 +408,25 @@ class ReqTracer:
                 if t.error:
                     ev["args"]["trace_error"] = t.error
                 events.append(ev)
+                if s.name == "kv_transfer" \
+                        and s.attrs.get("from_replica") is not None \
+                        and s.attrs.get("to_replica") is not None:
+                    # the handoff arrow: flow start on the prefill
+                    # worker's row, flow finish on the decode
+                    # worker's row, tied by a shared id
+                    flow_id += 1
+                    common = {"name": "kv_transfer",
+                              "cat": "kv_transfer", "tid": tid,
+                              "id": flow_id,
+                              "args": {"trace_id": t.trace_id}}
+                    events.append({
+                        **common, "ph": "s",
+                        "ts": round((s.t0 - base) * 1e6, 1),
+                        "pid": pid_of(s.attrs["from_replica"])})
+                    events.append({
+                        **common, "ph": "f", "bp": "e",
+                        "ts": round((s.t1 - base) * 1e6, 1),
+                        "pid": pid_of(s.attrs["to_replica"])})
         for replica_id, pid in pids.items():
             name = ("router" if replica_id is None
                     else f"replica {replica_id}")

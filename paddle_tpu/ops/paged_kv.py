@@ -15,6 +15,10 @@ convention.  Three ops own the cache contract:
   masked to its true length.  Default impl is an XLA dense-gather twin
   (layout-matched, the CPU/parity fallback); `use_pallas` routes to the
   tiled kernel (ops/pallas/paged_attention.py).
+- `paged_kv_import`: scatter another pool's exported rows into this
+  pool's pages (the disagg prefill→decode handoff,
+  serving/disagg.py) — same drop-mode idiom, one fixed shape for any
+  prompt length.
 
 All three are born in the head-major (S, H*D) / (P, page, H*D) layout
 (ISSUE 8): a page write is a plain row scatter and no transpose exists
@@ -142,6 +146,47 @@ def paged_kv_prefill_write(ctx, ins, attrs):
         return res
     return out(KCacheOut=_write_rows(kc, phys, off, k),
                VCacheOut=_write_rows(vc, phys, off, v))
+
+
+def paged_import_rows(pool, rows, pt_row, num_valid):
+    """One slot's exported dense rows -> this pool's pages (the disagg
+    prefill→decode KV handoff, serving/disagg.py).
+
+    rows (T_cap, C) is a token-major page gather of the SOURCE pool
+    (positions 0..T_cap-1, T_cap = max_pages * page); pt_row
+    (max_pages,) int32 names the RECEIVING slot's physical pages;
+    positions >= num_valid (export padding — whatever the zeroed source
+    table pointed at) are dropped via the OOB-scatter idiom, so one
+    fixed shape imports any prompt length.  Rows are already in pool
+    dtype (int8 codes and scale sidecars travel verbatim — bitwise, no
+    requantization)."""
+    n_pages, page, _ = pool.shape
+    t_cap = rows.shape[0]
+    pos = jnp.arange(t_cap, dtype=jnp.int32)
+    page_idx = pos // page
+    off = pos % page
+    pt_row = pt_row.astype(jnp.int32)
+    phys = pt_row[jnp.clip(page_idx, 0, pt_row.shape[0] - 1)]
+    valid = (pos < num_valid) & (page_idx < pt_row.shape[0])
+    phys = jnp.where(valid, phys, n_pages)   # OOB -> mode="drop"
+    return pool.at[phys, off].set(rows.astype(pool.dtype), mode="drop")
+
+
+@register_op("paged_kv_import")
+def paged_kv_import(ctx, ins, attrs):
+    """Import one slot's exported KV rows into a cache pool.
+
+    Rows (T_cap, C) token-major export of the source pool; Cache
+    (P, page, C); PageTable (max_pages,) int32 — the receiving slot's
+    pages; NumValid scalar int32 — rows at positions >= it drop.
+    Output: CacheOut (P, page, C).  Serving-only (the disagg handoff
+    path); applies identically to int8 code pools and their scale
+    sidecars."""
+    rows = first(ins, "Rows")
+    cache = first(ins, "Cache")
+    pt = first(ins, "PageTable").astype(jnp.int32)
+    nv = first(ins, "NumValid").astype(jnp.int32).reshape(())
+    return out(CacheOut=paged_import_rows(cache, rows, pt, nv))
 
 
 def _gather_pool(pool, pt):

@@ -18,7 +18,13 @@ The inference-side subsystem (docs/SERVING.md): what `parallel/` +
 - `fleet.Fleet`: N engine replicas behind one health-checked router —
   least-loaded routing, per-replica breakers, hedging, in-flight
   decode failover (token-identical regeneration), and rolling hot
-  weight reload (ISSUE 14; docs/SERVING.md §fleet).
+  weight reload (ISSUE 14; docs/SERVING.md §fleet),
+- `disagg.DisaggFleet`: phase-disaggregated serving — prefill workers
+  (bucketed ladder, prefill-only, KV-page export) and decode workers
+  (paged chunk engine, page import) behind a phase router with
+  KV-page handoff, cross-hop token-parity failover, and the
+  SLO-driven `disagg.Autoscaler` over AlertEngine.signals()
+  (ISSUE 18; docs/SERVING.md §disagg).
 
 Quick start (or `paddle_tpu.contrib.serve(...)`):
 
@@ -41,6 +47,8 @@ from .decode import (DecodeBucketMissError,  # noqa: F401
                      DecodeReplicaFailedError, DecodeRequest, PagePool)
 from .engine import (BucketConfig, BucketMemoryError,  # noqa: F401
                      BucketMissError, ServingEngine)
+from .disagg import (Autoscaler, DisaggFleet,  # noqa: F401
+                     DisaggStats, PhaseWorker)
 from .fleet import (FailoverParityError, Fleet,  # noqa: F401
                     FleetClosedError, FleetConfig, FleetResponse,
                     FleetSaturatedError, FleetStats, ReplicaHandle)

@@ -155,7 +155,8 @@ class DecodeRequest:
     """One accepted generation request."""
 
     __slots__ = ("prompt", "max_new_tokens", "priority", "future",
-                 "deadline", "t_submit", "preempted", "trace")
+                 "deadline", "t_submit", "preempted", "trace",
+                 "handoff")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  priority: int = 0, deadline: Optional[float] = None,
@@ -168,6 +169,7 @@ class DecodeRequest:
         self.t_submit = time.monotonic()
         self.preempted = 0
         self.trace = trace  # observe.reqtrace.RequestTrace (or None)
+        self.handoff = None  # disagg: imported KV package (decode role)
 
     def descriptor(self, generated: Optional[List[int]] = None
                    ) -> Dict[str, Any]:
@@ -262,7 +264,19 @@ class DecodeEngine:
                  stats_window: int = 64,
                  breaker: Union[CircuitBreaker, bool, None] = None,
                  memory_budget_bytes: Union[int, bool, None] = None,
-                 donate_pools: Optional[bool] = None, tracer=None):
+                 donate_pools: Optional[bool] = None, tracer=None,
+                 role: str = "unified"):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill' or 'decode'; "
+                f"got {role!r}")
+        # disagg phase specialization (serving/disagg.py): a "prefill"
+        # engine compiles only the bucket ladder plus a page-EXPORT
+        # gather and resolves every request with a KV handoff package;
+        # a "decode" engine compiles only the chunk loop plus a
+        # fixed-shape page-IMPORT scatter and admits requests through
+        # import_handoff().  "unified" is the byte-identical default.
+        self.role = role
         self.model = model
         # observe pillar 7: per-request tracing (host spans only —
         # join_wait, per-chunk dispatch, preempt/evacuated markers);
@@ -309,6 +323,8 @@ class DecodeEngine:
         self._pools: Optional[Dict[str, Any]] = None
         self._decode_exec = None
         self._prefill_execs: Dict[int, Any] = {}
+        self._export_exec = None   # role="prefill": page gather
+        self._import_exec = None   # role="decode": page scatter
         self.page_pool = PagePool(self.config.num_pages)
         self._page_tables = np.zeros(
             (self.config.num_slots, self.config.max_pages_per_slot),
@@ -429,6 +445,37 @@ class DecodeEngine:
 
         return prefill_fn
 
+    def _build_export_fn(self):
+        """role="prefill": gather ONE slot's pool pages into dense
+        token-major rows (T_cap, C), T_cap = max_pages_per_slot *
+        page_size.  Fixed shape for any slot/prompt — rows past the
+        committed length gather whatever the zero page-table padding
+        points at and are masked again on import (NumValid)."""
+
+        def export_fn(page_table_row, pools):
+            out = {}
+            for n, p in pools.items():
+                g = p[page_table_row]        # (maxp, page, C)
+                out[n] = g.reshape(g.shape[0] * g.shape[1], g.shape[2])
+            return out
+
+        return export_fn
+
+    def _build_import_fn(self):
+        """role="decode": scatter one handoff's exported rows into this
+        worker's OWN pool pages (the receiving slot's page-table row)
+        via the drop-mode paged scatter — one fixed shape serves any
+        join/handoff/failover pattern, the zero-recompile contract
+        across the hop."""
+        from ..ops.paged_kv import paged_import_rows
+
+        def import_fn(rows, page_table_row, num_valid, pools):
+            return {n: paged_import_rows(pools[n], rows[n],
+                                         page_table_row, num_valid)
+                    for n in pools}
+
+        return import_fn
+
     def _specs(self):
         import jax
         import jax.numpy as jnp
@@ -463,6 +510,7 @@ class DecodeEngine:
                 num_pages=cfg.num_pages, max_len=cfg.max_len,
                 prefill_buckets=list(cfg.prefill_buckets),
                 decode_chunk=cfg.decode_chunk, kv_dtype=cfg.kv_dtype,
+                role=self.role,
                 queue_capacity=self.admission.queue_capacity)
         snap = runtime_stats.snapshot()
         t0 = time.perf_counter()
@@ -474,24 +522,49 @@ class DecodeEngine:
                        self.model.fresh_pools(cfg.num_pages,
                                               cfg.page_size).items()}
         params_spec, vec, pt, pool_specs = self._specs()
-        donate = (6,) if self._donate else ()
-        self._decode_exec = jax.jit(
-            self._build_decode_fn(),
-            donate_argnums=donate).lower(
-                params_spec, vec, vec, vec, vec, pt,
-                pool_specs).compile()
-        for t in cfg.prefill_buckets:
-            tok = jax.ShapeDtypeStruct((cfg.num_slots, t), jax.numpy.int32)
-            last = jax.ShapeDtypeStruct((cfg.num_slots, 1),
-                                        jax.numpy.int32)
-            donate_p = (5,) if self._donate else ()
-            self._prefill_execs[t] = jax.jit(
-                self._build_prefill_fn(t),
-                donate_argnums=donate_p).lower(
-                    params_spec, tok, vec, last, pt,
+        i32 = jax.numpy.int32
+        n_exec = 0
+        if self.role != "prefill":
+            donate = (6,) if self._donate else ()
+            self._decode_exec = jax.jit(
+                self._build_decode_fn(),
+                donate_argnums=donate).lower(
+                    params_spec, vec, vec, vec, vec, pt,
                     pool_specs).compile()
+            n_exec += 1
+        if self.role != "decode":
+            for t in cfg.prefill_buckets:
+                tok = jax.ShapeDtypeStruct((cfg.num_slots, t), i32)
+                last = jax.ShapeDtypeStruct((cfg.num_slots, 1), i32)
+                donate_p = (5,) if self._donate else ()
+                self._prefill_execs[t] = jax.jit(
+                    self._build_prefill_fn(t),
+                    donate_argnums=donate_p).lower(
+                        params_spec, tok, vec, last, pt,
+                        pool_specs).compile()
+            n_exec += len(cfg.prefill_buckets)
+        row = jax.ShapeDtypeStruct((cfg.max_pages_per_slot,), i32)
+        if self.role == "prefill":
+            # page-export gather: pools NOT donated — the worker keeps
+            # serving from them after every export
+            self._export_exec = jax.jit(
+                self._build_export_fn()).lower(row, pool_specs).compile()
+            n_exec += 1
+        if self.role == "decode":
+            t_cap = cfg.max_pages_per_slot * cfg.page_size
+            rows_spec = {
+                n: jax.ShapeDtypeStruct((t_cap, spec.shape[2]),
+                                        spec.dtype)
+                for n, spec in pool_specs.items()}
+            nv = jax.ShapeDtypeStruct((), i32)
+            donate_i = (3,) if self._donate else ()
+            self._import_exec = jax.jit(
+                self._build_import_fn(),
+                donate_argnums=donate_i).lower(
+                    rows_spec, row, nv, pool_specs).compile()
+            n_exec += 1
         delta = runtime_stats.delta(snap)
-        self.stats.record_warmup(1 + len(cfg.prefill_buckets),
+        self.stats.record_warmup(n_exec,
                                  delta["compiles"],
                                  delta["compile_time_s"],
                                  time.perf_counter() - t0)
@@ -611,6 +684,7 @@ class DecodeEngine:
             num_pages=self.config.num_pages,
             completed=self.stats.completed,
             replica_id=self.replica_id,
+            role=self.role,
             model_version=self.model_version,
             post_warmup_compiles=self.stats.post_warmup_compiles())
 
@@ -749,6 +823,11 @@ class DecodeEngine:
         stopped it).  Raises DecodeBucketMissError / QueueFullError /
         CircuitOpenError / ServingClosedError synchronously.
         `_trace`: a fleet router's RequestTrace to continue."""
+        if self.role == "decode":
+            raise ValueError(
+                "role='decode' engine admits requests only through "
+                "import_handoff() — prompts prefill on a prefill "
+                "worker (serving/disagg.py)")
         trace = _trace
         if trace is None and self.tracer is not None:
             trace = self.tracer.new_trace("decode")
@@ -805,6 +884,69 @@ class DecodeEngine:
         """Synchronous submit()+result() convenience."""
         return self.submit(prompt, max_new_tokens, **kw).result(
             timeout_s)
+
+    def import_handoff(self, handoff: Dict[str, Any],
+                       deadline_ms: Optional[float] = None,
+                       _trace=None) -> Future:
+        """role="decode" entry: accept a prefill worker's KV handoff
+        package (the export of `_export_handoffs`) and continue the
+        generation from its first token.  The imported slot is seeded
+        to EXACTLY the post-prefill state of the unified engine
+        (committed prompt KV, pending first token, remaining budget),
+        so greedy decode continues bit-identically — the token-parity
+        proof holds across the hop.  Returns a Future of the FULL
+        generated ids (first token included)."""
+        if self.role != "decode":
+            raise ValueError(
+                "import_handoff() requires role='decode' "
+                f"(this engine is role={self.role!r})")
+        trace = _trace
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.new_trace("decode")
+        prompt = np.asarray(handoff["prompt"], np.int32)
+        committed = int(handoff["committed"])
+        max_new = int(handoff["max_new_tokens"])
+        cfg = self.config
+        if prompt.ndim != 1 or prompt.size < 1 \
+                or committed != prompt.size:
+            raise ValueError(
+                f"handoff package inconsistent: committed {committed} "
+                f"vs prompt length {prompt.size}")
+        if handoff.get("rows") is None:
+            raise ValueError("handoff package carries no KV rows "
+                             "(done=True packages resolve at the "
+                             "router, not on a decode worker)")
+        if committed + max_new > cfg.max_len:
+            self.stats.record_bucket_miss()
+            raise DecodeBucketMissError(
+                f"handoff prompt {committed} + max_new_tokens "
+                f"{max_new} exceeds the per-slot budget max_len "
+                f"{cfg.max_len}", prompt_len=committed,
+                max_new_tokens=max_new, max_len=cfg.max_len)
+        deadline = self.admission.deadline_for(deadline_ms)
+        req = DecodeRequest(prompt, max_new,
+                            priority=int(handoff.get("priority", 0)),
+                            deadline=deadline, trace=trace)
+        req.handoff = handoff
+        try:
+            with self._cv:
+                self.admission.check(self._unresolved)
+                self._queue.append(req)
+                self._unresolved += 1
+                self._cv.notify_all()
+        except ServingError as e:
+            if e.kind == "queue_full":
+                self.stats.record_shed()
+            elif e.kind == "circuit_open":
+                self.stats.record_circuit_reject()
+            if trace is not None and not trace.fleet_owned \
+                    and self.tracer is not None:
+                trace.point("rejected", reject=e.kind,
+                            replica_id=self.replica_id)
+                self.tracer.finish(trace, error=e)
+            raise
+        self.stats.record_submit()
+        return req.future
 
     # -- scheduler ------------------------------------------------------
     def _loop(self):
@@ -942,7 +1084,7 @@ class DecodeEngine:
             pend["ev"].set()
 
     def _resolve(self, slot_id: int, error: Optional[BaseException]
-                 = None):
+                 = None, value=None):
         slot = self._slots[slot_id]
         self._slots[slot_id] = None
         self.page_pool.free(slot.pages)
@@ -963,8 +1105,11 @@ class DecodeEngine:
             # which weights produced this generation (a router's
             # response tag for the hot-reload roll)
             slot.req.future.model_version = slot.version
+            # `value` overrides the token array for role="prefill":
+            # the future resolves with the KV handoff package instead
             slot.req.future.set_result(
-                np.asarray(slot.generated, np.int32))
+                value if value is not None
+                else np.asarray(slot.generated, np.int32))
         self.stats.record_done()
         if own_trace:
             self.tracer.finish(tr)
@@ -1058,7 +1203,66 @@ class DecodeEngine:
             joiners.append(slot_id)
         if not joiners:
             return
-        self._dispatch_prefill(joiners)
+        # disagg: handoff joiners import their prefilled KV pages (one
+        # fixed-shape scatter each) instead of prefilling
+        imports = [i for i in joiners
+                   if self._slots[i].req.handoff is not None]
+        prefills = [i for i in joiners
+                    if self._slots[i].req.handoff is None]
+        for i in imports:
+            self._dispatch_import(i)
+        if prefills:
+            self._dispatch_prefill(prefills)
+
+    def _dispatch_import(self, slot_id: int):
+        """Scatter one handoff's exported KV rows into this worker's
+        pool at the receiving slot's pages, then seed the slot to the
+        unified engine's post-prefill state (pending first token) so
+        the next decode chunk continues bit-identically."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        slot = self._slots[slot_id]
+        h = slot.req.handoff
+        t_i0 = time.monotonic()
+        tr = slot.req.trace
+        if tr is not None:
+            tr.add("join_wait", slot.req.t_submit, t_i0,
+                   replica_id=self.replica_id, slot=slot_id)
+        try:
+            rows = {n: jnp.asarray(h["rows"][n]) for n in self._pools}
+            pools = self._import_exec(
+                rows, jnp.asarray(self._page_tables[slot_id]),
+                jnp.asarray(np.int32(h["committed"])), self._pools)
+        except BaseException as e:
+            self.stats.record_executor_failure()
+            self._breaker_result(False, 1)
+            err = ExecutorFailureError(
+                f"KV-page import dispatch failed: "
+                f"{type(e).__name__}: {e}",
+                error_type=type(e).__name__, joins=1)
+            t_i1 = time.monotonic()
+            if tr is not None:
+                tr.add("dispatch", t_i0, t_i1, kind="import",
+                       replica_id=self.replica_id, slot=slot_id,
+                       error=type(e).__name__)
+            self._resolve(slot_id, error=err)
+            return
+        t_i1 = time.monotonic()
+        if tr is not None:
+            tr.add("dispatch", t_i0, t_i1, kind="import",
+                   replica_id=self.replica_id, slot=slot_id,
+                   pages=len(slot.pages))
+        self._breaker_result(True, 1)
+        self._pools = pools
+        slot.committed = int(h["committed"])
+        slot.cur_tok = int(h["first_token"])
+        slot.generated = [int(t) for t in h["generated"]]
+        slot.remaining = slot.req.max_new_tokens - len(slot.generated)
+        self.stats.record_import()
+        if slot.remaining <= 0 or (cfg.eos_id is not None
+                                   and slot.cur_tok == cfg.eos_id):
+            self._resolve(slot_id)
 
     def _dispatch_prefill(self, joiners: List[int]):
         import jax.numpy as jnp
@@ -1125,12 +1329,68 @@ class DecodeEngine:
             slot.remaining = slot.req.max_new_tokens - 1
             ttfts.append((now - slot.req.t_submit) * 1e3)
         self.stats.record_prefill(len(joiners), ttfts)
+        if self.role == "prefill":
+            # disagg: every joiner resolves NOW with its KV handoff
+            # package — the slot and pages recycle immediately, so the
+            # prefill worker's TTFT is decoupled from any decode
+            # occupancy (the whole point of the split)
+            self._export_handoffs(joiners)
+            return
         # a request satisfied by its very first token resolves here
         for i in joiners:
             slot = self._slots[i]
             if slot.remaining <= 0 or (cfg.eos_id is not None
                                        and slot.cur_tok == cfg.eos_id):
                 self._resolve(i)
+
+    def _export_handoffs(self, joiners: List[int]):
+        """role="prefill": gather each joiner's pool pages to host rows
+        and resolve its future with the handoff wire package (PR 14
+        descriptor fields + the KV rows; docs/SERVING.md §disagg).
+        Rows copy VERBATIM in pool dtype — int8 codes and their scale
+        sidecars transfer without requantization, so the hop is
+        bitwise."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        for i in joiners:
+            slot = self._slots[i]
+            done = slot.remaining <= 0 or (
+                cfg.eos_id is not None and slot.cur_tok == cfg.eos_id)
+            t_e0 = time.monotonic()
+            rows = None
+            nbytes = 0
+            if not done:
+                exported = self._export_exec(
+                    jnp.asarray(self._page_tables[i]), self._pools)
+                rows = {n: np.asarray(v) for n, v in exported.items()}
+                # valid rows only — padding rows never cross the wire
+                # in accounting (they do travel in the fixed buffers)
+                nbytes = sum(slot.committed * v.shape[1]
+                             * v.dtype.itemsize for v in rows.values())
+            t_e1 = time.monotonic()
+            tr = slot.req.trace
+            if tr is not None and not done:
+                tr.add("export", t_e0, t_e1,
+                       replica_id=self.replica_id, slot=i,
+                       pages=len(slot.pages), bytes=nbytes)
+            package = {
+                "kind": "handoff",
+                "prompt": [int(t) for t in slot.req.prompt],
+                "first_token": int(slot.cur_tok),
+                "generated": [int(t) for t in slot.generated],
+                "committed": int(slot.committed),
+                "max_new_tokens": slot.req.max_new_tokens,
+                "priority": slot.req.priority,
+                "done": bool(done),
+                "n_pages": len(slot.pages),
+                "rows": rows,
+                "bytes": int(nbytes),
+                "export_ms": round((t_e1 - t_e0) * 1e3, 3),
+                "from_replica": self.replica_id,
+                "model_version": slot.version,
+            }
+            self._resolve(i, value=package)
 
     def _breaker_result(self, ok: bool, n: int):
         res = self.admission.record_dispatch_result(ok)
@@ -1174,6 +1434,8 @@ class DecodeEngine:
     def _decode(self):
         import jax.numpy as jnp
 
+        if self._decode_exec is None:
+            return  # role="prefill": every slot resolved at export
         cfg = self.config
         active_ids = self._ensure_decode_pages()
         if not active_ids:

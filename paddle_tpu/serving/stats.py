@@ -300,6 +300,8 @@ class DecodeStats:
         self.reload_pause_ms = 0.0  # worst single swap pause
         self.prefills = 0           # prefill dispatches
         self.prefill_joins = 0      # requests admitted via those
+        self.imports = 0            # KV-page handoff imports accepted
+        #                             (role="decode" workers only)
         self.decode_dispatches = 0  # chunked decode dispatches
         self.decode_iterations = 0  # While iterations across them
         self.tokens_generated = 0
@@ -372,6 +374,14 @@ class DecodeStats:
         for ms in ttfts_ms:
             self.ttft_ms.record(ms)
 
+    def record_import(self, n: int = 1):
+        """A decode-role worker accepted a KV-page handoff (the first
+        token was produced — and counted — on the PREFILL worker, so
+        imports add no tokens here; the fleet-merged totals stay
+        exact)."""
+        with self._lock:
+            self.imports += n
+
     def record_decode(self, iterations: int, active_slots: int,
                       num_slots: int, tokens: int, pages_in_use: int,
                       num_pages: int, elapsed_ms: float):
@@ -432,7 +442,7 @@ class DecodeStats:
                 "submitted", "completed", "shed", "deadline_misses",
                 "bucket_misses", "circuit_rejects", "executor_failures",
                 "preemptions", "evacuations", "reloads", "prefills",
-                "prefill_joins", "decode_dispatches",
+                "prefill_joins", "imports", "decode_dispatches",
                 "decode_iterations", "tokens_generated", "_slot_steps",
                 "_cap_steps", "_util_sum", "_util_samples")}
             o_peak = other.peak_pages_in_use
@@ -464,6 +474,7 @@ class DecodeStats:
                 "reload_pause_ms": round(self.reload_pause_ms, 3),
                 "prefills": self.prefills,
                 "prefill_joins": self.prefill_joins,
+                "imports": self.imports,
                 "decode_dispatches": self.decode_dispatches,
                 "decode_iterations": self.decode_iterations,
                 "tokens_generated": self.tokens_generated,

@@ -158,6 +158,51 @@ def test_chrome_export_rows_and_metadata(tmp_path):
     assert tr.export_chrome_trace(window_s=0.0)["traceEvents"] == []
 
 
+def test_chrome_export_kv_transfer_flow_events():
+    """The disagg handoff pin (ISSUE 18): one trace_id draws the whole
+    journey — prefill-worker row, a kv_transfer arrow, decode-worker
+    row.  The exporter emits a chrome flow-event pair (ph "s" on the
+    SOURCE replica's row at t0, ph "f" bp "e" on the DESTINATION
+    replica's row at t1) for every kv_transfer span that names both
+    endpoints, so the page hop renders as an arrow between rows."""
+    tr = ReqTracer()
+    t = tr.new_trace("disagg")
+    now = time.monotonic()
+    t.add("dispatch", now, now + 0.004, replica_id=0)      # prefill row
+    t.add("kv_transfer", now + 0.004, now + 0.006,
+          from_replica=0, to_replica=1, pages=3, bytes=4096)
+    t.add("dispatch", now + 0.006, now + 0.012, replica_id=1)  # decode
+    tr.finish(t)
+    out = tr.export_chrome_trace()
+    evs = out["traceEvents"]
+    # the span itself stays a router-row slice (no replica_id attr)
+    kv_x = [e for e in evs if e["ph"] == "X"
+            and e["name"] == "kv_transfer"]
+    assert len(kv_x) == 1 and kv_x[0]["pid"] == 0
+    starts = [e for e in evs if e["ph"] == "s"]
+    finishes = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 1
+    s, f = starts[0], finishes[0]
+    assert s["name"] == f["name"] == "kv_transfer"
+    assert s["id"] == f["id"]                  # one arrow, paired
+    assert s["tid"] == f["tid"]
+    assert f["bp"] == "e"                      # bind to enclosing slice
+    assert s["pid"] == 1                       # replica 0's row
+    assert f["pid"] == 2                       # replica 1's row
+    assert s["ts"] < f["ts"]
+    assert s["args"]["trace_id"] == t.trace_id
+    # both endpoint rows exist: one trace spans prefill AND decode rows
+    assert {e["pid"] for e in evs if e["ph"] == "X"} == {0, 1, 2}
+    # a kv_transfer span missing an endpoint draws no arrow (and does
+    # not crash the exporter)
+    t2 = tr.new_trace("disagg")
+    t2.add("kv_transfer", now, now + 0.001, from_replica=0,
+           to_replica=None)
+    tr.finish(t2)
+    evs2 = tr.export_chrome_trace()["traceEvents"]
+    assert len([e for e in evs2 if e["ph"] == "s"]) == 1  # unchanged
+
+
 # ---------------------------------------------------------------------------
 # Engine integration (single-shot serving + decode)
 # ---------------------------------------------------------------------------

@@ -232,6 +232,36 @@ def check_schema(candidate):
                 errors.append(
                     f"detail.{name}: client-visible failures during "
                     f"the chaos run (the zero-failure fleet contract)")
+        if name.startswith("serving_disagg"):
+            # disagg contract (ISSUE 18, docs/SERVING.md §disagg): a
+            # phase-disaggregated entry must carry the JOINT client
+            # TTFT (submit -> first token across the prefill hop), the
+            # steady decode throughput, the measured handoff tax
+            # (latency + pages moved), and the fleet-wide
+            # zero-recompile proof — the KV-page import must never
+            # recompile the decode executable
+            for field in ("ttft_p99_ms", "tokens_per_sec",
+                          "handoff_ms_p50", "pages_transferred",
+                          "post_warmup_compiles"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: disagg entry "
+                                  f"missing {field!r} (disagg serving "
+                                  f"contract)")
+            if entry.get("post_warmup_compiles"):
+                errors.append(
+                    f"detail.{name}: {entry['post_warmup_compiles']} "
+                    f"post-warmup compile(s) — a handoff import or "
+                    f"scale event recompiled (the disagg fleet-wide "
+                    f"zero-recompile contract)")
+            if entry.get("zero_client_failures") is False:
+                errors.append(
+                    f"detail.{name}: client-visible failures during "
+                    f"the disagg run (the zero-failure contract)")
+            if entry.get("token_parity_vs_unified") is False:
+                errors.append(
+                    f"detail.{name}: disagg tokens diverged from the "
+                    f"unified fleet (greedy decode must be "
+                    f"bit-identical across the KV handoff)")
         if name.startswith("serving_decode"):
             # decode contract (ISSUE 12, docs/SERVING.md §decode): a
             # continuous-batching decode entry must carry the

@@ -572,6 +572,143 @@ print("fleet bench smoke OK:",
 EOF
 python tools/perf_gate.py --schema --candidate /tmp/bench_fleet_line.json
 
+echo "== disagg serving chaos smoke (cpu) =="
+# ISSUE 18 tentpole: phase-disaggregated fleet (2 prefill + 2 decode
+# workers), kill ONE worker of EACH kind mid-stream -> zero
+# client-visible failures and every output token-identical to the
+# unified control engine (the parity contract holds across the KV-page
+# handoff AND across both failover kinds); fleet-wide
+# post_warmup_compiles stays 0 — the fixed-shape import scatter never
+# recompiles the decode executable.  The chrome trace proof: ONE
+# trace_id draws prefill-worker row -> kv_transfer flow arrow ->
+# decode-worker row.
+python - <<'EOF'
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.observe import ReqTracer
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import DecodeConfig, DecodeEngine, DisaggFleet
+
+def mk(role):
+    lm = DecoderLM(vocab_size=96, n_layer=2, n_head=2, d_model=32,
+                   d_inner=64, kv_dtype="float32", seed=5)
+    cfg = DecodeConfig(num_slots=2, page_size=4, max_len=48,
+                       num_pages=24, prefill_buckets=(8, 16),
+                       decode_chunk=2, kv_dtype="float32")
+    return DecodeEngine(lm, cfg, role=role, memory_budget_bytes=False)
+
+prompts = make_prompts(8, 96, min_len=3, max_len=12, seed=9)
+budgets = [18, 16, 20, 14, 18, 16, 15, 17]
+
+ctrl = mk("unified").start()
+control = [ctrl.generate(p, max_new_tokens=b, timeout_s=300).tolist()
+           for p, b in zip(prompts, budgets)]
+ctrl.close()
+
+tracer = ReqTracer(sample_rate=1.0)
+fleet = DisaggFleet([mk("prefill"), mk("prefill")],
+                    [mk("decode"), mk("decode")],
+                    tracer=tracer).start()
+pf_victim = fleet.prefill[0].engine
+dec_victim = fleet.decode[0].engine
+chaos.arm(f"replica:{pf_victim.replica_id}:kill", times=1)
+futs = [fleet.submit(p, max_new_tokens=b)
+        for p, b in zip(prompts, budgets)]
+end = time.monotonic() + 60
+while dec_victim.stats.tokens_generated < 2 and time.monotonic() < end:
+    time.sleep(0.002)
+chaos.kill_replica(dec_victim)      # mid-generation decode death
+resps = [f.result(300) for f in futs]
+chaos.clear()
+outs = [list(r.tokens) for r in resps]
+snap = fleet.snapshot()
+assert outs == control, "disagg chaos broke greedy token identity"
+assert snap["failed"] == 0, snap
+assert snap["prefill_failovers"] >= 1, snap
+assert snap["decode_failovers"] >= 1, snap
+assert snap["parity_failed"] == 0, snap
+assert snap["post_warmup_compiles"] == 0, snap
+assert snap["handoffs"] >= len(prompts), snap
+assert snap["pages_transferred"] > 0, snap
+
+# -- the one-trace handoff proof: prefill row -> arrow -> decode row --
+r0 = resps[0]
+pf_ids = {h.replica_id for h in fleet.prefill}
+dec_ids = {h.replica_id for h in fleet.decode}
+assert r0.hops[0] in pf_ids and r0.hops[-1] in dec_ids, r0.hops
+t = tracer.trace(r0.trace_id)
+assert "kv_transfer" in t.span_names(), t.span_names()
+ct = tracer.export_chrome_trace("/tmp/disagg_chaos_trace.json")
+xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"
+      and e["args"].get("trace_id") == r0.trace_id]
+rows = {e["pid"] for e in xs}
+# router row + the prefill worker's row + the decode worker's row
+assert rows >= {0, r0.hops[0] + 1, r0.hops[-1] + 1}, rows
+flows = [e for e in ct["traceEvents"] if e["name"] == "kv_transfer"
+         and e.get("ph") in ("s", "f")
+         and e["args"].get("trace_id") == r0.trace_id]
+by_id = {}
+for e in flows:
+    by_id.setdefault(e["id"], []).append(e)
+# every arrow is a paired s/f (one per handoff hop of this request)
+assert by_id, flows
+assert all(sorted(x["ph"] for x in v) == ["f", "s"]
+           for v in by_id.values()), flows
+# the FINAL arrow lands on the decode worker that served the request,
+# leaving from a prefill-worker row
+last = max(by_id.values(), key=lambda v: min(x["ts"] for x in v))
+src = next(e for e in last if e["ph"] == "s")
+dst = next(e for e in last if e["ph"] == "f")
+assert src["pid"] - 1 in pf_ids and dst["pid"] == r0.hops[-1] + 1, \
+    (src["pid"], dst["pid"], r0.hops)
+fleet.close()
+print("disagg chaos smoke OK:",
+      {k: snap[k] for k in ("completed", "handoffs", "pages_transferred",
+                            "prefill_failovers", "decode_failovers",
+                            "parity_checked", "post_warmup_compiles")},
+      {"trace_id": r0.trace_id, "rows": sorted(rows),
+       "exported": "/tmp/disagg_chaos_trace.json"})
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q
+
+echo "== disagg bench line + schema gate (cpu) =="
+# the --model serving_disagg entry must print one JSON line carrying
+# the joint TTFT p99, steady tokens/s, the handoff tax
+# (handoff_ms_p50 + pages_transferred), the unified-control comparison
+# keys, and the fleet-wide zero-recompile proof, and satisfy
+# perf_gate --schema
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "serving_disagg",
+     "--probe-timeout", "0"],
+    capture_output=True, text=True, timeout=900)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["serving_disagg"]
+assert "error" not in d, d
+assert d["tokens_per_sec"] > 0 and d["post_warmup_compiles"] == 0, d
+assert d["zero_client_failures"] and d["token_parity_vs_unified"], d
+assert d["handoffs"] == d["n_requests"] and d["pages_transferred"] > 0, d
+for k in ("ttft_p99_ms", "handoff_ms_p50", "unified_ttft_p99_ms",
+          "unified_tokens_per_sec", "wins_ttft", "wins_tokens"):
+    assert k in d, k
+with open("/tmp/bench_disagg_line.json", "w") as f:
+    f.write(lines[-1])
+print("disagg bench smoke OK:",
+      {k: d[k] for k in ("ttft_p99_ms", "unified_ttft_p99_ms",
+                         "tokens_per_sec", "unified_tokens_per_sec",
+                         "handoff_ms_p50", "pages_transferred",
+                         "wins_ttft", "wins_tokens",
+                         "post_warmup_compiles")})
+EOF
+python tools/perf_gate.py --schema --candidate /tmp/bench_disagg_line.json
+
 echo "== resilience chaos smoke (cpu) =="
 # the fault-tolerance contract end-to-end (docs/RESILIENCE.md): inject
 # NaN at step 3 -> the guard skips exactly that update; corrupt the
