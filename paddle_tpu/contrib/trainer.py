@@ -63,6 +63,16 @@ class EndStepEvent:
         self.metrics = metrics
 
 
+class _RollbackSignal(Exception):
+    """Internal control flow: unwind the epoch loop to the restored
+    cursor after an autopilot rollback (never escapes train())."""
+
+    def __init__(self, epoch: int, step: int):
+        super().__init__(f"rollback to epoch {epoch} step {step}")
+        self.epoch = epoch
+        self.step = step
+
+
 class CheckpointConfig:
     """reference contrib/trainer.py CheckpointConfig:100.
 
@@ -103,7 +113,8 @@ class Trainer:
                  = None, scope: Optional[Scope] = None, telemetry=None,
                  step_deadline_s: Optional[float] = None,
                  preempt_drain: bool = False, mesh=None,
-                 build_strategy=None):
+                 build_strategy=None, autopilot=None,
+                 validate_feed: bool = False):
         """telemetry: an observe.TelemetryConfig — enables the
         device-side StepTelemetry accumulator on the train program and
         publishes a window (telemetry means + compile/retrace/dispatch
@@ -139,7 +150,27 @@ class Trainer:
         BuildStrategy — its `grad_sync` knob ("bf16"/"int8"/
         GradSyncConfig) opts gradient exchange into the explicit
         (optionally blockwise-int8-quantized) all-reduce instead of
-        the implicit GSPMD one (docs/DIST.md)."""
+        the implicit GSPMD one (docs/DIST.md).
+
+        autopilot: a resilience.AutopilotConfig (or True for
+        defaults) — the divergence autopilot (docs/RESILIENCE.md
+        §autopilot): on a guard-skip streak or loss/grad-norm z-trip
+        the trainer rolls back IN PROCESS to the newest verified-good
+        checkpoint, quarantines the poisoned data window on replay,
+        and — once the rollback budget is spent — halts with a
+        structured TrainingDivergedError plus a flight-recorder
+        bundle.  Requires telemetry= (the trigger signals ride the
+        telemetry windows) and checkpoint_config= (rollback needs
+        serials); the update guard (resilience.enable_update_guard)
+        supplies the skip-streak signal.  Pure host: the step
+        lowering is byte-identical with the autopilot on or off.
+
+        validate_feed: host-side admission check on every batch
+        (data.pipeline.validate_feed_batch) BEFORE it reaches the
+        device — a non-finite or signature-drifted batch is dropped
+        with a `feed_quarantined` event + counter (feed_stats), and
+        counted into the autopilot's quarantine ledger when one is
+        attached."""
         self.checkpoint_cfg = checkpoint_config
         self.telemetry_cfg = telemetry
         self.step_deadline_s = step_deadline_s
@@ -188,8 +219,9 @@ class Trainer:
         self._resume_reader_state = None
         # observe pillar 8: every second of train() wall clock lands in
         # exactly one ledger category (step/replay/compile/data_stall/
-        # checkpoint/barrier_wait/idle) — pure host bookkeeping, the
-        # traced step is byte-identical with or without it
+        # checkpoint/recovery/barrier_wait/idle) — pure host
+        # bookkeeping, the traced step is byte-identical with or
+        # without it
         from ..observe.goodput import GoodputLedger
 
         self.goodput_ledger = GoodputLedger()
@@ -199,6 +231,28 @@ class Trainer:
         # /metrics (the keys survive as aliases for perf_gate baselines)
         self.ckpt_stats = {"saves": 0, "blocking_ms": 0.0,
                            "write_ms": 0.0, "bytes": 0}
+        self.validate_feed = bool(validate_feed)
+        self.feed_stats = {"quarantined": 0}
+        self._feed_signature = None
+        self.autopilot = None
+        self._window_dirty = False   # last published window poisoned?
+        self._in_recovery = False    # between rollback and re-entry
+        if autopilot:
+            from ..resilience.autopilot import (AutopilotConfig,
+                                                RecoveryController)
+
+            if telemetry is None:
+                raise ValueError(
+                    "autopilot= requires telemetry= — the recovery "
+                    "controller consumes the periodic telemetry "
+                    "windows (observe.TelemetryConfig)")
+            if checkpoint_config is None:
+                raise ValueError(
+                    "autopilot= requires checkpoint_config= — "
+                    "rollback needs verified-good serials to restore")
+            cfg = (autopilot if isinstance(autopilot, AutopilotConfig)
+                   else AutopilotConfig())
+            self.autopilot = RecoveryController(cfg)
         self.last_telemetry = None     # newest StepTelemetry window
         #                                (the metrics-registry source)
         self._metrics_registry = None
@@ -382,8 +436,13 @@ class Trainer:
                 # it so stale shard files cannot mix with the fresh save
                 shutil.rmtree(path, ignore_errors=True)
             os.makedirs(path, exist_ok=True)
+            # verified-good marking (autopilot anchor + _rotate pin):
+            # computed on the training thread at snapshot time, so the
+            # verdict describes exactly the state being saved
+            verified = self._checkpoint_verified()
             trainer_state = {"epoch": epoch, "step": step,
                              "serial": serial,
+                             "verified_good": verified,
                              "train_state":
                              self._capture_train_state(epoch, step)}
             with scope_guard(self.scope):
@@ -404,6 +463,11 @@ class Trainer:
                     json.dump(trainer_state, f)
                 os.replace(tmp,
                            os.path.join(path, "__trainer_state__.json"))
+                if self.autopilot is not None:
+                    # the serial becomes a rollback anchor only after
+                    # its state file landed — never before
+                    self.autopilot.note_checkpoint(serial, epoch, step,
+                                                   verified)
                 self._rotate()
                 led.note_background("ckpt_write",
                                     (job.write_ms or 0.0) / 1000.0)
@@ -458,13 +522,61 @@ class Trainer:
             if surface:
                 raise
 
+    def _checkpoint_verified(self) -> bool:
+        """The verified-good verdict for the state being saved RIGHT
+        NOW: the trailing telemetry window is clean.  Three gates —
+        the device accumulator's current (since-last-fetch) nonfinite/
+        skip counters are zero, the last PUBLISHED window was clean
+        (the accumulator resets at each fetch, so a poison just before
+        a fetch would otherwise be invisible at save time), and the
+        autopilot (when attached) holds no unresolved anomaly.  A
+        trainer without telemetry marks every save verified — it has
+        no evidence of poison, and the pre-autopilot rotation
+        semantics are unchanged."""
+        from ..observe.metrics import TELEMETRY_VAR
+
+        if self._window_dirty:
+            return False
+        if self.autopilot is not None and not self.autopilot.healthy:
+            return False
+        tel = self.scope.find_var(TELEMETRY_VAR)
+        if tel is not None:
+            for k in ("nonfinite_grad_steps", "nonfinite_loss_steps",
+                      "skipped_update_steps"):
+                v = tel.get(k) if hasattr(tel, "get") else None
+                if v is not None and float(np.asarray(v)) > 0:
+                    return False
+        return True
+
+    def _serial_verified(self, serial: int) -> bool:
+        """Read a serial's on-disk verified-good marking (False for
+        pre-marking checkpoints and unreadable state files)."""
+        path = os.path.join(self._ckpt_root(), f"ckpt_{serial}",
+                            "__trainer_state__.json")
+        try:
+            with open(path) as f:
+                return bool(json.load(f).get("verified_good"))
+        except (OSError, ValueError):
+            return False
+
     def _rotate(self):
-        # rotate (reference keeps max_num_checkpoints, deleting oldest)
+        # rotate (reference keeps max_num_checkpoints, deleting
+        # oldest) — EXCEPT the newest verified-good serial, which is
+        # pinned: blind oldest-first deletion could evict the last
+        # known-good checkpoint while keeping N newer poisoned ones,
+        # leaving the autopilot (and crash resume) nothing sane to
+        # restore (tests/test_autopilot.py pins the regression)
         root = self._ckpt_root()
         ids = self._list_checkpoints()
-        while len(ids) > self.checkpoint_cfg.max_num_checkpoints:
-            victim = os.path.join(root, f"ckpt_{ids.pop(0)}")
-            shutil.rmtree(victim, ignore_errors=True)
+        verified = [s for s in ids if self._serial_verified(s)]
+        pinned = verified[-1] if verified else None
+        victims = [s for s in ids if s != pinned]
+        while len(ids) > self.checkpoint_cfg.max_num_checkpoints \
+                and victims:
+            victim = victims.pop(0)
+            ids.remove(victim)
+            shutil.rmtree(os.path.join(root, f"ckpt_{victim}"),
+                          ignore_errors=True)
 
     def _load_checkpoint(self, path: str) -> dict:
         """Load one checkpoint dir (trainer cursor + train_state +
@@ -640,7 +752,9 @@ class Trainer:
                     "train_begin", num_epochs=num_epochs,
                     resume_epoch=self._resume_epoch,
                     resume_step=self._resume_step_in_epoch)
-        for epoch in range(self._resume_epoch, num_epochs):
+        epoch = self._resume_epoch
+        while epoch < num_epochs:
+          try:  # noqa: E111 — rollback unwind point for the whole epoch
             handler(BeginEpochEvent(epoch))
             step = 0
             done = 0
@@ -655,11 +769,29 @@ class Trainer:
                     skip -= 1
                     step += 1
                     continue
+                if self._quarantined(epoch, step):
+                    # autopilot rung 3: a batch inside a quarantined
+                    # window is consumed (cursor parity with the run
+                    # that trained on it) but never trained — the
+                    # poison does not get a second chance
+                    with self.goodput_ledger.phase(
+                            "recovery", label="quarantine"):
+                        step += 1
+                        self.autopilot.quarantined_batches += 1
+                    continue
+                if self._in_recovery:
+                    # first live batch past the quarantine: caught up —
+                    # reader waits are data_stall again, not recovery
+                    self._in_recovery = False
                 if not isinstance(batch, dict):
                     if feed_order is None:
                         raise ValueError(
                             "tuple batches need feed_order")
                     batch = dict(zip(feed_order, batch))
+                if self.validate_feed and self._reject_feed(
+                        batch, epoch, step):
+                    step += 1
+                    continue
                 begin = BeginStepEvent(epoch, step)
                 handler(begin)
                 if self._step_watchdog is not None:
@@ -691,6 +823,10 @@ class Trainer:
                         done % self.telemetry_cfg.interval == 0):
                     tel_snap = self._publish_telemetry(epoch, step,
                                                        tel_snap)
+                    if self.autopilot is not None:
+                        # may raise _RollbackSignal (rung 2) or
+                        # TrainingDivergedError (rung 4)
+                        self._autopilot_check(epoch, step)
                 if (self.checkpoint_cfg and
                         done % self.checkpoint_cfg.step_interval == 0):
                     self._save_checkpoint(serial, epoch, step)
@@ -716,6 +852,20 @@ class Trainer:
             handler(EndEpochEvent(epoch))
             if preempt.drain_requested():
                 self._drain(serial, epoch + 1, 0)
+          except _RollbackSignal as rb:  # noqa: E111
+            # autopilot rung 2 landed: the scope now holds the
+            # verified-good checkpoint — restart its epoch with the
+            # fast-forward cursor (skip replays nothing: batches before
+            # rb.step were trained pre-rollback and are skipped;
+            # batches in [rb.step, fail) hit the quarantine check)
+            epoch = rb.epoch
+            skip = rb.step
+            if (self._resume_reader_state is not None
+                    and reader is not None
+                    and hasattr(reader, "load_state_dict")):
+                reader.load_state_dict(self._resume_reader_state)
+            continue
+          epoch += 1  # noqa: E111
         # a background write still in flight must land (and a failed
         # one must surface) before train() returns green
         self._await_pending(surface=True)
@@ -742,15 +892,165 @@ class Trainer:
     def _goodput_batches(self, it):
         """Wrap reader `next()` in the ledger's data_stall phase — the
         input pipeline's blocking time, attributed without touching the
-        reader or the step."""
+        reader or the step.  While replaying past a rollback the same
+        waits are autopilot fallout, not pipeline slowness, and land in
+        the `recovery` category instead."""
         led = self.goodput_ledger
         while True:
-            with led.phase("data_stall"):
+            with led.phase("recovery" if self._in_recovery
+                           else "data_stall"):
                 try:
                     batch = next(it)
                 except StopIteration:
                     return
             yield batch
+
+    # -- divergence autopilot (resilience/autopilot.py) ------------------
+    def _quarantined(self, epoch: int, pos: int) -> bool:
+        """Is reader position (epoch, pos) inside a quarantined data
+        window?  Windows are half-open [(e_r, s_r), (e_f, s_f)) in
+        tuple order — the batches the diverged timeline consumed after
+        the rollback anchor and before detection."""
+        if self.autopilot is None:
+            return False
+        for w in self.autopilot.quarantine_windows:
+            if ((w["from_epoch"], w["from_step"]) <= (epoch, pos)
+                    < (w["to_epoch"], w["to_step"])):
+                return True
+        return False
+
+    def _reject_feed(self, batch: dict, epoch: int, step: int) -> bool:
+        """Opt-in admission check (validate_feed=True): non-finite
+        values, unknown feed names, or dtype/rank drift vs the first
+        accepted batch quarantine the batch BEFORE it reaches
+        device_put — poison stopped at the door costs one skipped
+        batch, not a guard trip and a rollback."""
+        from ..data.pipeline import feed_signature, validate_feed_batch
+
+        problems = validate_feed_batch(batch, self._feed_signature)
+        if not problems:
+            if self._feed_signature is None:
+                self._feed_signature = feed_signature(batch)
+            return False
+        self.feed_stats["quarantined"] += 1
+        if self.autopilot is not None:
+            self.autopilot.note_quarantined_feed()
+        self._emit("feed_quarantined", epoch=epoch, step=step,
+                   quarantined_total=self.feed_stats["quarantined"],
+                   problems=problems)
+        return True
+
+    def _autopilot_check(self, epoch: int, step: int) -> None:
+        """Feed the freshly published telemetry window to the
+        RecoveryController; escalate when it returns a trigger."""
+        ap = self.autopilot
+        if ap.halted or self.last_telemetry is None:
+            return
+        trigger = ap.observe_window(self.last_telemetry, epoch, step)
+        if trigger is None:
+            return
+        if ap.rollbacks >= ap.cfg.max_rollbacks:
+            self._recovery_halt(trigger, epoch, step,
+                                reason="rollback_budget_exhausted")
+        self._rollback(trigger, epoch, step)
+
+    def _rollback(self, trigger: dict, epoch: int, step: int) -> None:
+        """Rung 2+3: restore the newest loadable verified-good serial
+        in process, quarantine the data window the diverged timeline
+        consumed, and unwind the epoch loop to the restored cursor."""
+        from ..resilience.errors import CheckpointError
+
+        ap = self.autopilot
+        target = None
+        with self.goodput_ledger.phase("recovery", label="rollback"):
+            # a background save may still reference the live arrays —
+            # and a save of the POISONED state must not land after the
+            # restore and become the newest serial
+            self._await_pending(surface=False)
+            for serial, e_r, s_r in reversed(ap.verified_serials()):
+                path = os.path.join(self._ckpt_root(),
+                                    f"ckpt_{serial}")
+                try:
+                    self._load_checkpoint(path)
+                except CheckpointError as e:
+                    self._emit("ckpt_fallback", serial=serial,
+                               error=e.as_dict())
+                    ap.forget_serial(serial)
+                    continue
+                target = (serial, e_r, s_r)
+                break
+        if target is None:
+            self._recovery_halt(trigger, epoch, step,
+                                reason="no_verified_checkpoint")
+        serial, e_r, s_r = target
+        window = {"from_epoch": e_r, "from_step": s_r,
+                  "to_epoch": epoch, "to_step": step}
+        ap.on_rollback(window)
+        self._window_dirty = False  # the restored state is clean
+        self._in_recovery = True
+        backoff = self._apply_lr_backoff()
+        self._emit("recovery_rollback", serial=serial, trigger=trigger,
+                   rollbacks=ap.rollbacks, budget=ap.cfg.max_rollbacks,
+                   lr_backoff=backoff, **window)
+        self._emit("data_quarantine",
+                   batches=(window["to_step"] - window["from_step"]
+                            if window["from_epoch"] == window["to_epoch"]
+                            else None), **window)
+        raise _RollbackSignal(e_r, s_r)
+
+    def _recovery_halt(self, trigger: dict, epoch: int, step: int,
+                       reason: str) -> None:
+        """Rung 4: stop deliberately with full provenance (plus a
+        FlightRecorder bundle when pillar 9 is attached) instead of
+        guard-skipping updates forever."""
+        from ..resilience.errors import TrainingDivergedError
+
+        ap = self.autopilot
+        ap.halted = True
+        ap.last_trigger = dict(trigger)
+        bundle = None
+        if self.flight_recorder is not None:
+            bundle = self.flight_recorder.record(
+                "training_diverged", force=True,
+                context={"trigger": trigger, "reason": reason,
+                         "epoch": epoch, "step": step,
+                         "rollbacks": ap.rollbacks,
+                         "budget": ap.cfg.max_rollbacks,
+                         "quarantine_windows": ap.quarantine_windows})
+        self._emit("recovery_halt", reason=reason, epoch=epoch,
+                   step=step, trigger=trigger, rollbacks=ap.rollbacks,
+                   budget=ap.cfg.max_rollbacks, flight_bundle=bundle)
+        raise TrainingDivergedError(
+            f"training diverged at epoch {epoch} step {step} "
+            f"(signal: {trigger.get('signal')}); halting: {reason} "
+            f"after {ap.rollbacks}/{ap.cfg.max_rollbacks} rollbacks",
+            reason=reason, trigger=trigger, epoch=epoch, step=step,
+            rollbacks=ap.rollbacks, budget=ap.cfg.max_rollbacks,
+            quarantine_windows=list(ap.quarantine_windows),
+            first_nonfinite_op=trigger.get("first_nonfinite_op"),
+            flight_bundle=bundle)
+
+    def _apply_lr_backoff(self):
+        """Optional rung-3 extra: scale every `.learning_rate`
+        variable (optimizer.py names them `<op>.learning_rate`) after
+        a restore.  Off by default — the chaos parity proof requires
+        re-entry bit-identical to a run that never diverged."""
+        factor = self.autopilot.cfg.lr_backoff
+        if factor is None or factor == 1.0:
+            return None
+        scaled = []
+        with scope_guard(self.scope):
+            for name in list(self.train_program.global_block().vars):
+                if not name.endswith(".learning_rate"):
+                    continue
+                arr = self.scope.find_var(name)
+                if arr is None:
+                    continue
+                host = np.asarray(arr)
+                self.scope.set_var(
+                    name, host * np.asarray(factor, dtype=host.dtype))
+                scaled.append(name)
+        return ({"factor": factor, "vars": scaled} if scaled else None)
 
     # -- goodput (observe pillar 8) --------------------------------------
     def _progress_path(self) -> str:
@@ -843,6 +1143,12 @@ class Trainer:
         if tel is None or tel.steps == 0:
             return now
         self.last_telemetry = tel
+        # verified-good bookkeeping: the accumulator resets on fetch,
+        # so the save path needs this window's verdict remembered
+        self._window_dirty = bool(
+            tel.skipped_update_steps or tel.nonfinite_grad_steps
+            or tel.nonfinite_loss_steps
+            or tel.first_nonfinite_op is not None)
         if self._event_log:
             delta = observe.runtime_stats.delta(since or {})
             self._event_log.telemetry_window(
@@ -875,6 +1181,7 @@ class Trainer:
         if self._metrics_registry is None:
             from ..observe.registry import (MetricsRegistry, gauge,
                                             goodput_collector,
+                                            recovery_collector,
                                             standard_collectors,
                                             telemetry_collector)
 
@@ -884,6 +1191,11 @@ class Trainer:
                              lambda: self.last_telemetry))
             reg.register("goodput",
                          goodput_collector(lambda: self.goodput()))
+            reg.register("recovery",
+                         recovery_collector(
+                             lambda: (self.autopilot.snapshot()
+                                      if self.autopilot is not None
+                                      else None)))
 
             def ckpt_collect():
                 s = self.ckpt_stats

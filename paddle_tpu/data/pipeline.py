@@ -18,14 +18,65 @@ instead of compute + transfer.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+import time
+from typing import (Any, Callable, Dict, Iterable, List, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
 
 from .decorator import _ReaderError
 
 _STOP = object()
+
+# producer-exception classes retried by default: the transient I/O
+# family (a flaky remote filesystem / dataset service).  A ValueError
+# from a broken reader is NOT transient — it reproduces on replay, so
+# retrying it would just burn the budget before the same _ReaderError.
+DEFAULT_RETRYABLE: Tuple[type, ...] = (ConnectionError, TimeoutError)
+
+
+def feed_signature(batch: Dict[str, Any]) -> Dict[str, Tuple[str, int]]:
+    """The per-feed (dtype, ndim) signature validation locks onto
+    after the first accepted batch — a drift would retrace the jitted
+    step (feed-signature storm) before it produced a wrong number."""
+    return {n: (str(np.asarray(v).dtype), int(np.asarray(v).ndim))
+            for n, v in batch.items()}
+
+
+def validate_feed_batch(batch: Dict[str, Any],
+                        signature: Optional[Dict[str, Tuple[str, int]]]
+                        = None) -> List[Dict[str, Any]]:
+    """Host-side admission check, shared by DeviceFeeder(validate=True)
+    and Trainer(validate_feed=True): every float feed must be finite,
+    and (with a locked signature) dtypes/ndims must match the first
+    accepted batch.  Returns a list of structured problems (empty =
+    admit) — the payload of the `feed_quarantined` event."""
+    problems: List[Dict[str, Any]] = []
+    for name, v in batch.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating):
+            finite = np.isfinite(arr)
+            if not finite.all():
+                problems.append(
+                    {"name": name, "problem": "nonfinite",
+                     "bad_values": int(arr.size - int(finite.sum()))})
+        if signature is not None:
+            want = signature.get(name)
+            got = (str(arr.dtype), int(arr.ndim))
+            if want is None:
+                problems.append({"name": name,
+                                 "problem": "unknown_feed"})
+            elif want != got:
+                problems.append({"name": name,
+                                 "problem": "signature_drift",
+                                 "want": list(want),
+                                 "got": list(got)})
+    if signature is not None:
+        for name in sorted(set(signature) - set(batch)):
+            problems.append({"name": name, "problem": "missing_feed"})
+    return problems
 
 
 class DeviceFeeder:
@@ -35,18 +86,61 @@ class DeviceFeeder:
             ({name: np.ndarray}) — one dict per step.
     capacity: max in-flight prefetched batches (2 = classic double
               buffering; raise it to ride out producer jitter).
+    validate: host-side admission check (validate_feed_batch) before
+              any device_put is spent — a poisoned batch is dropped
+              with a `feed_quarantined` event + counter instead of
+              reaching the step.
+    retryable: exception classes the producer treats as TRANSIENT:
+               instead of killing the pass via _ReaderError it
+               re-opens the reader, fast-forwards past the batches
+               already produced (the reader must be deterministic —
+               the same contract checkpoint resume already imposes),
+               and retries with exponential backoff, up to
+               max_retries consecutive failures.
+    stall_timeout_s: producer-stall watchdog on the CONSUMER side — a
+               `next()` that waits longer than this emits a loud
+               `feeder_stall` event (queue depth attached) and keeps
+               waiting, instead of blocking the training loop
+               silently.
+    event_log: an observe.RunEventLog for the feeder_* /
+               feed_quarantined events (stderr otherwise).
     """
 
     def __init__(self, reader: Callable[[], Iterable[Dict[str, np.ndarray]]],
-                 capacity: int = 2, device=None):
+                 capacity: int = 2, device=None, validate: bool = False,
+                 retryable: Optional[Tuple[type, ...]] = None,
+                 max_retries: int = 3, backoff_s: float = 0.05,
+                 stall_timeout_s: Optional[float] = None,
+                 event_log=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
         self._reader = reader
         self._capacity = capacity
         self._device = device
+        self._validate = bool(validate)
+        self._retryable = (DEFAULT_RETRYABLE if retryable is None
+                           else tuple(retryable))
+        self._max_retries = int(max_retries)
+        self._backoff_s = float(backoff_s)
+        self._stall_timeout_s = stall_timeout_s
+        self._event_log = event_log
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._signature: Optional[Dict[str, Tuple[str, int]]] = None
+        self.quarantined = 0   # admission-rejected batches (validate)
+        self.retries = 0       # transient producer errors survived
+        self.stalls = 0        # feeder_stall events emitted
+
+    def _emit(self, kind: str, **fields):
+        if self._event_log is not None:
+            self._event_log.event(kind, **fields)
+        else:
+            print(f"DeviceFeeder {kind}: "
+                  + " ".join(f"{k}={v}" for k, v in fields.items()),
+                  file=sys.stderr)
 
     # -- lifecycle (py_reader start/reset parity) -----------------------
     def start(self):
@@ -92,13 +186,60 @@ class DeviceFeeder:
                 continue
         return False
 
+    def _reopen(self, produced: int):
+        """Recover a transient producer failure: re-open the reader
+        and fast-forward past the batches already handed downstream —
+        the deterministic-reader contract checkpoint resume already
+        imposes makes the replayed prefix identical."""
+        it = iter(self._reader())
+        for _ in range(produced):
+            next(it)
+        return it
+
     def _producer(self, q: queue.Queue):
         import jax
 
+        from ..resilience import chaos
+
+        produced = 0     # batches handed to the queue this pass
+        attempts = 0     # consecutive transient failures
         try:
-            for batch in self._reader():
-                if self._stop.is_set():
+            it = iter(self._reader())
+            while not self._stop.is_set():
+                try:
+                    # deterministic fault injection for the retry and
+                    # stall-watchdog proofs (tests + CI chaos smoke)
+                    chaos.delaypoint("feeder:producer")
+                    chaos.failpoint("feeder:producer")
+                    batch = next(it)
+                except StopIteration:
+                    self._put(q, _STOP)
                     return
+                except self._retryable as e:
+                    attempts += 1
+                    if attempts > self._max_retries:
+                        raise
+                    self.retries += 1
+                    self._emit("feeder_retry", attempt=attempts,
+                               max_retries=self._max_retries,
+                               produced=produced,
+                               error=f"{type(e).__name__}: {e}")
+                    time.sleep(self._backoff_s * (2 ** (attempts - 1)))
+                    it = self._reopen(produced)
+                    continue
+                attempts = 0
+                if self._validate:
+                    problems = validate_feed_batch(batch,
+                                                   self._signature)
+                    if problems:
+                        self.quarantined += 1
+                        self._emit("feed_quarantined",
+                                   produced=produced,
+                                   quarantined=self.quarantined,
+                                   problems=problems)
+                        continue
+                    if self._signature is None:
+                        self._signature = feed_signature(batch)
                 # device_put is async: the transfer starts now and
                 # overlaps the consumer's current step
                 # (buffered_reader.cc's pinned-mem copy)
@@ -106,7 +247,7 @@ class DeviceFeeder:
                           for n, v in batch.items()}
                 if not self._put(q, placed):
                     return
-            self._put(q, _STOP)
+                produced += 1
         except BaseException as e:  # surfaced on the consumer side
             self._put(q, _ReaderError(e))
 
@@ -115,6 +256,28 @@ class DeviceFeeder:
         if self._queue is None:
             self.start()
         return self
+
+    def _get(self):
+        """Queue pop with the producer-stall watchdog: waiting past
+        stall_timeout_s emits a loud `feeder_stall` (queue depth +
+        cumulative wait attached) and keeps waiting — the starved
+        consumer is diagnosable without killing the pass."""
+        if not self._stall_timeout_s:
+            return self._queue.get()
+        waited = 0.0
+        while True:
+            try:
+                return self._queue.get(timeout=self._stall_timeout_s)
+            except queue.Empty:
+                waited += self._stall_timeout_s
+                self.stalls += 1
+                self._emit("feeder_stall",
+                           queue_depth=self._queue.qsize(),
+                           capacity=self._capacity,
+                           waited_s=round(waited, 3),
+                           producer_alive=(
+                               self._thread is not None
+                               and self._thread.is_alive()))
 
     def __next__(self) -> Dict[str, np.ndarray]:
         if self._queue is None:
@@ -126,14 +289,14 @@ class DeviceFeeder:
             # FLAGS_reader_queue_speed_test_mode): serve the first batch
             # forever so consumer-side throughput excludes producer cost
             if not hasattr(self, "_speed_test_batch"):
-                self._speed_test_batch = self._queue.get()
+                self._speed_test_batch = self._get()
             if self._speed_test_batch is _STOP or isinstance(
                     self._speed_test_batch, _ReaderError):
                 item = self._speed_test_batch
             else:
                 return self._speed_test_batch
         else:
-            item = self._queue.get()
+            item = self._get()
         if item is _STOP:
             self._queue = None
             self._thread = None

@@ -65,7 +65,8 @@ Three pillars (docs/OBSERVE.md):
 
 8. GOODPUT — `goodput.py` accounts every second of a training run's
    WALL clock into exclusive categories (step / replay / compile /
-   data_stall / checkpoint / barrier_wait / idle, Σ == wall):
+   data_stall / checkpoint / recovery / barrier_wait / idle,
+   Σ == wall):
    host-monotonic timestamps at phase boundaries only, zero device
    dispatches, byte-identical step lowering.  `GoodputLedger.report`
    yields the goodput fraction and `effective_mfu` = headline MFU x
@@ -98,8 +99,9 @@ from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    format_cost_table, layout_byte_share, op_cost_table,
                    program_costs)
 from .events import (ALERT_EVENTS, DECODE_EVENTS,  # noqa: F401
-                     DISAGG_EVENTS, FLEET_EVENTS, FLIGHT_EVENTS,
-                     GANG_EVENTS, GOODPUT_EVENTS, NUMERICS_EVENTS,
+                     DISAGG_EVENTS, FEED_EVENTS, FLEET_EVENTS,
+                     FLIGHT_EVENTS, GANG_EVENTS, GOODPUT_EVENTS,
+                     NUMERICS_EVENTS, RECOVERY_EVENTS,
                      RESILIENCE_EVENTS, SERVING_EVENTS, BoundEventLog,
                      RunEventLog, git_sha, new_run_id, read_events,
                      register_event_kinds, set_strict_kinds)
@@ -128,7 +130,8 @@ from .registry import (MetricFamily, MetricsRegistry,  # noqa: F401
                        disagg_collector, fleet_collector,
                        gang_collector, goodput_collector,
                        memory_collector, metrics_snapshot,
-                       process_collector, runtime_collector,
+                       process_collector, recovery_collector,
+                       runtime_collector,
                        serving_stats_collector, standard_collectors,
                        telemetry_collector, tracer_collector)
 from .reqtrace import (TAIL_KEEP_MARKS, ReqTracer,  # noqa: F401

@@ -856,7 +856,10 @@ def trainer_rule_pack(*, goodput_floor: float = 0.5,
     - `train_compile_storm`: retraces/s over budget — the
       feed-signature-drift storm (runtime_stats counter rate).
     - `gang_skew`: heartbeat step lag beyond the straggler budget
-      (silent without a gang)."""
+      (silent without a gang).
+    - `train_recovery_rollbacks`: the divergence autopilot recovered
+      in-run (ticket severity — nobody was paged, which is the point;
+      silent without an autopilot)."""
     kw = {"for_duration_s": for_duration_s,
           "resolve_duration_s": resolve_duration_s}
 
@@ -912,4 +915,13 @@ def trainer_rule_pack(*, goodput_floor: float = 0.5,
             clear=gang_max_lag_steps * 0.5,
             description="a rank lags the gang beyond the straggler "
                         "budget", severity="ticket", **kw),
+        ThresholdRule(
+            "train_recovery_rollbacks",
+            MetricSelector("recovery_rollbacks_total"),
+            op=">", threshold=0.0,
+            description="the divergence autopilot rolled back to a "
+                        "verified-good checkpoint (recovered in-run; "
+                        "see recovery_rollback/data_quarantine "
+                        "events for the window)",
+            severity="ticket", **kw),
     ]

@@ -116,6 +116,33 @@ RESILIENCE_EVENTS = (
     "ckpt_emergency",       # the drain path's final checkpoint landed
 )
 
+# divergence-autopilot event kinds (docs/RESILIENCE.md §autopilot):
+# the anomaly-triggered rollback-and-replay loop contrib.Trainer runs
+# when built with autopilot= (resilience/autopilot.py)
+RECOVERY_EVENTS = (
+    "recovery_rollback",  # LOUD: in-process rollback to the newest
+    #                       verified-good serial (trigger signal,
+    #                       from/to cursor, budget state attached)
+    "data_quarantine",    # the poisoned batch window the replay will
+    #                       fast-forward past (never re-trained)
+    "recovery_halt",      # LOUD: rollback budget exhausted (or no
+    #                       verified-good serial) — train() raises
+    #                       TrainingDivergedError after this record
+)
+
+# input-pipeline resilience event kinds (data/pipeline.py DeviceFeeder
+# hardening + Trainer(validate_feed=True) admission checks)
+FEED_EVENTS = (
+    "feeder_retry",       # transient producer error: bounded
+    #                       backoff retry (attempt, produced count)
+    "feeder_stall",       # LOUD: the producer starved the queue past
+    #                       stall_timeout_s — queue depth attached,
+    #                       instead of the loop blocking silently
+    "feed_quarantined",   # admission rejected a poisoned batch
+    #                       (non-finite / signature drift) before any
+    #                       device_put was spent on it
+)
+
 # gang fault-tolerance event kinds (docs/RESILIENCE.md, distributed
 # failure model): health-plane detections, the dispatch watchdog's
 # pre-abort record, straggler telemetry, and the supervisor lifecycle
@@ -191,11 +218,13 @@ NUMERICS_EVENTS = (
 # ---------------------------------------------------------------------------
 
 _VALIDATED_PREFIXES = ("serving_", "fleet_", "gang_", "alert_",
-                       "flight_", "autoscale_")
+                       "flight_", "autoscale_", "recovery_",
+                       "feeder_", "feed_")
 _KNOWN_KINDS = set(SERVING_EVENTS) | set(DECODE_EVENTS) \
     | set(FLEET_EVENTS) | set(GANG_EVENTS) | set(RESILIENCE_EVENTS) \
     | set(NUMERICS_EVENTS) | set(GOODPUT_EVENTS) | set(ALERT_EVENTS) \
-    | set(FLIGHT_EVENTS) | set(DISAGG_EVENTS)
+    | set(FLIGHT_EVENTS) | set(DISAGG_EVENTS) | set(RECOVERY_EVENTS) \
+    | set(FEED_EVENTS)
 _strict_kinds = [False]
 _warned_kinds: set = set()
 

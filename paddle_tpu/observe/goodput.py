@@ -23,6 +23,10 @@ clock into EXCLUSIVE categories:
 | data_stall   | reader `next()` blocking (input pipeline)           |
 | checkpoint   | save time the step loop actually waited out         |
 |              | (snapshot + any wait-for-previous + sync writes)    |
+| recovery     | divergence-autopilot work: in-process rollback      |
+|              | restores, the reader catch-up after a rollback, and |
+|              | quarantined-window fast-forward (resilience/        |
+|              | autopilot.py — badput a human never had to spend)   |
 | barrier_wait | gang waits: end-of-run done-rendezvous, health       |
 |              | checks at step boundaries                           |
 | idle         | residual host time (event handlers, logging, loop   |
@@ -76,7 +80,7 @@ def _compile_wall(delta: Dict[str, float]) -> float:
 # exclusive wall-clock categories; "idle" is the computed residual and
 # "compile" is re-attributed out of whichever phase it interrupted
 CATEGORIES = ("step", "replay", "compile", "data_stall", "checkpoint",
-              "barrier_wait", "idle")
+              "recovery", "barrier_wait", "idle")
 # categories a phase() may claim explicitly (everything but the
 # residual; "compile" phases are legal for callers that KNOW a region
 # is compile, e.g. an explicit warmup — normally it is auto-derived)

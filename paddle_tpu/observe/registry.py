@@ -635,6 +635,52 @@ def goodput_collector(fetch: Callable[[], Any], **labels: Any
     return collect
 
 
+def recovery_collector(fetch: Callable[[], Any], **labels: Any
+                       ) -> Callable[[], List[MetricFamily]]:
+    """Divergence-autopilot adapter: `fetch` returns the
+    RecoveryController.snapshot() dict (or None when no autopilot is
+    attached) — contrib.Trainer registers it as the "recovery" source.
+    The rollback counter is what trainer_rule_pack's
+    `train_recovery_rollbacks` rule watches."""
+
+    def collect() -> List[MetricFamily]:
+        snap = fetch()
+        if snap is None:
+            return [gauge("recovery_autopilot_enabled",
+                          "1 when a divergence autopilot is attached",
+                          0, **labels)]
+        return [
+            gauge("recovery_autopilot_enabled",
+                  "1 when a divergence autopilot is attached", 1,
+                  **labels),
+            counter("recovery_rollbacks_total",
+                    "in-process rollbacks to a verified-good serial",
+                    snap["rollbacks"], **labels),
+            gauge("recovery_rollback_budget",
+                  "max rollbacks before the run halts",
+                  snap["budget"], **labels),
+            gauge("recovery_halted",
+                  "1 after a TrainingDivergedError halt",
+                  snap["halted"], **labels),
+            gauge("recovery_skip_streak",
+                  "consecutive poisoned steps in the current streak",
+                  snap["skip_streak"], **labels),
+            counter("recovery_quarantined_batches_total",
+                    "batches quarantined (rollback windows + "
+                    "admission rejects)",
+                    snap["quarantined_batches"], **labels),
+            counter("recovery_quarantine_windows_total",
+                    "quarantined data windows recorded",
+                    snap["quarantine_windows"], **labels),
+            gauge("recovery_verified_serials",
+                  "verified-good checkpoint serials available as "
+                  "rollback anchors",
+                  snap["verified_serials"], **labels),
+        ]
+
+    return collect
+
+
 def process_collector() -> Callable[[], List[MetricFamily]]:
     """Process-level basics (stdlib only)."""
 

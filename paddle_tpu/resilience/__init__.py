@@ -33,6 +33,12 @@ failures): this subsystem survives them (docs/RESILIENCE.md):
   period, and relaunches with a restart budget + deterministic
   backoff, resuming from the newest valid checkpoint
   (`tools/launch_gang.py` is the CLI),
+- `autopilot`: the divergence autopilot — `RecoveryController` drives
+  contrib.Trainer through a bounded escalation ladder (absorb via the
+  guard → in-process rollback to the newest verified-good checkpoint →
+  quarantine of the poisoned data window → structured
+  `TrainingDivergedError` halt with a FlightRecorder bundle once the
+  rollback budget is spent),
 - `chaos`: deterministic fault injectors (failpoints, delaypoints, NaN
   batches, shard corruption, torn checkpoints, executor failure
   bursts, env-armed per-rank kill/hang for gang workers, in-process
@@ -41,10 +47,13 @@ failures): this subsystem survives them (docs/RESILIENCE.md):
   above.
 """
 
+from . import autopilot  # noqa: F401
 from . import chaos  # noqa: F401
 from . import health  # noqa: F401
 from . import preempt  # noqa: F401
 from . import supervisor  # noqa: F401
+from .autopilot import (AutopilotConfig,  # noqa: F401
+                        RecoveryController)
 from .chaos import (ChaosKilled, FakeKv, FlakyPredictor,  # noqa: F401
                     corrupt_file, corrupt_shard, delay_replica,
                     hang_rank, kill_rank, kill_replica, nan_reader,
@@ -57,7 +66,8 @@ from .errors import (CheckpointBarrierPoisonedError,  # noqa: F401
                      CheckpointWriteError, GangError, GangFailedError,
                      GangPoisonedError, PeerLostError, PeerStalledError,
                      ResilienceError, RetriesExhaustedError,
-                     StepHangError, TrainingPreempted, WatchdogTimeout)
+                     StepHangError, TrainingDivergedError,
+                     TrainingPreempted, WatchdogTimeout)
 from .guard import (LossScaleConfig, UpdateGuardConfig,  # noqa: F401
                     enable_update_guard, guard_config)
 from .health import (PEER_LOST_EXIT_CODE, HealthConfig,  # noqa: F401

@@ -134,6 +134,24 @@ class TrainingPreempted(ResilienceError):
 
 
 # ---------------------------------------------------------------------------
+# Divergence autopilot (resilience/autopilot.py, contrib.Trainer)
+# ---------------------------------------------------------------------------
+
+class TrainingDivergedError(ResilienceError):
+    """The divergence autopilot halted training deliberately: its
+    rollback budget is exhausted (or no verified-good checkpoint
+    existed to roll back to), so continuing would only skip updates
+    forever.  `details` carries the full provenance a post-mortem
+    needs without re-running anything: the `trigger` (signal name,
+    skip streak / z-score, the latched first_nonfinite_op), the
+    rollback count vs `budget`, every quarantined data window, and
+    `flight_bundle` — the FlightRecorder bundle path when a recorder
+    was attached (None otherwise)."""
+
+    kind = "training_diverged"
+
+
+# ---------------------------------------------------------------------------
 # Watchdog / retry (resilience/watchdog.py)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,498 @@
+"""Divergence autopilot (ISSUE 19): anomaly-triggered in-run
+rollback-and-replay with data quarantine, proven by chaos injection —
+
+- THE correctness gate: a run poisoned mid-stream (chaos.nan_reader)
+  rolls back to the newest verified-good serial, quarantines the
+  poisoned data window, and converges to BIT-IDENTICAL parameters vs
+  a control run that never saw the quarantined batches,
+- the escalation ladder holds its order: absorb (below the streak,
+  zero rollbacks) → rollback+quarantine events → halt with a
+  structured TrainingDivergedError + FlightRecorder bundle once the
+  budget is spent,
+- checkpoint rotation pins the newest verified-good serial (blind
+  oldest-first deletion would evict the only sane rollback anchor
+  while keeping N newer poisoned serials), and resume falls back to
+  it over torn/corrupt newer serials,
+- the autopilot is PURE HOST: step lowering is byte-identical with it
+  on or off,
+- DeviceFeeder hardening: bounded retry-with-backoff over transient
+  producer errors, retry exhaustion surfacing the original error, the
+  producer-stall watchdog, and validate= admission quarantine.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe, resilience
+from paddle_tpu.contrib import CheckpointConfig, Trainer
+from paddle_tpu.data.pipeline import DeviceFeeder
+from paddle_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clear_failpoints():
+    yield
+    chaos.clear()
+
+
+def _train_func():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+
+def _opt_func():
+    return fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+
+
+def _reader(n, seed=11):
+    def read():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            yield {"x": r.rand(8, 4).astype(np.float32),
+                   "y": r.rand(8, 1).astype(np.float32)}
+    return read
+
+
+def _params(t):
+    return {v.name: np.asarray(t.scope.find_var(v.name))
+            for v in t.train_program.list_vars()
+            if v.persistable and "__" not in v.name}
+
+
+def _trainer(tmp_path, tag, autopilot=None, interval=1,
+             step_interval=2, **kw):
+    log = str(tmp_path / f"ev_{tag}.jsonl")
+    return Trainer(
+        _train_func, _opt_func,
+        checkpoint_config=CheckpointConfig(
+            str(tmp_path / f"ck_{tag}"), step_interval=step_interval),
+        telemetry=observe.TelemetryConfig(interval=interval,
+                                          log_path=log),
+        autopilot=autopilot, **kw), log
+
+
+# ---------------------------------------------------------------------------
+# THE correctness gate: rollback + quarantine == never saw the poison
+# ---------------------------------------------------------------------------
+
+def test_rollback_and_quarantine_bit_identical_params(tmp_path):
+    """12 batches, NaN poison at index 5, checkpoints every 2 steps,
+    skip_streak=1: the autopilot must roll back to serial 1 (saved at
+    step 4), quarantine positions [4, 6), replay the rest — and land
+    on params BIT-IDENTICAL to a control run whose reader simply
+    never yielded positions 4 and 5."""
+    ap = resilience.AutopilotConfig(skip_streak=1, loss_spike_z=None,
+                                    grad_norm_z=None)
+    t, log = _trainer(tmp_path, "auto", autopilot=ap)
+    resilience.enable_update_guard(t.train_program)
+    t.train(num_epochs=1,
+            reader=chaos.nan_reader(_reader(12), at_step=5,
+                                    names=["y"]))
+    got = _params(t)
+
+    def control_read():
+        for i, b in enumerate(_reader(12)()):
+            if i not in (4, 5):
+                yield b
+
+    ctl, _ = _trainer(tmp_path, "ctl")
+    resilience.enable_update_guard(ctl.train_program)
+    ctl.train(num_epochs=1, reader=lambda: control_read())
+    want = _params(ctl)
+
+    assert got and set(got) == set(want)
+    for name in got:
+        assert np.isfinite(got[name]).all(), name
+        np.testing.assert_array_equal(got[name], want[name],
+                                      err_msg=name)
+
+    # controller state: one rollback, one recorded window, 2 batches
+    snap = t.autopilot.snapshot()
+    assert snap["rollbacks"] == 1
+    assert snap["halted"] == 0
+    assert snap["quarantine_windows"] == 1
+    assert snap["quarantined_batches"] == 2
+    assert t.autopilot.quarantine_windows == [
+        {"from_epoch": 0, "from_step": 4,
+         "to_epoch": 0, "to_step": 6}]
+
+    # escalation order in the event stream: the telemetry window that
+    # saw the poison precedes the rollback, which precedes quarantine
+    events = observe.read_events(log)
+    kinds = [e["event"] for e in events]
+    rb = kinds.index("recovery_rollback")
+    dq = kinds.index("data_quarantine")
+    assert rb < dq
+    assert any(k == "telemetry" for k in kinds[:rb])
+    rbe = events[rb]
+    assert rbe["serial"] == 1
+    assert rbe["trigger"]["signal"] == "skip_streak"
+    assert (rbe["from_step"], rbe["to_step"]) == (4, 6)
+    assert events[dq]["batches"] == 2
+    assert "recovery_halt" not in kinds
+
+    # pillar 8: the rollback work is attributed to its own category
+    rep = t.goodput()
+    assert rep["categories_s"]["recovery"] > 0
+    assert rep["fractions"]["recovery"] > 0
+
+    # pillar 7: the controller exports through the recovery collector
+    fams = {f.name: f for f in t.metrics_registry().collect()}
+    assert fams["recovery_rollbacks_total"].samples[0][1] == 1
+    assert fams["recovery_autopilot_enabled"].samples[0][1] == 1
+    assert fams["recovery_quarantined_batches_total"].samples[0][1] == 2
+    t.stop()
+    ctl.stop()
+
+
+def test_absorb_below_streak_zero_rollbacks(tmp_path):
+    """Rung 1: a single isolated poisoned step with skip_streak=2 is
+    absorbed by the update guard — no rollback, no quarantine, run
+    completes with finite params."""
+    ap = resilience.AutopilotConfig(skip_streak=2, loss_spike_z=None,
+                                    grad_norm_z=None)
+    t, log = _trainer(tmp_path, "absorb", autopilot=ap)
+    resilience.enable_update_guard(t.train_program)
+    t.train(num_epochs=1,
+            reader=chaos.nan_reader(_reader(6), at_step=2,
+                                    names=["y"]))
+    assert t.autopilot.rollbacks == 0
+    assert t.autopilot.quarantine_windows == []
+    assert t.autopilot.skip_streak == 0  # the clean window reset it
+    kinds = [e["event"] for e in observe.read_events(log)]
+    assert "recovery_rollback" not in kinds
+    assert "recovery_halt" not in kinds
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+    t.stop()
+
+
+def test_budget_zero_halts_with_structured_error_and_bundle(tmp_path):
+    """Rung 4: max_rollbacks=0 means the first trigger halts — a
+    TrainingDivergedError with full provenance, a recovery_halt event,
+    and a FlightRecorder bundle on disk."""
+    ap = resilience.AutopilotConfig(skip_streak=1, max_rollbacks=0,
+                                    loss_spike_z=None, grad_norm_z=None)
+    t, log = _trainer(tmp_path, "halt", autopilot=ap)
+    resilience.enable_update_guard(t.train_program)
+    t.enable_alerts(rules=[], start=False,
+                    flight_dir=str(tmp_path / "flight"))
+    with pytest.raises(resilience.TrainingDivergedError) as ei:
+        t.train(num_epochs=1,
+                reader=chaos.nan_reader(_reader(6), at_step=1,
+                                        names=["y"]))
+    err = ei.value
+    assert err.kind == "training_diverged"
+    d = err.as_dict()
+    assert d["reason"] == "rollback_budget_exhausted"
+    assert d["rollbacks"] == 0 and d["budget"] == 0
+    assert d["trigger"]["signal"] == "skip_streak"
+    assert d["flight_bundle"] and os.path.isdir(d["flight_bundle"])
+    with open(os.path.join(d["flight_bundle"],
+                           "MANIFEST.json")) as f:
+        man = json.load(f)
+    assert man["reason"] == "training_diverged"
+    assert json.dumps(d)  # the structured error stays serializable
+    kinds = [e["event"] for e in observe.read_events(log)]
+    assert "recovery_halt" in kinds
+    assert t.autopilot.halted
+    t.stop()
+
+
+def test_z_rule_trigger_on_finite_divergence():
+    """The finite-divergence path the guard cannot see: a loss
+    explosion (no NaN) trips the AnomalyRule z-score and returns a
+    trigger once the baseline is established."""
+    from paddle_tpu.observe.metrics import StepTelemetry
+
+    ctl = resilience.RecoveryController(resilience.AutopilotConfig(
+        skip_streak=100, loss_spike_z=4.0, grad_norm_z=None,
+        min_baseline_windows=4))
+
+    def window(loss):
+        return StepTelemetry(steps=2, loss_last=loss, loss_mean=loss,
+                             grad_norm_last=1.0, grad_norm_mean=1.0,
+                             update_norm_last=0.1, update_norm_mean=0.1,
+                             nonfinite_grad_steps=0,
+                             nonfinite_loss_steps=0)
+
+    trig = None
+    for i, loss in enumerate([1.0, 1.01, 0.99, 1.02, 1.0, 500.0]):
+        trig = ctl.observe_window(window(loss), epoch=0, step=i)
+        if loss < 100:
+            assert trig is None, (i, trig)
+    assert trig is not None
+    assert trig["signal"] == "autopilot_loss_spike"
+    assert not ctl.healthy  # firing rule gates verified-good marking
+    ctl.on_rollback({"from_epoch": 0, "from_step": 0,
+                     "to_epoch": 0, "to_step": 5})
+    assert ctl.healthy  # fresh regime: baselines rebuilt
+
+
+# ---------------------------------------------------------------------------
+# Rotation pin + resume fallback to the verified-good serial
+# ---------------------------------------------------------------------------
+
+def test_rotation_pins_newest_verified_good_serial(tmp_path):
+    """Regression for the _rotate bug: with max_num_checkpoints=2 and
+    three newer UNverified saves, blind oldest-first rotation would
+    delete serial 0 — the only verified-good anchor.  It must be
+    pinned, and a fresh Trainer must resume from it when the newer
+    serials are corrupt."""
+    ckpt_dir = str(tmp_path / "ck")
+    log = str(tmp_path / "ev.jsonl")
+    t = Trainer(_train_func, _opt_func,
+                checkpoint_config=CheckpointConfig(
+                    ckpt_dir, max_num_checkpoints=2,
+                    step_interval=100),
+                telemetry=observe.TelemetryConfig(interval=1,
+                                                  log_path=log))
+    t.train(num_epochs=1, reader=_reader(3))  # epoch-end save only
+    assert t._list_checkpoints() == [0]
+    assert t._serial_verified(0)
+
+    # poisoned regime from here: every later save is unverified
+    t._window_dirty = True
+    for serial in (1, 2, 3):
+        t._save_checkpoint(serial, 0, 99)
+        assert not t._serial_verified(serial)
+    # rotation kept the pinned verified serial + the newest, not the
+    # blind newest-2
+    assert t._list_checkpoints() == [0, 3]
+
+    # newer serials torn/corrupt → resume lands on the pinned one
+    chaos.corrupt_shard(os.path.join(ckpt_dir, "ckpt_3"))
+    t2 = Trainer(_train_func, _opt_func,
+                 checkpoint_config=CheckpointConfig(
+                     ckpt_dir, max_num_checkpoints=2,
+                     step_interval=100),
+                 telemetry=observe.TelemetryConfig(interval=1,
+                                                   log_path=log))
+    events = observe.read_events(log)
+    falls = [e for e in events if e["event"] == "ckpt_fallback"]
+    assert falls and falls[-1]["serial"] == 3
+    with open(os.path.join(ckpt_dir, "ckpt_0",
+                           "__trainer_state__.json")) as f:
+        st = json.load(f)
+    assert st["verified_good"] is True
+    assert (t2._resume_epoch, t2._resume_step_in_epoch) \
+        == (st["epoch"], st["step"])
+    t.stop()
+    t2.stop()
+
+
+def test_torn_newer_serial_is_invisible_and_pin_survives(tmp_path):
+    """tear_checkpoint on the newest serial (death between shard and
+    manifest write): it vanishes from the listing entirely; the
+    pinned verified serial remains the resume anchor."""
+    ckpt_dir = str(tmp_path / "ck")
+    t = Trainer(_train_func, _opt_func,
+                checkpoint_config=CheckpointConfig(
+                    ckpt_dir, max_num_checkpoints=2,
+                    step_interval=100),
+                telemetry=observe.TelemetryConfig(interval=1))
+    t.train(num_epochs=1, reader=_reader(3))
+    t._window_dirty = True
+    t._save_checkpoint(1, 0, 99)
+    assert t._list_checkpoints() == [0, 1]
+    chaos.tear_checkpoint(os.path.join(ckpt_dir, "ckpt_1"))
+    assert t._list_checkpoints() == [0]
+    t2 = Trainer(_train_func, _opt_func,
+                 checkpoint_config=CheckpointConfig(ckpt_dir),
+                 telemetry=observe.TelemetryConfig(interval=1))
+    assert (t2._resume_epoch, t2._resume_step_in_epoch) == (1, 0)
+    t.stop()
+    t2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead discipline
+# ---------------------------------------------------------------------------
+
+def test_autopilot_off_on_step_lowering_byte_identical(tmp_path):
+    """The controller is pure host: the jitted step's lowered text is
+    byte-identical with the autopilot attached or absent."""
+    def lowered(tag, autopilot):
+        t, _ = _trainer(tmp_path, tag, autopilot=autopilot)
+        resilience.enable_update_guard(t.train_program)
+        batch = {"x": np.zeros((8, 4), np.float32),
+                 "y": np.zeros((8, 1), np.float32)}
+        with fluid.scope_guard(t.scope):
+            fn, state, feeds = t.exe._prepare(
+                t.train_program, batch,
+                [t.train_outputs[0].name], t.scope, 1, True)
+            text = fn.lower(state, feeds).as_text()
+        t.stop()
+        return text
+
+    on = lowered("low_on", resilience.AutopilotConfig(skip_streak=1))
+    off = lowered("low_off", None)
+    assert on == off
+
+
+def test_autopilot_requires_telemetry_and_checkpoints(tmp_path):
+    with pytest.raises(ValueError, match="telemetry"):
+        Trainer(_train_func, _opt_func,
+                autopilot=resilience.AutopilotConfig())
+    with pytest.raises(ValueError, match="checkpoint_config"):
+        Trainer(_train_func, _opt_func,
+                telemetry=observe.TelemetryConfig(interval=1),
+                autopilot=resilience.AutopilotConfig())
+
+
+def test_autopilot_config_validation():
+    with pytest.raises(ValueError):
+        resilience.AutopilotConfig(skip_streak=0)
+    with pytest.raises(ValueError):
+        resilience.AutopilotConfig(max_rollbacks=-1)
+    with pytest.raises(ValueError):
+        resilience.AutopilotConfig(lr_backoff=1.5)
+
+
+# ---------------------------------------------------------------------------
+# Trainer feed validation (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_trainer_validate_feed_quarantines_poison(tmp_path):
+    """validate_feed=True: the NaN batch is rejected BEFORE device_put
+    — params stay finite with NO update guard compiled in, and the
+    quarantine ledger records the reject."""
+    log = str(tmp_path / "ev.jsonl")
+    t = Trainer(_train_func, _opt_func, validate_feed=True,
+                telemetry=observe.TelemetryConfig(interval=1,
+                                                  log_path=log))
+    t.train(num_epochs=1,
+            reader=chaos.nan_reader(_reader(4), at_step=1,
+                                    names=["y"]))
+    assert t.feed_stats["quarantined"] == 1
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+    events = observe.read_events(log)
+    fq = [e for e in events if e["event"] == "feed_quarantined"]
+    assert len(fq) == 1
+    assert fq[0]["problems"][0]["name"] == "y"
+    assert fq[0]["problems"][0]["problem"] == "nonfinite"
+    t.stop()
+
+
+def test_validate_feed_batch_signature_drift():
+    from paddle_tpu.data.pipeline import (feed_signature,
+                                          validate_feed_batch)
+
+    good = {"x": np.zeros((4, 2), np.float32)}
+    sig = feed_signature(good)
+    assert validate_feed_batch(good, sig) == []
+    drift = validate_feed_batch(
+        {"x": np.zeros((4, 2, 1), np.float32)}, sig)
+    assert drift[0]["problem"] == "signature_drift"
+    unknown = validate_feed_batch(
+        {"x": np.zeros((4, 2), np.float32),
+         "z": np.zeros((4,), np.float32)}, sig)
+    assert {p["problem"] for p in unknown} == {"unknown_feed"}
+    missing = validate_feed_batch({}, sig)
+    assert missing == [{"name": "x", "problem": "missing_feed"}]
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeeder hardening (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _feed_batches(n):
+    r = np.random.RandomState(5)
+    return [{"x": r.rand(4, 2).astype(np.float32)} for _ in range(n)]
+
+
+def test_feeder_retries_transient_producer_error(tmp_path):
+    log = observe.RunEventLog(str(tmp_path / "ev.jsonl"))
+    batches = _feed_batches(4)
+    chaos.arm("feeder:producer", times=2)
+    f = DeviceFeeder(lambda: batches, retryable=(chaos.ChaosKilled,),
+                     max_retries=3, backoff_s=0.001, event_log=log)
+    got = list(f)
+    assert len(got) == 4
+    assert f.retries == 2
+    for want, have in zip(batches, got):
+        np.testing.assert_array_equal(np.asarray(have["x"]),
+                                      want["x"])
+    log.close()
+    kinds = [e["event"] for e in
+             observe.read_events(str(tmp_path / "ev.jsonl"))]
+    assert kinds.count("feeder_retry") == 2
+
+
+def test_feeder_retry_exhaustion_surfaces_original_error():
+    batches = _feed_batches(3)
+    chaos.arm("feeder:producer", times=10)
+    f = DeviceFeeder(lambda: batches, retryable=(chaos.ChaosKilled,),
+                     max_retries=2, backoff_s=0.001)
+    with pytest.raises(chaos.ChaosKilled):
+        list(f)
+    assert f.retries == 2  # bounded: gave up after max_retries
+
+
+def test_feeder_nonretryable_error_still_propagates():
+    """The pre-hardening contract holds: an error class NOT in
+    retryable (ValueError is not in DEFAULT_RETRYABLE) kills the pass
+    immediately, no retry."""
+    def bad_reader():
+        yield {"x": np.zeros((2, 2), np.float32)}
+        raise ValueError("boom")
+
+    f = DeviceFeeder(lambda: bad_reader(), max_retries=5)
+    with pytest.raises(ValueError, match="boom"):
+        list(f)
+    assert f.retries == 0
+
+
+def test_feeder_reopen_fast_forwards_produced(tmp_path):
+    batches = _feed_batches(5)
+    f = DeviceFeeder(lambda: batches)
+    it = f._reopen(3)
+    np.testing.assert_array_equal(np.asarray(next(it)["x"]),
+                                  batches[3]["x"])
+
+
+def test_feeder_stall_watchdog_emits_and_recovers(tmp_path):
+    log = observe.RunEventLog(str(tmp_path / "ev.jsonl"))
+    batches = _feed_batches(3)
+    chaos.arm_delay("feeder:producer", 0.4, times=1)
+    f = DeviceFeeder(lambda: batches, stall_timeout_s=0.05,
+                     event_log=log)
+    got = list(f)  # the stalled pass still completes
+    assert len(got) == 3
+    assert f.stalls >= 1
+    log.close()
+    stalls = [e for e in
+              observe.read_events(str(tmp_path / "ev.jsonl"))
+              if e["event"] == "feeder_stall"]
+    assert stalls
+    assert stalls[0]["capacity"] == 2
+    assert "queue_depth" in stalls[0]
+    assert stalls[0]["producer_alive"] in (True, False)
+
+
+def test_feeder_validate_quarantines_bad_batches(tmp_path):
+    log = observe.RunEventLog(str(tmp_path / "ev.jsonl"))
+    batches = _feed_batches(4)
+    poisoned = {"x": batches[1]["x"].copy()}
+    poisoned["x"][0, 0] = np.nan
+    drifted = {"x": batches[2]["x"].astype(np.float64)}
+    stream = [batches[0], poisoned, drifted, batches[3]]
+    f = DeviceFeeder(lambda: stream, validate=True, event_log=log)
+    got = list(f)
+    assert len(got) == 2
+    assert f.quarantined == 2
+    np.testing.assert_array_equal(np.asarray(got[0]["x"]),
+                                  batches[0]["x"])
+    np.testing.assert_array_equal(np.asarray(got[1]["x"]),
+                                  batches[3]["x"])
+    log.close()
+    fq = [e for e in observe.read_events(str(tmp_path / "ev.jsonl"))
+          if e["event"] == "feed_quarantined"]
+    assert len(fq) == 2
+    assert fq[0]["problems"][0]["problem"] == "nonfinite"
+    assert fq[1]["problems"][0]["problem"] == "signature_drift"

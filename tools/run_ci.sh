@@ -894,6 +894,102 @@ print("numerics provenance smoke OK:",
        "groups": sorted(tel.groups)})
 EOF
 
+echo "== divergence autopilot chaos smoke (cpu) =="
+# ISSUE 19 tentpole (docs/RESILIENCE.md §autopilot): a NaN window
+# injected mid-run must recover with ZERO human action — in-process
+# rollback to the newest verified-good serial, quarantine of the
+# poisoned data window (recovery_rollback + data_quarantine events),
+# wall clock attributed to the goodput `recovery` category, and final
+# params BIT-IDENTICAL to a control run that never saw the
+# quarantined batches.
+python - <<'EOF'
+import os, tempfile
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe, resilience
+from paddle_tpu.contrib import CheckpointConfig, Trainer
+from paddle_tpu.resilience import chaos, enable_update_guard
+
+d = tempfile.mkdtemp()
+
+def train_func():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+def opt_func():
+    return fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+
+def reader():
+    r = np.random.RandomState(11)
+    for _ in range(12):
+        yield {"x": r.rand(8, 4).astype(np.float32),
+               "y": r.rand(8, 1).astype(np.float32)}
+
+log = os.path.join(d, "auto.jsonl")
+t = Trainer(train_func, opt_func,
+            checkpoint_config=CheckpointConfig(os.path.join(d, "ck"),
+                                               step_interval=2),
+            telemetry=observe.TelemetryConfig(interval=1,
+                                              log_path=log),
+            autopilot=resilience.AutopilotConfig(
+                skip_streak=1, loss_spike_z=None, grad_norm_z=None))
+enable_update_guard(t.train_program)
+# poison position 5 mid-stream: NO human action from here on
+t.train(num_epochs=1,
+        reader=chaos.nan_reader(reader, at_step=5, names=["y"]))
+snap = t.autopilot.snapshot()
+assert snap["rollbacks"] == 1 and snap["halted"] == 0, snap
+assert snap["quarantined_batches"] == 2, snap
+
+events = observe.read_events(log)
+kinds = [e["event"] for e in events]
+rb = kinds.index("recovery_rollback")   # raises if absent
+dq = kinds.index("data_quarantine")
+assert rb < dq and "recovery_halt" not in kinds, kinds
+rbe = events[rb]
+assert (rbe["from_step"], rbe["to_step"]) == (4, 6), rbe
+
+rep = t.goodput()
+assert rep["categories_s"]["recovery"] > 0, rep["categories_s"]
+
+params = {v.name: np.asarray(t.scope.find_var(v.name))
+          for v in t.train_program.list_vars()
+          if v.persistable and "__" not in v.name}
+
+# control: the same stream minus the quarantined positions [4, 6)
+def control_reader():
+    for i, b in enumerate(reader()):
+        if i not in (4, 5):
+            yield b
+
+ctl = Trainer(train_func, opt_func,
+              checkpoint_config=CheckpointConfig(
+                  os.path.join(d, "ck_ctl"), step_interval=2),
+              telemetry=observe.TelemetryConfig(interval=1))
+enable_update_guard(ctl.train_program)
+ctl.train(num_epochs=1, reader=lambda: control_reader())
+want = {v.name: np.asarray(ctl.scope.find_var(v.name))
+        for v in ctl.train_program.list_vars()
+        if v.persistable and "__" not in v.name}
+assert params and set(params) == set(want)
+for name in params:
+    assert np.isfinite(params[name]).all(), name
+    np.testing.assert_array_equal(params[name], want[name],
+                                  err_msg=name)
+t.stop(); ctl.stop()
+print("autopilot chaos smoke OK:",
+      {"rollbacks": snap["rollbacks"],
+       "quarantined": snap["quarantined_batches"],
+       "window": (rbe["from_step"], rbe["to_step"]),
+       "recovery_s": rep["categories_s"]["recovery"],
+       "bit_identical_params": sorted(params)})
+EOF
+
 echo "== goodput ledger smoke (cpu) =="
 # ISSUE 16 tentpole (docs/OBSERVE.md pillar 8): a short Trainer run with
 # a deliberately slow reader + periodic checkpoint saves must yield a
