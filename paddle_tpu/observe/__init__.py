@@ -93,7 +93,7 @@ from .alerts import (AlertEngine, AlertRule, AnomalyRule,  # noqa: F401
                      BurnRateRule, MetricSelector, ThresholdRule,
                      disagg_rule_pack, fleet_rule_pack,
                      serving_rule_pack, snapshot_value,
-                     trainer_rule_pack)
+                     speculate_rule_pack, trainer_rule_pack)
 from .cost import (bucket_summary, copyish_instructions,  # noqa: F401
                    device_peaks, flash_boundary_layout,
                    format_cost_table, layout_byte_share, op_cost_table,
@@ -102,7 +102,8 @@ from .events import (ALERT_EVENTS, DECODE_EVENTS,  # noqa: F401
                      DISAGG_EVENTS, FEED_EVENTS, FLEET_EVENTS,
                      FLIGHT_EVENTS, GANG_EVENTS, GOODPUT_EVENTS,
                      NUMERICS_EVENTS, RECOVERY_EVENTS,
-                     RESILIENCE_EVENTS, SERVING_EVENTS, BoundEventLog,
+                     RESILIENCE_EVENTS, SERVING_EVENTS,
+                     SPECULATE_EVENTS, BoundEventLog,
                      RunEventLog, git_sha, new_run_id, read_events,
                      register_event_kinds, set_strict_kinds)
 from .flightrec import FlightRecorder  # noqa: F401

@@ -835,6 +835,51 @@ def disagg_rule_pack(fleet=None, *,
     ]
 
 
+def speculate_rule_pack(*, min_accept_rate: float = 0.3,
+                        min_efficiency: float = 0.0,
+                        for_duration_s: float = 0.0,
+                        resolve_duration_s: float = 0.0
+                        ) -> List[AlertRule]:
+    """Speculative-decoding health pack (docs/SERVING.md §speculate).
+
+    - `serving_speculation_accept_low`: the cumulative accept rate
+      dropped under `min_accept_rate`.  Below that floor the verify
+      rows mostly score rejected drafts — the engine is paying the
+      folded-batch cost of speculation without the multi-token wins,
+      and a sequential engine (or a better drafter / smaller k) would
+      serve the same stream faster.  Severity ticket: it is a
+      throughput regression, not an outage.
+    - `serving_speculation_efficiency_low` (opt-in via
+      `min_efficiency` > 0): committed tokens over verify rows paid —
+      the same signal normalized per row, useful when comparing
+      different k settings across replicas.
+
+    Rules stay silent ("no data") until the engine has scored drafts,
+    so installing the pack on a non-speculative fleet is harmless.
+    """
+    kw = {"for_duration_s": for_duration_s,
+          "resolve_duration_s": resolve_duration_s}
+    rules = [
+        ThresholdRule(
+            "serving_speculation_accept_low",
+            MetricSelector("serving_speculation_accept_rate"),
+            op="<", threshold=min_accept_rate,
+            clear=min_accept_rate * 1.2, severity="ticket",
+            description="speculative accept rate under floor (drafts "
+                        "mostly rejected — speculation is costing "
+                        "throughput instead of buying it)", **kw),
+    ]
+    if min_efficiency > 0.0:
+        rules.append(ThresholdRule(
+            "serving_speculation_efficiency_low",
+            MetricSelector("serving_speculation_efficiency"),
+            op="<", threshold=min_efficiency,
+            clear=min_efficiency * 1.2, severity="ticket",
+            description="committed tokens per verify row under floor",
+            **kw))
+    return rules
+
+
 def trainer_rule_pack(*, goodput_floor: float = 0.5,
                       loss_spike_z: float = 6.0,
                       grad_norm_z: float = 6.0,

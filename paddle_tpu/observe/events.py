@@ -53,6 +53,17 @@ DECODE_EVENTS = (
     "serving_decode_reload",       # hot weight swap applied
 )
 
+# speculative-decoding event kinds (docs/SERVING.md §speculate): the
+# multi-token verified-step path DecodeEngine(speculate_k=k) runs
+SPECULATE_EVENTS = (
+    "serving_decode_speculate",   # drafter armed at start(): k +
+    #                               drafter class (the one-line record
+    #                               that says THIS replica speculates)
+    "serving_speculate_window",   # periodic speculation snapshot:
+    #                               accept_rate, accept_hist,
+    #                               speculation_efficiency
+)
+
 # serving-fleet event kinds (docs/SERVING.md §fleet): the router layer
 # fronting N engine replicas.  Every record carries replica_id where
 # one replica is the subject (engines stamp their own events with it
@@ -224,7 +235,7 @@ _KNOWN_KINDS = set(SERVING_EVENTS) | set(DECODE_EVENTS) \
     | set(FLEET_EVENTS) | set(GANG_EVENTS) | set(RESILIENCE_EVENTS) \
     | set(NUMERICS_EVENTS) | set(GOODPUT_EVENTS) | set(ALERT_EVENTS) \
     | set(FLIGHT_EVENTS) | set(DISAGG_EVENTS) | set(RECOVERY_EVENTS) \
-    | set(FEED_EVENTS)
+    | set(FEED_EVENTS) | set(SPECULATE_EVENTS)
 _strict_kinds = [False]
 _warned_kinds: set = set()
 

@@ -281,6 +281,36 @@ def serving_stats_collector(stats, **labels: Any
                 fams.append(counter(f"serving_{key}_total",
                                     f"serving stats counter {key}",
                                     val, **labels))
+        # the generic loop skips dict values, so the speculation
+        # section (DecodeStats with speculate_k set) gets explicit
+        # families — the accept-rate gauge is what speculate_rule_pack
+        # alerts on
+        spec = snap.get("speculation")
+        if isinstance(spec, dict):
+            fams.append(gauge("serving_speculation_k",
+                              "configured speculative draft length",
+                              spec["speculate_k"], **labels))
+            for key in ("verify_dispatches", "drafted_tokens",
+                        "accepted_tokens", "emitted_tokens"):
+                fams.append(counter(
+                    f"serving_speculation_{key}_total",
+                    f"speculative decoding counter {key}",
+                    spec[key], **labels))
+            if spec["accept_rate"] is not None:
+                fams.append(gauge(
+                    "serving_speculation_accept_rate",
+                    "accepted drafts over drafts scored",
+                    spec["accept_rate"], **labels))
+            if spec["speculation_efficiency"] is not None:
+                fams.append(gauge(
+                    "serving_speculation_efficiency",
+                    "tokens committed over verify rows paid",
+                    spec["speculation_efficiency"], **labels))
+            hist = counter("serving_speculation_accept_hist_total",
+                           "slot-verify rounds by accepted count")
+            for a, n in enumerate(spec["accept_hist"]):
+                hist.add(n, accepted=str(a), **labels)
+            fams.append(hist)
         for attr in _STATS_HIST_ATTRS:
             h = getattr(obj, attr, None)
             if isinstance(h, LatencyHistogram):
@@ -400,10 +430,32 @@ def disagg_collector(dfleet) -> Callable[[], List[MetricFamily]]:
                               "prefill workers' merged TTFT (queue "
                               "wait + prefill dispatch)",
                               dfleet.merged_stats("prefill").ttft_ms))
+        dec = dfleet.merged_stats("decode")
         fams.append(histogram("disagg_decode_tpot_ms",
                               "decode workers' merged time per output "
-                              "token",
-                              dfleet.merged_stats("decode").tpot_ms))
+                              "token", dec.tpot_ms))
+        spec = dec.snapshot().get("speculation")
+        if isinstance(spec, dict):
+            # decode phase speculates; mirror the per-engine families
+            # under the disagg_ prefix so one dashboard covers both
+            fams.append(gauge("disagg_speculation_k",
+                              "configured speculative draft length",
+                              spec["speculate_k"], phase="decode"))
+            for key in ("verify_dispatches", "drafted_tokens",
+                        "accepted_tokens", "emitted_tokens"):
+                fams.append(counter(
+                    f"disagg_speculation_{key}_total",
+                    f"speculative decoding counter {key}",
+                    spec[key], phase="decode"))
+            if spec["accept_rate"] is not None:
+                fams.append(gauge("disagg_speculation_accept_rate",
+                                  "accepted drafts over drafts scored",
+                                  spec["accept_rate"], phase="decode"))
+            if spec["speculation_efficiency"] is not None:
+                fams.append(gauge(
+                    "disagg_speculation_efficiency",
+                    "tokens committed over verify rows paid",
+                    spec["speculation_efficiency"], phase="decode"))
         return fams
 
     return collect

@@ -264,6 +264,53 @@ def paged_attention(ctx, ins, attrs):
                                         float(scale), ks=ks, vs=vs))
 
 
+@register_op("speculative_accept")
+def speculative_accept(ctx, ins, attrs):
+    """Greedy longest-accepted-prefix acceptance for speculative decode.
+
+    The verify program scores k drafted tokens per slot in one forward
+    (the step body at folded batch S*(k+1), staggered lengths); its
+    argmax Predictions (S, k+1) are what the SEQUENTIAL engine would
+    have produced at positions L..L+k given the drafted prefix.  A
+    draft token is accepted iff every earlier draft matched — so the
+    committed stream is bit-identical to the sequential engine:
+
+      match_i   = (Drafts[:, i-1] == Predictions[:, i-1]) & (i <= DraftLen)
+      Accepted  = sum(cumprod(match))          # in 0..k, -1 if inactive
+      Tokens[j] = Predictions[j] if j <= Accepted else -1
+
+    Predictions[a] is the model's own next token after the accepted
+    prefix, so every verify emits Accepted+1 tokens (>= 1): the engine
+    never stalls even at accept rate 0.  Inputs: Drafts (S, k) int,
+    Predictions (S, k+1) int, DraftLen (S,) int32 (ragged drafts ride
+    this companion — no recompiles), optional Active (S,).  Outputs:
+    Accepted (S,) int32, Tokens (S, k+1) int32 (-1 padding)."""
+    drafts = first(ins, "Drafts").astype(jnp.int32)
+    preds = first(ins, "Predictions").astype(jnp.int32)
+    dlen = first(ins, "DraftLen").astype(jnp.int32)
+    active = opt_in(ins, "Active")
+    if preds.ndim != 2 or drafts.ndim != 2:
+        raise ValueError("speculative_accept: Drafts (S, k) and "
+                         "Predictions (S, k+1) must be rank-2")
+    s, k1 = preds.shape
+    k = k1 - 1
+    if drafts.shape != (s, k):
+        raise ValueError(
+            f"speculative_accept: Drafts {drafts.shape} must be "
+            f"(S, k) = ({s}, {k}) for Predictions {preds.shape}")
+    idx = jnp.arange(1, k + 1, dtype=jnp.int32)[None, :]      # (1, k)
+    match = (drafts == preds[:, :k]) & (idx <= dlen[:, None])
+    accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                       axis=1).astype(jnp.int32)              # (S,)
+    if active is not None:
+        accepted = jnp.where(active.astype(jnp.int32) != 0,
+                             accepted, -1).astype(jnp.int32)
+    pos = jnp.arange(k1, dtype=jnp.int32)[None, :]            # (1, k+1)
+    tokens = jnp.where(pos <= accepted[:, None], preds,
+                       -1).astype(jnp.int32)
+    return out(Accepted=accepted, Tokens=tokens)
+
+
 @register_op("add_position_encoding_at")
 def add_position_encoding_at(ctx, ins, attrs):
     """X (S, D) + sinusoid(Position[s]) — the single-token decode twin

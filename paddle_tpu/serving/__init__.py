@@ -52,4 +52,6 @@ from .disagg import (Autoscaler, DisaggFleet,  # noqa: F401
 from .fleet import (FailoverParityError, Fleet,  # noqa: F401
                     FleetClosedError, FleetConfig, FleetResponse,
                     FleetSaturatedError, FleetStats, ReplicaHandle)
+from .speculate import (Drafter, ModelDrafter,  # noqa: F401
+                        NGramDrafter, ngram_propose)
 from .stats import DecodeStats, ServingStats  # noqa: F401

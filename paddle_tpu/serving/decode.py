@@ -265,11 +265,38 @@ class DecodeEngine:
                  breaker: Union[CircuitBreaker, bool, None] = None,
                  memory_budget_bytes: Union[int, bool, None] = None,
                  donate_pools: Optional[bool] = None, tracer=None,
-                 role: str = "unified"):
+                 role: str = "unified", speculate_k: int = 0,
+                 drafter=None):
         if role not in ("unified", "prefill", "decode"):
             raise ValueError(
                 f"role must be 'unified', 'prefill' or 'decode'; "
                 f"got {role!r}")
+        # speculative decoding (ISSUE 20, serving/speculate.py): a
+        # drafter proposes up to k tokens per slot, ONE fixed-shape
+        # verify dispatch (the step program at folded batch S*(k+1))
+        # scores them all, greedy longest-accepted-prefix acceptance
+        # commits 1..k+1 tokens bit-identical to the sequential engine
+        self.speculate_k = int(speculate_k or 0)
+        if self.speculate_k < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0, got {speculate_k}")
+        if self.speculate_k and role == "prefill":
+            raise ValueError(
+                "speculate_k requires a decoding role — a "
+                "role='prefill' worker never runs decode steps; put "
+                "the drafter on the decode workers (serving/disagg.py)")
+        if drafter is not None and not self.speculate_k:
+            raise ValueError("drafter given but speculate_k is 0")
+        self.drafter = None
+        if self.speculate_k:
+            from .speculate import NGramDrafter
+
+            self.drafter = (drafter if drafter is not None
+                            else NGramDrafter(self.speculate_k))
+            if getattr(self.drafter, "k", None) != self.speculate_k:
+                raise ValueError(
+                    f"drafter.k {getattr(self.drafter, 'k', None)} != "
+                    f"speculate_k {self.speculate_k}")
         # disagg phase specialization (serving/disagg.py): a "prefill"
         # engine compiles only the bucket ladder plus a page-EXPORT
         # gather and resolves every request with a KV handoff package;
@@ -294,6 +321,8 @@ class DecodeEngine:
         self._event_log = event_log
         self.stats = DecodeStats(event_log=event_log,
                                  window=stats_window)
+        if self.speculate_k:
+            self.stats.configure_speculation(self.speculate_k)
         if breaker is None:
             breaker = CircuitBreaker(failure_threshold=5, cooldown_s=5.0)
         elif breaker is False:
@@ -322,6 +351,8 @@ class DecodeEngine:
         self._cache_names = model.cache_feed_names()
         self._pools: Optional[Dict[str, Any]] = None
         self._decode_exec = None
+        self._verify_exec = None   # speculate_k > 0: replaces the
+        #                            sequential chunk executable
         self._prefill_execs: Dict[int, Any] = {}
         self._export_exec = None   # role="prefill": page gather
         self._import_exec = None   # role="decode": page scatter
@@ -419,6 +450,48 @@ class DecodeEngine:
             return outbuf, steps, tok, wp, act, rem, pls
 
         return chunk_fn
+
+    def _build_verify_fn(self):
+        """Speculative verify: ONE dispatch of the step body at folded
+        batch S*(k+1) — row (s, j) scores position committed_s + j,
+        staggered lengths make it causal, inactive rows' KV writes
+        drop, and greedy acceptance (`speculative_accept`) runs
+        in-program.  Returns (accepted (S,), tokens (S, k+1), pools);
+        the rejected-tail 'rollback' is the host simply not advancing
+        the slot past the accepted position."""
+        import jax.numpy as jnp
+
+        from ..core.executor import interpret_program
+
+        ver = self.model.verify(self.speculate_k)
+        program = ver["main"]
+        acc_name = ver["accepted"]
+        tok_name = ver["tokens"]
+        cache_outs = ver["cache_outs"]
+        cache_names = self._cache_names
+        fetches = (acc_name, tok_name, *cache_outs)
+
+        def verify_fn(params, folded, drafts, slot_meta, page_table,
+                      pools):
+            # folded rows: [tokens, write_pos, lengths, active] at
+            # (4, S*(k+1)); slot_meta rows: [draft_len, slot_active]
+            # at (2, S).  Packing the small int feeds into two arrays
+            # keeps the per-round host->device transfer count low —
+            # the verify round races the sequential engine's chunk
+            # dispatch, so feed overhead is on the critical path.
+            env = self._feed_env(
+                params, pools, tokens=folded[0], write_pos=folded[1],
+                lengths=folded[2], active=folded[3], drafts=drafts,
+                draft_len=slot_meta[0], slot_active=slot_meta[1],
+                page_table=page_table)
+            env = interpret_program(program, env, None,
+                                    fetch_names=fetches)
+            new_pools = {n: env[o] for n, o in
+                         zip(cache_names, cache_outs)}
+            return (env[acc_name].astype(jnp.int32),
+                    env[tok_name].astype(jnp.int32), new_pools)
+
+        return verify_fn
 
     def _build_prefill_fn(self, t_bucket: int):
         import jax.numpy as jnp
@@ -525,12 +598,31 @@ class DecodeEngine:
         i32 = jax.numpy.int32
         n_exec = 0
         if self.role != "prefill":
-            donate = (6,) if self._donate else ()
-            self._decode_exec = jax.jit(
-                self._build_decode_fn(),
-                donate_argnums=donate).lower(
-                    params_spec, vec, vec, vec, vec, pt,
-                    pool_specs).compile()
+            if self.speculate_k:
+                # the verify executable REPLACES the sequential chunk
+                # loop: one fixed folded shape serves any accept
+                # pattern (ragged drafts ride the draft_len companion)
+                k1 = self.speculate_k + 1
+                fmat = jax.ShapeDtypeStruct((4, cfg.num_slots * k1),
+                                            i32)
+                fpt = jax.ShapeDtypeStruct(
+                    (cfg.num_slots * k1, cfg.max_pages_per_slot), i32)
+                dspec = jax.ShapeDtypeStruct(
+                    (cfg.num_slots, self.speculate_k), i32)
+                smeta = jax.ShapeDtypeStruct((2, cfg.num_slots), i32)
+                donate = (5,) if self._donate else ()
+                self._verify_exec = jax.jit(
+                    self._build_verify_fn(),
+                    donate_argnums=donate).lower(
+                        params_spec, fmat, dspec, smeta, fpt,
+                        pool_specs).compile()
+            else:
+                donate = (6,) if self._donate else ()
+                self._decode_exec = jax.jit(
+                    self._build_decode_fn(),
+                    donate_argnums=donate).lower(
+                        params_spec, vec, vec, vec, vec, pt,
+                        pool_specs).compile()
             n_exec += 1
         if self.role != "decode":
             for t in cfg.prefill_buckets:
@@ -563,6 +655,15 @@ class DecodeEngine:
                 donate_argnums=donate_i).lower(
                     rows_spec, row, nv, pool_specs).compile()
             n_exec += 1
+        if self.drafter is not None:
+            # drafter compiles land INSIDE the warmup window, so the
+            # zero-post-warmup-compile contract covers drafting too
+            self.drafter.start(self)
+            if self._event_log is not None:
+                self._event_log.event(
+                    "serving_decode_speculate",
+                    speculate_k=self.speculate_k,
+                    drafter=type(self.drafter).__name__)
         delta = runtime_stats.delta(snap)
         self.stats.record_warmup(n_exec,
                                  delta["compiles"],
@@ -1260,6 +1361,10 @@ class DecodeEngine:
         slot.generated = [int(t) for t in h["generated"]]
         slot.remaining = slot.req.max_new_tokens - len(slot.generated)
         self.stats.record_import()
+        if self.drafter is not None:
+            # no draft-model KV crossed the wire: re-seed the draft
+            # pool from the raw prompt (serving/speculate.py)
+            self.drafter.on_import(self, slot_id)
         if slot.remaining <= 0 or (cfg.eos_id is not None
                                    and slot.cur_tok == cfg.eos_id):
             self._resolve(slot_id)
@@ -1329,6 +1434,11 @@ class DecodeEngine:
             slot.remaining = slot.req.max_new_tokens - 1
             ttfts.append((now - slot.req.t_submit) * 1e3)
         self.stats.record_prefill(len(joiners), ttfts)
+        if self.drafter is not None:
+            # mirror the join into the draft pool (same buffers, same
+            # page tables — the pools share geometry by construction)
+            self.drafter.on_prefill(self, joiners, tokens, seq_len,
+                                    last_idx)
         if self.role == "prefill":
             # disagg: every joiner resolves NOW with its KV handoff
             # package — the slot and pages recycle immediately, so the
@@ -1406,6 +1516,11 @@ class DecodeEngine:
         preempting the least-important slots when the pool runs dry.
         Returns the slot ids still active afterwards."""
         cfg = self.config
+        # speculative rounds commit at most k+1 tokens per dispatch
+        # (positions committed..committed+k), the chunk loop at most
+        # decode_chunk — the page window follows whichever path runs
+        window = (self.speculate_k + 1) if self.speculate_k \
+            else cfg.decode_chunk
         order = sorted(
             (i for i, s in enumerate(self._slots) if s is not None),
             key=lambda i: self._slots[i].importance(), reverse=True)
@@ -1413,7 +1528,7 @@ class DecodeEngine:
             slot = self._slots[i]
             if slot is None:
                 continue  # preempted as a victim earlier in the loop
-            target = _cdiv(min(slot.committed + cfg.decode_chunk,
+            target = _cdiv(min(slot.committed + window,
                                slot.cap_tokens), cfg.page_size)
             while slot is not None and target > len(slot.pages):
                 got = self.page_pool.alloc(target - len(slot.pages))
@@ -1434,6 +1549,9 @@ class DecodeEngine:
     def _decode(self):
         import jax.numpy as jnp
 
+        if self._verify_exec is not None:
+            self._decode_speculative()
+            return
         if self._decode_exec is None:
             return  # role="prefill": every slot resolved at export
         cfg = self.config
@@ -1509,3 +1627,118 @@ class DecodeEngine:
         for i in active_ids:
             if int(new_act[i]) == 0:
                 self._resolve(i)
+
+    def _decode_speculative(self):
+        """One verify round: draft on the host, score all drafts in
+        ONE folded dispatch, commit the accepted prefix (+1 model
+        token) per slot.  Token-identical to `_decode`'s sequential
+        chunk by the greedy-acceptance argument in
+        ops/paged_kv.py `speculative_accept`; rollback of a rejected
+        tail is simply not advancing `committed` — the stale rows sit
+        past every length and are overwritten before any attention
+        reads them."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        k = self.speculate_k
+        k1 = k + 1
+        active_ids = self._ensure_decode_pages()
+        if not active_ids:
+            return
+        s = cfg.num_slots
+        proposals, prop_len = self.drafter.draft(self, active_ids)
+        folded = np.zeros((4, s * k1), np.int32)
+        tokens, write_pos, lengths, active = folded
+        slot_meta = np.zeros((2, s), np.int32)
+        draft_len, slot_active = slot_meta
+        drafts = np.zeros((s, k), np.int32)
+        pt = np.zeros((s * k1, cfg.max_pages_per_slot), np.int32)
+        ar = np.arange(k1)
+        for i in active_ids:
+            slot = self._slots[i]
+            # cap so emitted (accepted+1) never exceeds the remaining
+            # budget and the last write position stays under
+            # cap_tokens (committed + remaining == cap_tokens)
+            m = int(min(int(prop_len[i]), k, slot.remaining - 1))
+            draft_len[i] = m
+            drafts[i, :m] = proposals[i, :m]
+            slot_active[i] = 1
+            base = i * k1
+            live = ar <= m          # row 0 always live (m >= 0)
+            # dead rows pin to the slot's current position: their
+            # writes drop (active 0) and their predictions are
+            # discarded, but their feeds stay in-range
+            off = np.where(live, ar, 0)
+            tokens[base] = slot.cur_tok
+            tokens[base + 1:base + k1] = drafts[i]
+            write_pos[base:base + k1] = slot.committed + off
+            lengths[base:base + k1] = slot.committed + off + 1
+            active[base:base + k1] = live
+            pt[base:base + k1] = self._page_tables[i]
+        drafted_total = int(draft_len.sum())
+        t0 = time.perf_counter()
+        t_d0 = time.monotonic()
+        try:
+            accepted, emitted, pools = self._verify_exec(
+                self._params, jnp.asarray(folded),
+                jnp.asarray(drafts), jnp.asarray(slot_meta),
+                jnp.asarray(pt), self._pools)
+        except BaseException as e:
+            self.stats.record_executor_failure()
+            self._breaker_result(False, len(active_ids))
+            err = ExecutorFailureError(
+                f"speculative verify dispatch failed for "
+                f"{len(active_ids)} slot(s): {type(e).__name__}: {e}",
+                error_type=type(e).__name__, slots=len(active_ids))
+            t_d1 = time.monotonic()
+            for i in active_ids:
+                tr = self._slots[i].req.trace
+                if tr is not None:
+                    tr.add("dispatch", t_d0, t_d1, kind="decode",
+                           replica_id=self.replica_id, slot=i,
+                           error=type(e).__name__)
+            for i in active_ids:
+                self._resolve(i, error=err)
+            return
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        t_d1 = time.monotonic()
+        self._breaker_result(True, len(active_ids))
+        self._pools = pools
+        accepted = np.asarray(accepted)
+        emitted = np.asarray(emitted)
+        total_tokens = 0
+        accept_counts = []
+        finished = []
+        for i in active_ids:
+            slot = self._slots[i]
+            a = int(accepted[i])
+            accept_counts.append(a)
+            toks = emitted[i, :a + 1].tolist()
+            if cfg.eos_id is not None and cfg.eos_id in toks:
+                # the sequential engine stops at the FIRST eos; tokens
+                # the verify scored past it were never really emitted
+                toks = toks[:toks.index(cfg.eos_id) + 1]
+            n = len(toks)
+            slot.generated.extend(toks)
+            total_tokens += n
+            slot.committed += n
+            slot.cur_tok = toks[-1]
+            slot.remaining -= n
+            tr = slot.req.trace
+            if tr is not None:
+                tr.add("dispatch", t_d0, t_d1, kind="decode",
+                       iterations=1, replica_id=self.replica_id,
+                       slot=i)
+                tr.add("speculate", t_d0, t_d1, slot=i,
+                       drafted=int(draft_len[i]), accepted=a,
+                       emitted=n, replica_id=self.replica_id)
+            if slot.remaining <= 0 or (cfg.eos_id is not None
+                                       and cfg.eos_id in toks):
+                finished.append(i)
+        self.stats.record_decode(
+            1, len(active_ids), cfg.num_slots, total_tokens,
+            self.page_pool.in_use, cfg.num_pages, elapsed_ms)
+        self.stats.record_verify(drafted_total, total_tokens,
+                                 accept_counts)
+        for i in finished:
+            self._resolve(i)

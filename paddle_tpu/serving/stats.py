@@ -310,6 +310,15 @@ class DecodeStats:
         self._util_sum = 0.0        # allocated/pool, per dispatch
         self._util_samples = 0
         self.peak_pages_in_use = 0
+        # speculative decoding (ISSUE 20): sized by
+        # configure_speculation(k); accept_hist bin a = verify rounds
+        # in which a slot had exactly a drafts accepted (k+1 bins)
+        self.spec_k = 0
+        self.accept_hist: list = []
+        self.verify_dispatches = 0  # speculative verify dispatches
+        self.drafted_tokens = 0     # proposals scored (post-cap)
+        self.accepted_tokens = 0    # proposals accepted
+        self.spec_emitted_tokens = 0  # tokens committed by verifies
         self.warmup: Dict[str, Any] = {}
         self._rt_base: Optional[Dict[str, Any]] = None
         self._merged_compiles = 0
@@ -382,6 +391,40 @@ class DecodeStats:
         with self._lock:
             self.imports += n
 
+    def configure_speculation(self, k: int):
+        """Size the accepted-token histogram for speculate_k = k
+        (called once by the engine before any verify records)."""
+        if int(k) < 1:
+            raise ValueError(f"speculate k must be >= 1, got {k}")
+        with self._lock:
+            if self.verify_dispatches:
+                raise RuntimeError(
+                    "configure_speculation after verifies recorded")
+            self.spec_k = int(k)
+            self.accept_hist = [0] * (self.spec_k + 1)
+
+    def record_verify(self, drafted: int, emitted: int,
+                      accept_counts) -> None:
+        """One speculative verify dispatch: `drafted` proposals scored
+        (sum of post-cap draft lengths), `emitted` tokens committed,
+        and per-active-slot accepted counts (each 0..k) binned into
+        the histogram."""
+        with self._lock:
+            if not self.spec_k:
+                raise RuntimeError("record_verify before "
+                                   "configure_speculation")
+            counts = [int(a) for a in accept_counts]
+            for a in counts:  # validate BEFORE mutating: a bad record
+                if not 0 <= a <= self.spec_k:  # must not tear counters
+                    raise ValueError(
+                        f"accepted count {a} outside 0..{self.spec_k}")
+            self.verify_dispatches += 1
+            self.drafted_tokens += int(drafted)
+            self.spec_emitted_tokens += int(emitted)
+            for a in counts:
+                self.accepted_tokens += a
+                self.accept_hist[a] += 1
+
     def record_decode(self, iterations: int, active_slots: int,
                       num_slots: int, tokens: int, pages_in_use: int,
                       num_pages: int, elapsed_ms: float):
@@ -435,6 +478,10 @@ class DecodeStats:
             raise TypeError(
                 f"cannot merge {type(other).__name__} into "
                 f"{type(self).__name__} (config mismatch)")
+        if other.spec_k and self.spec_k and other.spec_k != self.spec_k:
+            raise ValueError(
+                f"cannot merge speculation histograms with different k "
+                f"({self.spec_k} vs {other.spec_k})")
         self.ttft_ms.merge(other.ttft_ms)
         self.tpot_ms.merge(other.tpot_ms)
         with other._lock:
@@ -443,10 +490,14 @@ class DecodeStats:
                 "bucket_misses", "circuit_rejects", "executor_failures",
                 "preemptions", "evacuations", "reloads", "prefills",
                 "prefill_joins", "imports", "decode_dispatches",
-                "decode_iterations", "tokens_generated", "_slot_steps",
+                "decode_iterations", "tokens_generated",
+                "verify_dispatches", "drafted_tokens", "accepted_tokens",
+                "spec_emitted_tokens", "_slot_steps",
                 "_cap_steps", "_util_sum", "_util_samples")}
             o_peak = other.peak_pages_in_use
             o_pause = other.reload_pause_ms
+            o_spec_k = other.spec_k
+            o_hist = list(other.accept_hist)
         o_compiles = other.post_warmup_compiles()
         with self._lock:
             for f, v in o.items():
@@ -455,6 +506,12 @@ class DecodeStats:
                 self.peak_pages_in_use = o_peak
             if o_pause > self.reload_pause_ms:
                 self.reload_pause_ms = o_pause
+            if o_spec_k:
+                if not self.spec_k:  # adopt a speculating replica's k
+                    self.spec_k = o_spec_k
+                    self.accept_hist = [0] * (o_spec_k + 1)
+                self.accept_hist = [a + b for a, b in
+                                    zip(self.accept_hist, o_hist)]
             self._merged_compiles += o_compiles
         return self
 
@@ -486,6 +543,26 @@ class DecodeStats:
                 if self._util_samples else None,
                 "peak_pages_in_use": self.peak_pages_in_use,
             }
+            if self.spec_k:
+                out["speculation"] = {
+                    "speculate_k": self.spec_k,
+                    "verify_dispatches": self.verify_dispatches,
+                    "drafted_tokens": self.drafted_tokens,
+                    "accepted_tokens": self.accepted_tokens,
+                    "emitted_tokens": self.spec_emitted_tokens,
+                    "accept_rate": round(
+                        self.accepted_tokens / self.drafted_tokens, 4)
+                    if self.drafted_tokens else None,
+                    "accept_hist": list(self.accept_hist),
+                    # emitted tokens over the verify rows paid for
+                    # (each slot-verify burns k+1 folded rows, and
+                    # sum(accept_hist) counts slot-verifies): 1.0 means
+                    # every row committed a token
+                    "speculation_efficiency": round(
+                        self.spec_emitted_tokens /
+                        (sum(self.accept_hist) * (self.spec_k + 1)), 4)
+                    if sum(self.accept_hist) else None,
+                }
             if self.warmup:
                 out["warmup"] = dict(self.warmup)
         out["ttft_ms"] = self.ttft_ms.summary()

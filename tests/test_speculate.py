@@ -1,0 +1,390 @@
+"""Speculative decoding: multi-token verified steps (ISSUE 20).
+
+The load-bearing property: `DecodeEngine(speculate_k=k)` commits
+token sequences BIT-IDENTICAL to the sequential engine — speculation
+may only change how fast tokens arrive, never which tokens.  Pinned by
+decoding the same streams through both engines across every lifecycle
+the sequential suite exercises (mid-stream joins, forced preemption,
+fleet chaos-kill failover, disagg prefill->decode handoff) plus the
+contracts that make the speedup claim honest:
+
+- zero post-warmup compiles across ANY accept pattern (fixed-shape
+  folded verify batch; drafter compiles land in the warmup window),
+- accept-histogram exactness via an ORACLE ModelDrafter (the target's
+  own architecture and seed: every draft accepted, accept_rate == 1.0
+  exactly) and a garbage drafter (constant proposals: parity still
+  holds, accounting identity emitted == accepted + slot-verifies),
+- n-gram drafting determinism (same stream twice -> identical tokens
+  AND identical histogram), and the `ngram_propose` lookup rules,
+- the `speculative_accept` op's masking semantics (ragged DraftLen,
+  inactive slots) and DecodeStats' speculation bookkeeping.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.observe.monitoring import runtime_stats
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import (DecodeConfig, DecodeEngine, DecodeStats,
+                                DisaggFleet, Drafter, Fleet, FleetConfig,
+                                ModelDrafter, NGramDrafter, ngram_propose)
+
+from op_test import run_op
+
+VOCAB = 48
+
+
+def _lm(seed=7):
+    return DecoderLM(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=32,
+                     d_inner=64, kv_dtype="float32", seed=seed)
+
+
+def _cfg(**kw):
+    base = dict(num_slots=2, page_size=4, max_len=48, num_pages=24,
+                prefill_buckets=(8, 16), decode_chunk=4,
+                kv_dtype="float32")
+    base.update(kw)
+    return DecodeConfig(**base)
+
+
+def _drain_close(engine):
+    assert engine.drain(timeout_s=120), "drain timed out"
+    snap = engine.stats.snapshot()
+    engine.close()
+    return snap
+
+
+def _sequential(prompts, budgets, cfg=None, priorities=None):
+    """The reference stream: the same requests through the SEQUENTIAL
+    engine (itself pinned against the naive full-KV reference in
+    test_paged_decode.py)."""
+    eng = DecodeEngine(_lm(), cfg or _cfg(),
+                       memory_budget_bytes=False).start()
+    futs = [eng.submit(p, max_new_tokens=b,
+                       **({"priority": pr} if priorities else {}))
+            for p, b, pr in zip(prompts, budgets,
+                                priorities or [None] * len(prompts))]
+    ref = [f.result(120).tolist() for f in futs]
+    _drain_close(eng)
+    return ref
+
+
+# -- engine parity ----------------------------------------------------------
+
+def test_speculative_matches_sequential_midstream_joins():
+    """More requests than slots (ragged joins mid-stream), default
+    NGramDrafter: token parity, zero post-warmup compiles, and the
+    speculation telemetry section all hold."""
+    prompts = make_prompts(5, VOCAB, min_len=3, max_len=14, seed=11)
+    budgets = [6, 3, 8, 1, 5]
+    ref = _sequential(prompts, budgets)
+
+    eng = DecodeEngine(_lm(), _cfg(), memory_budget_bytes=False,
+                       speculate_k=4).start()
+    snap = runtime_stats.snapshot()
+    futs = [eng.submit(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    got = [f.result(120).tolist() for f in futs]
+    compiles = runtime_stats.delta(snap)["compiles"]
+    stats = _drain_close(eng)
+
+    assert got == ref, "speculative tokens diverged from sequential"
+    assert compiles == 0, \
+        f"XLA compile after warmup (verify shape leaked): {compiles}"
+    assert stats["post_warmup_compiles"] == 0
+    assert stats["completed"] == 5
+    spec = stats["speculation"]
+    assert spec["speculate_k"] == 4
+    assert spec["verify_dispatches"] >= 1
+    assert len(spec["accept_hist"]) == 5
+    # every committed token is either a prefill first-token or a
+    # verify emission — nothing double-counted, nothing lost
+    assert spec["emitted_tokens"] + stats["prefill_joins"] == \
+        stats["tokens_generated"], (spec, stats)
+
+
+def test_speculative_under_forced_preemption():
+    """Pool sized so two slots cannot both finish: the low-priority
+    request is evicted mid-generation and regenerated — rollback,
+    requeue, and re-prefill must all preserve token parity under
+    speculation."""
+    cfg = _cfg(max_len=40, num_pages=11, prefill_buckets=(8,))
+    prompts = [np.arange(1, 8, dtype=np.int64),
+               np.arange(2, 9, dtype=np.int64)]
+    budgets = [24, 24]
+    ref = _sequential(prompts, budgets, cfg=cfg, priorities=[0, 5])
+
+    eng = DecodeEngine(_lm(), cfg, memory_budget_bytes=False,
+                       speculate_k=4).start()
+    lo = eng.submit(prompts[0], max_new_tokens=24, priority=0)
+    hi = eng.submit(prompts[1], max_new_tokens=24, priority=5)
+    got = [lo.result(120).tolist(), hi.result(120).tolist()]
+    stats = _drain_close(eng)
+    assert stats["preemptions"] >= 1, \
+        f"pool geometry did not force a preemption: {stats}"
+    assert got == ref, \
+        "preempted+regenerated speculative request diverged"
+    assert stats["post_warmup_compiles"] == 0
+
+
+def test_fleet_failover_parity_speculative():
+    """Chaos-kill one of two speculative replicas mid-decode: the
+    fleet regenerates in-flight requests on the survivor with token
+    parity, and the merged stats still carry the speculation section."""
+    prompts = make_prompts(6, VOCAB, min_len=3, max_len=8, seed=21)
+    budgets = [14, 12, 16, 11, 14, 12]
+    cfg = _cfg(prefill_buckets=(8,), decode_chunk=2)
+    ref = _sequential(prompts, budgets, cfg=cfg)
+
+    import time
+    engines = [DecodeEngine(_lm(), cfg, memory_budget_bytes=False,
+                            speculate_k=4) for _ in range(2)]
+    fleet = Fleet(engines, FleetConfig()).start()
+    try:
+        futs = [fleet.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        deadline = time.monotonic() + 60
+        while (engines[0].stats.tokens_generated < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        chaos.kill_replica(engines[0])
+        resps = [f.result(300) for f in futs]
+        snap = fleet.snapshot()
+    finally:
+        fleet.close()
+        chaos.clear()
+    for r, c in zip(resps, ref):
+        assert list(r.tokens) == c, (list(r.tokens), c)
+    assert snap["failovers"] >= 1, snap["failovers"]
+    assert snap["post_warmup_compiles"] == 0
+    assert snap["engines"]["speculation"]["speculate_k"] == 4
+
+
+def test_disagg_handoff_parity_speculative():
+    """Prefill worker -> KV-page handoff -> SPECULATIVE decode worker:
+    the imported slot decodes with verified multi-token steps and the
+    cross-hop stream stays token-identical."""
+    prompts = make_prompts(6, VOCAB, min_len=3, max_len=8, seed=21)
+    budgets = [14, 12, 16, 11, 14, 12]
+    cfg = _cfg(prefill_buckets=(8,), decode_chunk=2)
+    ref = _sequential(prompts, budgets, cfg=cfg)
+
+    fleet = DisaggFleet(
+        [DecodeEngine(_lm(), cfg, role="prefill",
+                      memory_budget_bytes=False)],
+        [DecodeEngine(_lm(), cfg, role="decode",
+                      memory_budget_bytes=False,
+                      speculate_k=4)]).start()
+    try:
+        futs = [fleet.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        outs = [f.result(300) for f in futs]
+        snap = fleet.snapshot()
+    finally:
+        fleet.close()
+    for r, c in zip(outs, ref):
+        assert list(r.tokens) == c, (list(r.tokens), c)
+    assert snap["handoffs"] == len(prompts), snap["handoffs"]
+    assert snap["post_warmup_compiles"] == 0
+
+
+# -- drafter-controlled histogram exactness ---------------------------------
+
+def test_oracle_model_drafter_accepts_everything():
+    """A draft model with the TARGET's own architecture and seed
+    proposes exactly what the verify forward predicts: with budgets
+    chosen so no round is capped to zero drafts, accept_rate is 1.0
+    EXACTLY and the zero-accept histogram bin stays empty."""
+    prompts = make_prompts(3, VOCAB, min_len=3, max_len=8, seed=5)
+    # post-prefill remainders 8/12/4 give draft caps 4,2 / 4,4,1 / 3 —
+    # never 0 — so a perfect drafter never records a zero-accept round
+    budgets = [9, 13, 5]
+    cfg = _cfg(prefill_buckets=(8,))
+    ref = _sequential(prompts, budgets, cfg=cfg)
+
+    eng = DecodeEngine(_lm(), cfg, memory_budget_bytes=False,
+                       speculate_k=4,
+                       drafter=ModelDrafter(_lm(), k=4)).start()
+    snap = runtime_stats.snapshot()
+    futs = [eng.submit(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    got = [f.result(120).tolist() for f in futs]
+    compiles = runtime_stats.delta(snap)["compiles"]
+    stats = _drain_close(eng)
+
+    assert got == ref
+    assert compiles == 0, \
+        f"draft-model compile leaked past warmup: {compiles}"
+    spec = stats["speculation"]
+    assert spec["accept_rate"] == 1.0, spec
+    assert spec["accept_hist"][0] == 0, spec
+    assert spec["accepted_tokens"] == spec["drafted_tokens"] > 0
+
+
+class _ZeroDrafter(Drafter):
+    """Worst-case drafter: always proposes k copies of token 0."""
+
+    def __init__(self, k):
+        self.k = int(k)
+
+    def draft(self, engine, active_ids):
+        s = engine.config.num_slots
+        drafts = np.zeros((s, self.k), np.int32)
+        draft_len = np.zeros((s,), np.int32)
+        for i in active_ids:
+            draft_len[i] = self.k
+        return drafts, draft_len
+
+
+def test_garbage_drafter_parity_and_accounting():
+    """A drafter that proposes garbage costs throughput, never
+    correctness: parity holds, and every slot-verify emits exactly
+    accepted+1 tokens (emitted == accepted_tokens + slot-verifies)."""
+    prompts = make_prompts(4, VOCAB, min_len=3, max_len=8, seed=13)
+    budgets = [7, 5, 9, 4]
+    cfg = _cfg(prefill_buckets=(8,))
+    ref = _sequential(prompts, budgets, cfg=cfg)
+
+    eng = DecodeEngine(_lm(), cfg, memory_budget_bytes=False,
+                       speculate_k=4, drafter=_ZeroDrafter(4)).start()
+    futs = [eng.submit(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    got = [f.result(120).tolist() for f in futs]
+    stats = _drain_close(eng)
+
+    assert got == ref, "garbage drafts corrupted the committed stream"
+    spec = stats["speculation"]
+    # no eos in these streams: each slot-verify commits accepted+1
+    assert spec["emitted_tokens"] == \
+        spec["accepted_tokens"] + sum(spec["accept_hist"]), spec
+    assert stats["post_warmup_compiles"] == 0
+
+
+def test_ngram_drafter_deterministic():
+    """Same stream twice through fresh speculative engines: identical
+    tokens AND an identical accept histogram (drafting is a pure
+    function of the committed stream)."""
+    prompts = make_prompts(4, VOCAB, min_len=3, max_len=14, seed=3)
+    budgets = [8, 6, 10, 7]
+
+    def run():
+        eng = DecodeEngine(_lm(), _cfg(), memory_budget_bytes=False,
+                           speculate_k=4).start()
+        futs = [eng.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        got = [f.result(120).tolist() for f in futs]
+        return got, _drain_close(eng)["speculation"]
+
+    got_a, spec_a = run()
+    got_b, spec_b = run()
+    assert got_a == got_b
+    assert spec_a["accept_hist"] == spec_b["accept_hist"]
+    assert spec_a["accept_rate"] == spec_b["accept_rate"]
+
+
+# -- ngram_propose lookup rules ---------------------------------------------
+
+def test_ngram_propose_rules():
+    # too short / degenerate k: nothing to look up
+    assert ngram_propose([], 4) == []
+    assert ngram_propose([7], 4) == []
+    assert ngram_propose([1, 2, 3], 0) == []
+    # no earlier occurrence of any suffix gram
+    assert ngram_propose([1, 2, 3, 4, 5], 4) == []
+    # exact 3-gram match: propose what followed it
+    assert ngram_propose([1, 2, 3, 4, 1, 2, 3], 4) == [4, 1, 2, 3]
+    # a FULL k-token continuation beats a nearer truncated match: in
+    # the period-2 cycle the nearest [7, 9] sits 2 from the end and
+    # would cap the proposal at 2 tokens
+    assert ngram_propose([7, 9] * 6, 4) == [7, 9, 7, 9]
+    # no full continuation anywhere: fall back to the nearest partial
+    assert ngram_propose([5, 1, 2, 3, 1, 2, 3], 4) == [1, 2, 3]
+    # gram backoff: no 3-gram repeat, but the trailing 1-gram repeats
+    assert ngram_propose([4, 8, 4, 9, 6, 4], 1) == [9]
+    # determinism
+    ctx = list(np.random.RandomState(0).randint(0, 6, size=40))
+    assert ngram_propose(ctx, 4) == ngram_propose(ctx, 4)
+
+
+def test_ngram_drafter_validation():
+    with pytest.raises(ValueError):
+        NGramDrafter(k=0)
+    with pytest.raises(ValueError):
+        NGramDrafter(k=4, ngram=0)
+
+
+# -- speculative_accept op semantics ----------------------------------------
+
+def test_speculative_accept_masking():
+    """Ragged DraftLen and inactive slots: acceptance never reads past
+    a slot's draft length, emitted tokens are -1-padded past the
+    accepted prefix, and inactive slots report Accepted == -1."""
+    ins = {
+        # slot 0: full match over 3 drafts          -> accept 3
+        # slot 1: DraftLen 1 masks the (matching) tail -> accept 1
+        # slot 2: inactive                           -> accept -1
+        # slot 3: first draft mismatches             -> accept 0
+        "Drafts": np.array([[5, 7, 2], [4, 6, 6], [1, 1, 1],
+                            [9, 3, 3]], np.int32),
+        "Predictions": np.array([[5, 7, 2, 8], [4, 6, 6, 1],
+                                 [1, 1, 1, 1], [8, 3, 3, 3]], np.int32),
+        "DraftLen": np.array([3, 1, 3, 3], np.int32),
+        "Active": np.array([1, 1, 0, 1], np.int32),
+    }
+    acc = run_op("speculative_accept", ins, out_slot="Accepted")
+    np.testing.assert_array_equal(acc, np.array([3, 1, -1, 0], np.int32))
+    toks = run_op("speculative_accept", ins, out_slot="Tokens")
+    np.testing.assert_array_equal(toks, np.array(
+        [[5, 7, 2, 8],
+         [4, 6, -1, -1],
+         [-1, -1, -1, -1],
+         [8, -1, -1, -1]], np.int32))
+
+
+# -- DecodeStats speculation bookkeeping ------------------------------------
+
+def test_stats_speculation_contracts():
+    st = DecodeStats()
+    with pytest.raises(ValueError):
+        st.configure_speculation(0)
+    with pytest.raises(RuntimeError):
+        st.record_verify(4, 5, [4])  # before configure_speculation
+    st.configure_speculation(4)
+    st.record_verify(drafted=7, emitted=9, accept_counts=[4, 3])
+    with pytest.raises(ValueError):
+        st.record_verify(1, 1, [5])  # count outside 0..k
+    with pytest.raises(RuntimeError):
+        st.configure_speculation(4)  # after verifies recorded
+    assert st.accept_hist == [0, 0, 0, 1, 1]
+    assert st.accepted_tokens == 7 and st.drafted_tokens == 7
+
+    # merge: k mismatch rejected; a non-speculating aggregator adopts
+    # the replica's k and merges histograms bin-wise
+    other = DecodeStats()
+    other.configure_speculation(2)
+    with pytest.raises(ValueError):
+        st.merge(other)
+    agg = DecodeStats()
+    agg.merge(st)
+    assert agg.spec_k == 4 and agg.accept_hist == [0, 0, 0, 1, 1]
+    agg.merge(st)
+    assert agg.accept_hist == [0, 0, 0, 2, 2]
+    assert agg.verify_dispatches == 2
+
+
+def test_engine_constructor_validation():
+    lm = _lm()
+    with pytest.raises(ValueError):
+        DecodeEngine(lm, _cfg(), memory_budget_bytes=False,
+                     role="prefill", speculate_k=4)
+    with pytest.raises(ValueError):
+        DecodeEngine(lm, _cfg(), memory_budget_bytes=False,
+                     drafter=NGramDrafter(4))  # drafter without k
+    with pytest.raises(ValueError):
+        DecodeEngine(lm, _cfg(), memory_budget_bytes=False,
+                     speculate_k=4, drafter=NGramDrafter(2))

@@ -281,6 +281,38 @@ def check_schema(candidate):
                     f"post-warmup compile(s) — a shape leaked across "
                     f"joins/leaves/preemptions (the zero-recompile "
                     f"decode contract)")
+        if entry.get("speculate"):
+            # speculative contract (ISSUE 20, docs/SERVING.md
+            # §speculate): a speculative entry must carry the accept
+            # rate with its k+1-bin histogram, the measured speedup
+            # against the sequential twin, and the token-parity proof
+            # — a speculative tokens/s number whose committed stream
+            # diverged from greedy decode is wrong, not fast
+            for field in ("accept_rate", "accept_hist",
+                          "speculation_efficiency",
+                          "speedup_vs_sequential", "token_parity",
+                          "post_warmup_compiles"):
+                if field not in entry:
+                    errors.append(f"detail.{name}: speculative entry "
+                                  f"missing {field!r} (speculative "
+                                  f"decode contract)")
+            if entry.get("token_parity") is False:
+                errors.append(
+                    f"detail.{name}: speculative tokens diverged from "
+                    f"the sequential engine (verified acceptance must "
+                    f"be bit-identical to greedy decode)")
+            hist = entry.get("accept_hist")
+            if (isinstance(hist, list)
+                    and len(hist) != int(entry["speculate"]) + 1):
+                errors.append(
+                    f"detail.{name}: accept_hist has {len(hist)} bins "
+                    f"for k={entry['speculate']} (want k+1)")
+            if entry.get("post_warmup_compiles"):
+                errors.append(
+                    f"detail.{name}: {entry['post_warmup_compiles']} "
+                    f"post-warmup compile(s) in a speculative run — "
+                    f"draft/verify must compile inside the warmup "
+                    f"window for ANY accept pattern")
         if "mesh" in entry:
             # mesh contract (ISSUE 10 + 13, docs/DIST.md): a multi-chip
             # entry must carry per-device AND aggregate throughput, the
@@ -307,7 +339,7 @@ def check_schema(candidate):
 
 def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
                    regressions, report, tol_mem=0.10, tol_ls=0.02,
-                   tol_comm=0.10, tol_gp=0.05):
+                   tol_comm=0.10, tol_gp=0.05, tol_ar=0.05):
     if "error" in cand and "error" not in base:
         regressions.append(f"{name}: candidate errored: "
                            f"{cand['error']}")
@@ -414,6 +446,24 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
         if fall > tol_gp:
             regressions.append(
                 line + f" exceeds tol -{tol_gp:.2f} share points")
+    # speculative accept rate (ISSUE 20): the drafter's health number.
+    # ABSOLUTE drop gates, and only between same-k speculative runs —
+    # on the deterministic CPU stream the accept rate is a pure
+    # function of drafter + model + prompts, so a fall means drafting
+    # quality regressed even when wall-clock noise hides it.  The
+    # speedup itself is NOT gated here (host-timing noise); the
+    # accept rate is its noise-free proxy.
+    bar, car = base.get("accept_rate"), cand.get("accept_rate")
+    if isinstance(bar, (int, float)) and isinstance(car, (int, float)) \
+            and base.get("speculate") == cand.get("speculate"):
+        fall = bar - car
+        line = (f"{name}.accept_rate: {bar:.4f} -> {car:.4f} "
+                f"({-fall:+.4f})")
+        report.append(line)
+        if fall > tol_ar:
+            regressions.append(
+                line + f" exceeds tol -{tol_ar:.2f} (drafting quality "
+                f"regressed)")
     # ZeRO opt-state footprint: per-device resident accumulator bytes
     # of the sharded step (same mesh + grad_sync guaranteed above) —
     # creeping back up means the fsdp sharding quietly stopped applying
@@ -431,7 +481,7 @@ def _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
 
 def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
          tol_mem=0.10, tol_ls=0.02, tol_comm=0.10, tol_gp=0.05,
-         allow_missing=False):
+         tol_ar=0.05, allow_missing=False):
     """(regressions, report_lines, compared_count).  Only entries whose
     device kind matches are compared — a CPU smoke candidate never
     false-fails against chip numbers."""
@@ -458,7 +508,8 @@ def gate(baseline, candidate, tol_mfu=0.05, tol_tp=0.07, tol_lat=0.10,
         compared += 1
         _compare_entry(name, base, cand, tol_mfu, tol_tp, tol_lat,
                        regressions, report, tol_mem=tol_mem,
-                       tol_ls=tol_ls, tol_comm=tol_comm, tol_gp=tol_gp)
+                       tol_ls=tol_ls, tol_comm=tol_comm, tol_gp=tol_gp,
+                       tol_ar=tol_ar)
         if "int8" in base and isinstance(cand.get("int8"), dict) \
                 and "error" not in base["int8"]:
             if "error" in cand["int8"]:
@@ -516,6 +567,14 @@ def main() -> int:
                         "warmup/compile split scales with steps, so "
                         "cross-shape goodput is not comparable (the "
                         "same-source rule)")
+    p.add_argument("--tol-accept-rate", type=float, default=0.05,
+                   help="tolerated ABSOLUTE drop in a speculative "
+                        "entry's accept_rate (ISSUE 20) — on the "
+                        "deterministic CPU stream the accept rate is "
+                        "a pure function of drafter + model + "
+                        "prompts, so a fall means drafting quality "
+                        "regressed even when timing noise hides it. "
+                        "Compared only between same-k runs")
     p.add_argument("--allow-missing", action="store_true",
                    help="baseline entries absent from the candidate "
                         "are not regressions (partial --model runs)")
@@ -567,7 +626,7 @@ def main() -> int:
         tol_tp=args.tol_throughput, tol_lat=args.tol_latency,
         tol_mem=args.tol_peak_mem, tol_ls=args.tol_layout_share,
         tol_comm=args.tol_comm_bytes, tol_gp=args.tol_goodput,
-        allow_missing=args.allow_missing)
+        tol_ar=args.tol_accept_rate, allow_missing=args.allow_missing)
     for line in report:
         print("  " + line)
     if compared == 0:

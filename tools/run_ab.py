@@ -3,13 +3,16 @@ perf-feature configuration on the real chip and write a combined
 AB artifact with the winners, so every bench default reflects a
 measured win.
 
-Usage: python tools/run_ab.py [--steps N] [--out AB_r11.json]
+Usage: python tools/run_ab.py [--steps N] [--out AB_r12.json]
 Each variant is a separate bench.py subprocess (fresh backend, no cache
 cross-talk); the probe inside bench.py keeps a dead backend from
 burning the timeout.  r11: every pair's summary carries goodput
 context (`<name>_goodput` — each side's harness-wall step fraction +
 effective_mfu, observe pillar 8) so a throughput verdict bought with
-badput is visible in the artifact itself.
+badput is visible in the artifact itself.  r12: the speculative-decode
+pair (`decode_spec_k4`, ISSUE 20) compares same-stream twins measured
+INSIDE one variant entry — bench --speculate runs the sequential twin
+itself, asserts token parity, and records both tokens/s.
 
 r06 added the scan-bound lstm variants (unroll sweep + the Pallas fused
 recurrence kernel vs the scan base).  r08 adds the dp-mesh pair
@@ -153,6 +156,14 @@ VARIANTS = [
     ("serving_decode_kv_bf16", ["--model", "serving_decode"]),
     ("serving_decode_kv_int8", ["--model", "serving_decode",
                                 "--kv-int8"]),
+    # r12: speculative decode (ISSUE 20).  The sequential side of this
+    # pair is measured INSIDE the variant itself — bench --speculate
+    # runs a sequential twin engine over the same stream/arch first
+    # (token parity asserted) and records sequential_tokens_per_sec —
+    # so the verdict compares same-stream twins, never the
+    # differently-shaped serving_decode entry above.
+    ("serving_decode_spec_k4", ["--model", "serving_decode",
+                                "--speculate", "4"]),
 ]
 
 
@@ -410,6 +421,21 @@ _PAIRS = {
                        "serving_decode_kv_bf16"),
 }
 
+# intra-entry pairs: both sides live in ONE variant's entry (the bench
+# measured them as same-stream twins in the same process).  The
+# speculative pair is the canonical case — speedup_vs_sequential is
+# spec tokens/s over the sequential twin's, with token parity asserted
+# before either number is recorded.
+_TWIN_PAIRS = {
+    "decode_spec_k4": ("serving_decode_spec_k4", {
+        "a_key": "tokens_per_sec",
+        "b_key": "sequential_tokens_per_sec",
+        "context": ("accept_rate", "accept_hist",
+                    "speculation_efficiency", "speedup_vs_sequential",
+                    "token_parity", "post_warmup_compiles"),
+    }),
+}
+
 
 def compute_summary(results):
     out = {}
@@ -449,6 +475,22 @@ def compute_summary(results):
             # so a throughput win bought with badput (compile storms,
             # ckpt stalls) is visible in the same artifact
             out[f"{name}_goodput"] = {a: ga, b: gb}
+    for name, (variant, spec) in _TWIN_PAIRS.items():
+        d = results.get(variant, {})
+        detail = d.get("detail") or {}
+        entry = None
+        for sub_name, sub in detail.items():
+            if isinstance(sub, dict) and spec["b_key"] in sub:
+                entry = sub
+                break
+        if entry is None or "error" in (d or {}):
+            out[f"{name}_wins"] = None
+            continue
+        ma, mb = entry.get(spec["a_key"]), entry.get(spec["b_key"])
+        out[f"{name}_wins"] = (None if not (ma and mb) else ma > mb)
+        out[f"{name}_twin"] = {spec["a_key"]: ma, spec["b_key"]: mb,
+                               **{c: entry.get(c)
+                                  for c in spec["context"]}}
     # the ZeRO scaling record (ISSUE 13 acceptance): opt-state bytes
     # per device across the fsdp ladder vs the dp=8 replicated
     # baseline — drop >=1.7x at fsdp=2, ~N/1 at fsdp=4/8 (the pinned
@@ -475,7 +517,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--timeout", type=int, default=1200)
-    p.add_argument("--out", default="AB_r11.json")
+    p.add_argument("--out", default="AB_r12.json")
     p.add_argument("--only", default=None,
                    help="comma-separated variant keys to run")
     p.add_argument("--bench-args", default=None,

@@ -347,6 +347,100 @@ print("decode bench smoke OK:",
 EOF
 python tools/perf_gate.py --schema --candidate /tmp/bench_decode_line.json
 
+echo "== speculative decode smoke (cpu) =="
+# ISSUE 20 tentpole: DecodeEngine(speculate_k=4) commits token
+# sequences BIT-IDENTICAL to the sequential engine across mid-stream
+# joins AND a forced preemption, performs ZERO XLA compiles after
+# warmup (the folded verify batch is one fixed shape for any accept
+# pattern), and the accept-rate telemetry section accounts for every
+# committed token (docs/SERVING.md §speculate)
+python - <<'EOF'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize stomps env
+
+from paddle_tpu.models.decoder_lm import DecoderLM, make_prompts
+from paddle_tpu.observe.monitoring import runtime_stats
+from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+def mk():
+    return DecoderLM(vocab_size=48, n_layer=2, n_head=2, d_model=32,
+                     d_inner=64, kv_dtype="float32", seed=7)
+
+cfg = DecodeConfig(num_slots=2, page_size=4, max_len=40, num_pages=11,
+                   prefill_buckets=(8, 16), decode_chunk=4,
+                   kv_dtype="float32")
+# 5 short requests exercise mid-stream joins; the trailing lo/hi pair
+# (two 24-token budgets against an 11-page pool) forces an eviction
+prompts = list(make_prompts(5, 48, min_len=3, max_len=14, seed=11)) \
+    + [np.arange(1, 8, dtype=np.int64), np.arange(2, 9, dtype=np.int64)]
+budgets = [8, 3, 10, 5, 7, 24, 24]
+prios = [0, 1, 0, 1, 0, 0, 5]
+
+def run_stream(**kw):
+    eng = DecodeEngine(mk(), cfg, memory_budget_bytes=False,
+                       **kw).start()
+    snap = runtime_stats.snapshot()
+    futs = [eng.submit(p, max_new_tokens=b, priority=pr)
+            for p, b, pr in zip(prompts, budgets, prios)]
+    outs = [f.result(300).tolist() for f in futs]
+    assert eng.drain(timeout_s=120), "drain timed out"
+    compiles = runtime_stats.delta(snap)["compiles"]
+    s = eng.stats.snapshot()
+    eng.close()
+    return outs, compiles, s
+
+ref, _, _ = run_stream()
+got, compiles, s = run_stream(speculate_k=4)
+assert got == ref, "speculative tokens diverged from sequential"
+assert compiles == 0, f"{compiles} XLA compiles AFTER warmup"
+assert s["post_warmup_compiles"] == 0 and s["completed"] == 7, s
+assert s["preemptions"] >= 1, f"pool did not force a preemption: {s}"
+spec = s["speculation"]
+assert spec["speculate_k"] == 4 and spec["verify_dispatches"] >= 1
+assert spec["emitted_tokens"] + s["prefill_joins"] == \
+    s["tokens_generated"], (spec, s["tokens_generated"])
+print("speculative decode smoke OK:",
+      {k: spec[k] for k in ("speculate_k", "verify_dispatches",
+                            "accept_rate", "accept_hist",
+                            "speculation_efficiency")},
+      {"preemptions": s["preemptions"],
+       "post_warmup_compiles": s["post_warmup_compiles"]})
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_speculate.py -q
+
+echo "== speculative bench line + schema gate (cpu) =="
+# the --speculate 4 serving_decode entry must print one JSON line
+# carrying the speculation contract (accept_rate, accept_hist,
+# speculation_efficiency, speedup_vs_sequential, token_parity) with
+# post_warmup_compiles == 0, and satisfy perf_gate --schema (which
+# also hard-fails on token_parity=false)
+BENCH_PLATFORM=cpu python - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "bench.py", "--model", "serving_decode",
+     "--speculate", "4", "--probe-timeout", "0"],
+    capture_output=True, text=True, timeout=900)
+lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+assert lines, "bench printed no JSON line:\n" + (r.stderr or r.stdout)[-2000:]
+out = json.loads(lines[-1])
+d = out["detail"]["serving_decode_spec_k4"]
+assert "error" not in d, d
+assert d["speculate"] == 4 and d["token_parity"] is True, d
+assert d["tokens_per_sec"] > 0 and d["post_warmup_compiles"] == 0, d
+assert len(d["accept_hist"]) == 5 and sum(d["accept_hist"]) > 0, d
+for k in ("accept_rate", "speculation_efficiency", "drafter",
+          "sequential_tokens_per_sec", "speedup_vs_sequential"):
+    assert k in d, k
+with open("/tmp/bench_spec_line.json", "w") as f:
+    f.write(lines[-1])
+print("speculative bench smoke OK:",
+      {k: d[k] for k in ("tokens_per_sec", "sequential_tokens_per_sec",
+                         "speedup_vs_sequential", "accept_rate",
+                         "token_parity", "post_warmup_compiles")})
+EOF
+python tools/perf_gate.py --schema --candidate /tmp/bench_spec_line.json
+
 echo "== serving fleet chaos smoke (cpu) =="
 # ISSUE 14 tentpole: kill one replica mid-stream under load -> zero
 # client-visible failures and every output token-identical to an
